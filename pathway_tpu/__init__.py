@@ -1,0 +1,184 @@
+"""pathway_tpu — a TPU-native live-data framework.
+
+A brand-new implementation of the Pathway capability surface (incremental
+streaming ETL with a Python Table API, connectors, persistence, and a live
+LLM/RAG stack) designed for JAX/XLA: batched jitted numeric plane, sharded
+device-resident KNN indexes, epoch-synchronous incremental host engine.
+
+Import convention::
+
+    import pathway_tpu as pw
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import api as _api
+from pathway_tpu.internals import dtype as _dt
+from pathway_tpu.internals import udfs
+from pathway_tpu.internals.api import PENDING
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    apply,
+    apply_async,
+    apply_with_type,
+    cast,
+    coalesce,
+    fill_error,
+    if_else,
+    make_tuple,
+    require,
+    unwrap,
+)
+from pathway_tpu.internals.config import set_license_key, set_monitoring_config
+from pathway_tpu.internals.json import Json
+from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.run import MonitoringLevel, run, run_all
+from pathway_tpu.internals.schema import (
+    Schema,
+    column_definition,
+    schema_builder,
+    schema_from_dict,
+    schema_from_pandas,
+    schema_from_types,
+)
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import left, right, this
+from pathway_tpu.internals.udfs import UDF, udf
+from pathway_tpu.internals.joins import JoinKind, JoinMode, JoinResult
+
+from pathway_tpu import debug
+from pathway_tpu import reducers
+
+#: engine Error value — poisoned cells propagate instead of aborting
+Error = _api.ERROR
+
+DATE_TIME_NAIVE = _dt.DATE_TIME_NAIVE
+DATE_TIME_UTC = _dt.DATE_TIME_UTC
+DURATION = _dt.DURATION
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name: str) -> Any:
+    # heavier subpackages load lazily to keep import fast
+    if name == "io":
+        import pathway_tpu.io as io
+
+        return io
+    if name == "stdlib":
+        import pathway_tpu.stdlib as stdlib
+
+        return stdlib
+    if name == "temporal":
+        import pathway_tpu.stdlib.temporal as temporal
+
+        return temporal
+    if name == "indexing":
+        import pathway_tpu.stdlib.indexing as indexing
+
+        return indexing
+    if name == "ml":
+        import pathway_tpu.stdlib.ml as ml
+
+        return ml
+    if name == "graphs":
+        import pathway_tpu.stdlib.graphs as graphs
+
+        return graphs
+    if name == "stateful":
+        import pathway_tpu.stdlib.stateful as stateful
+
+        return stateful
+    if name == "statistical":
+        import pathway_tpu.stdlib.statistical as statistical
+
+        return statistical
+    if name == "ordered":
+        import pathway_tpu.stdlib.ordered as ordered
+
+        return ordered
+    if name == "utils":
+        import pathway_tpu.stdlib.utils as utils
+
+        return utils
+    if name == "xpacks":
+        import pathway_tpu.xpacks as xpacks
+
+        return xpacks
+    if name == "demo":
+        import pathway_tpu.demo as demo
+
+        return demo
+    if name == "persistence":
+        import pathway_tpu.persistence as persistence
+
+        return persistence
+    if name == "universes":
+        import pathway_tpu.universes as universes
+
+        return universes
+    if name == "AsyncTransformer":
+        from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+
+        return AsyncTransformer
+    if name == "iterate":
+        from pathway_tpu.internals.iterate import iterate
+
+        return iterate
+    if name == "sql":
+        from pathway_tpu.internals.sql import sql
+
+        return sql
+    if name == "load_yaml":
+        from pathway_tpu.internals.yaml_loader import load_yaml
+
+        return load_yaml
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "Table",
+    "Schema",
+    "Json",
+    "Pointer",
+    "Error",
+    "PENDING",
+    "ColumnExpression",
+    "ColumnReference",
+    "this",
+    "left",
+    "right",
+    "JoinKind",
+    "JoinMode",
+    "JoinResult",
+    "apply",
+    "apply_async",
+    "apply_with_type",
+    "cast",
+    "coalesce",
+    "if_else",
+    "require",
+    "unwrap",
+    "fill_error",
+    "make_tuple",
+    "udf",
+    "udfs",
+    "UDF",
+    "run",
+    "run_all",
+    "MonitoringLevel",
+    "debug",
+    "reducers",
+    "column_definition",
+    "schema_from_types",
+    "schema_from_dict",
+    "schema_builder",
+    "schema_from_pandas",
+    "set_license_key",
+    "set_monitoring_config",
+    "G",
+]

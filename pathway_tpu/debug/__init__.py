@@ -1,0 +1,335 @@
+"""``pw.debug`` — build tables from literals, run & print results.
+
+Capability parity with reference ``python/pathway/debug/__init__.py``:
+``table_from_markdown`` (``:312``), ``table_from_rows``, ``table_from_pandas``,
+``compute_and_print`` (``:207``), ``compute_and_print_update_stream``
+(``:235``), ``table_to_pandas``, ``StreamGenerator`` (``:496``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Iterable, Mapping
+
+from pathway_tpu.engine import graph as eg
+from pathway_tpu.engine.scheduler import Scheduler
+from pathway_tpu.internals import api
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+
+def _parse_cell(text: str) -> Any:
+    text = text.strip()
+    if text in ("", "None"):
+        return None
+    if text == "True":
+        return True
+    if text == "False":
+        return False
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    if len(text) >= 2 and text[0] == text[-1] and text[0] in "\"'":
+        return text[1:-1]
+    return text
+
+
+def table_from_markdown(
+    txt: str,
+    *,
+    id_from: list[str] | None = None,
+    schema: Any = None,
+    _stream: bool = False,
+    **kwargs: Any,
+) -> Table:
+    """Parse a markdown/ascii table into a static table.  A column named
+    ``id`` gives explicit row keys; ``__time__``/``__diff__`` columns build
+    an update stream (reference ``debug/__init__.py:312-481``)."""
+    lines = [l for l in txt.strip().splitlines() if l.strip() and not set(l.strip()) <= {"-", "|", "+", " "}]
+
+    def split_line(line: str) -> list[str]:
+        line = line.strip()
+        if "|" in line:
+            return [c.strip() for c in line.strip("|").split("|")]
+        # whitespace-separated; quoted strings stay whole
+        return re.findall(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"|\S+", line)
+
+    header = [h for h in split_line(lines[0]) if h]
+    rows: list[list[Any]] = []
+    for line in lines[1:]:
+        cells = [c for c in split_line(line)]
+        rows.append([_parse_cell(c) for c in cells[: len(header)]])
+
+    has_id = "id" in header
+    special = [c for c in ("__time__", "__diff__") if c in header]
+    data_cols = [c for c in header if c != "id" and c not in special]
+
+    if special:
+        return _stream_table_from_rows(header, rows, data_cols, has_id, schema)
+
+    out_rows: list[tuple[K.Pointer, tuple]] = []
+    for i, r in enumerate(rows):
+        vals = dict(zip(header, r))
+        if has_id:
+            key = K.ref_scalar(vals["id"])
+        elif id_from:
+            key = K.ref_scalar(*[vals[c] for c in id_from])
+        elif schema is not None and sch.is_schema(schema) and schema.primary_key_columns():
+            key = K.ref_scalar(*[vals[c] for c in schema.primary_key_columns()])
+        else:
+            key = K.sequential_key(i)
+        out_rows.append((key, tuple(vals[c] for c in data_cols)))
+
+    dtypes = _infer_dtypes(data_cols, [v for _, v in out_rows], schema)
+    node = eg.InputNode(
+        G.engine_graph, n_cols=len(data_cols), static_rows=out_rows, name="markdown"
+    )
+    return Table(node, data_cols, dtypes, name="markdown")
+
+
+def _infer_dtypes(cols: list[str], rows: list[tuple], schema: Any) -> dict[str, dt.DType]:
+    if schema is not None and sch.is_schema(schema):
+        return {c: schema.__columns__[c].dtype for c in cols if c in schema.__columns__}
+    dtypes: dict[str, dt.DType] = {}
+    for i, c in enumerate(cols):
+        seen = {dt.dtype_of_value(r[i]) for r in rows if r[i] is not None}
+        has_none = any(r[i] is None for r in rows)
+        if len(seen) == 1:
+            d = seen.pop()
+        elif seen == {dt.INT, dt.FLOAT}:
+            d = dt.FLOAT
+        else:
+            d = dt.ANY
+        dtypes[c] = dt.Optional(d) if has_none and d != dt.ANY else d
+    return dtypes
+
+
+class _StreamSubject:
+    """Replays timed rows through the connector interface so ``__time__`` /
+    ``__diff__`` markdown columns become a genuine update stream."""
+
+    def __init__(self, timed_rows: list[tuple[int, K.Pointer, tuple, int]]):
+        self.timed_rows = sorted(timed_rows, key=lambda r: r[0])
+
+    def run(self, events: Any) -> None:
+        current_time: int | None = None
+        for t, key, vals, diff in self.timed_rows:
+            if current_time is not None and t != current_time:
+                events.commit()
+            current_time = t
+            if diff >= 0:
+                events.add(key, vals)
+            else:
+                events.remove(key, vals)
+        events.commit()
+
+
+def _stream_table_from_rows(
+    header: list[str], rows: list[list[Any]], data_cols: list[str], has_id: bool, schema: Any
+) -> Table:
+    timed: list[tuple[int, K.Pointer, tuple, int]] = []
+    for i, r in enumerate(rows):
+        vals = dict(zip(header, r))
+        t = int(vals.get("__time__", 0))
+        diff = int(vals.get("__diff__", 1))
+        key = K.ref_scalar(vals["id"]) if has_id else K.sequential_key(i)
+        timed.append((t, key, tuple(vals[c] for c in data_cols), diff))
+    dtypes = _infer_dtypes(data_cols, [v for _, _, v, _ in timed], schema)
+    node = eg.InputNode(
+        G.engine_graph,
+        n_cols=len(data_cols),
+        subject=_StreamSubject(timed),
+        name="markdown_stream",
+    )
+    return Table(node, data_cols, dtypes, name="markdown_stream")
+
+
+def stream_table_from_markdown(txt: str, **kwargs: Any) -> Table:
+    return table_from_markdown(txt, _stream=True, **kwargs)
+
+
+def table_from_rows(
+    schema: Any,
+    rows: Iterable[tuple],
+    unsafe_trusted_ids: bool = False,
+    is_stream: bool = False,
+) -> Table:
+    cols = schema.column_names()
+    pk = schema.primary_key_columns()
+    out_rows: list[tuple[K.Pointer, tuple]] = []
+    timed: list[tuple[int, K.Pointer, tuple, int]] = []
+    for i, r in enumerate(rows):
+        if is_stream:
+            *vals, time_, diff = r
+        else:
+            vals = list(r)
+            time_, diff = 0, 1
+        if pk:
+            key = K.ref_scalar(*[vals[cols.index(c)] for c in pk])
+        else:
+            key = K.sequential_key(i)
+        if is_stream:
+            timed.append((time_, key, tuple(vals), diff))
+        else:
+            out_rows.append((key, tuple(vals)))
+    dtypes = {c: schema.__columns__[c].dtype for c in cols}
+    if is_stream:
+        node = eg.InputNode(
+            G.engine_graph, n_cols=len(cols), subject=_StreamSubject(timed), name="rows_stream"
+        )
+    else:
+        node = eg.InputNode(
+            G.engine_graph, n_cols=len(cols), static_rows=out_rows, name="rows"
+        )
+    return Table(node, cols, dtypes, name="rows")
+
+
+def table_from_dicts(rows: Iterable[Mapping[str, Any]], schema: Any = None) -> Table:
+    rows = list(rows)
+    if schema is None:
+        cols: list[str] = []
+        for r in rows:
+            for c in r:
+                if c not in cols:
+                    cols.append(c)
+        schema = sch.schema_from_types(**{c: Any for c in cols})
+    return table_from_rows(schema, [tuple(r.get(c) for c in schema.column_names()) for r in rows])
+
+
+def table_from_pandas(df: Any, id_from: list[str] | None = None, schema: Any = None) -> Table:
+    if schema is None:
+        schema = sch.schema_from_pandas(df, id_from=id_from)
+    cols = schema.column_names()
+    rows = [tuple(df.iloc[i][c] for c in cols) for i in range(len(df))]
+    # normalise numpy scalars to python
+    import numpy as np
+
+    def norm(v: Any) -> Any:
+        if isinstance(v, np.generic):
+            return v.item()
+        return v
+
+    rows = [tuple(norm(v) for v in r) for r in rows]
+    return table_from_rows(schema, rows)
+
+
+def _run_capture(*tables: Table) -> list[tuple[dict, list]]:
+    captures = [t._capture_node() for t in tables]
+    sched = Scheduler(G.engine_graph)
+    ctx = sched.run()
+    G.last_run_ctx = ctx
+    out = []
+    for c in captures:
+        st = ctx.state(c)
+        out.append((st["rows"], st["stream"]))
+    return out
+
+
+def table_to_dicts(table: Table) -> tuple[list, dict[str, dict]]:
+    (rows, _), = _run_capture(table)
+    keys = list(rows.keys())
+    cols = {
+        c: {k: rows[k][i] for k in keys} for i, c in enumerate(table._column_names)
+    }
+    return keys, cols
+
+
+def table_to_pandas(table: Table, include_id: bool = True) -> Any:
+    import pandas as pd
+
+    (rows, _), = _run_capture(table)
+    data = {c: [v[i] for v in rows.values()] for i, c in enumerate(table._column_names)}
+    if include_id:
+        return pd.DataFrame(data, index=[repr(k) for k in rows.keys()])
+    return pd.DataFrame(data)
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "None"
+    if v is api.ERROR:
+        return "Error"
+    return repr(v) if isinstance(v, str) else str(v)
+
+
+def compute_and_print(
+    table: Table,
+    *,
+    include_id: bool = True,
+    short_pointers: bool = True,
+    n_rows: int | None = None,
+    squash_updates: bool = True,
+    **kwargs: Any,
+) -> None:
+    """Run the graph; print the final state of ``table``."""
+    (rows, _), = _run_capture(table)
+    cols = table._column_names
+    header = (["id"] if include_id else []) + list(cols)
+    lines = []
+    sortable = sorted(
+        rows.items(), key=lambda kv: tuple(repr(v) for v in kv[1])
+    )
+    for key, vals in sortable[: n_rows if n_rows is not None else len(sortable)]:
+        row = ([repr(key)] if include_id else []) + [_fmt(v) for v in vals]
+        lines.append(row)
+    widths = [max(len(h), *(len(l[i]) for l in lines)) if lines else len(h) for i, h in enumerate(header)]
+    print(" | ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip())
+    for l in lines:
+        print(" | ".join(c.ljust(w) for c, w in zip(l, widths)).rstrip())
+
+
+def compute_and_print_update_stream(
+    table: Table, *, include_id: bool = True, **kwargs: Any
+) -> None:
+    """Run the graph; print every (time, diff) update of ``table``."""
+    (_, stream), = _run_capture(table)
+    cols = table._column_names
+    header = (["id"] if include_id else []) + list(cols) + ["__time__", "__diff__"]
+    lines = []
+    for key, vals, time, diff in stream:
+        row = ([repr(key)] if include_id else []) + [_fmt(v) for v in vals] + [str(time), str(diff)]
+        lines.append(row)
+    widths = [max(len(h), *(len(l[i]) for l in lines)) if lines else len(h) for i, h in enumerate(header)]
+    print(" | ".join(h.ljust(w) for h, w in zip(header, widths)).rstrip())
+    for l in lines:
+        print(" | ".join(c.ljust(w) for c, w in zip(l, widths)).rstrip())
+
+
+class StreamGenerator:
+    """Programmatic update-stream builder for tests (reference
+    ``debug/__init__.py:496``)."""
+
+    def __init__(self) -> None:
+        self._events: list[tuple[int, K.Pointer, tuple, int]] = []
+        self._counter = 0
+
+    def table(self, schema: Any, batches: list[dict[K.Pointer, list]] | None = None) -> Table:
+        cols = schema.column_names()
+        node = eg.InputNode(
+            G.engine_graph,
+            n_cols=len(cols),
+            subject=_StreamSubject(self._events),
+            name="stream_generator",
+        )
+        dtypes = {c: schema.__columns__[c].dtype for c in cols}
+        return Table(node, cols, dtypes, name="stream_generator")
+
+    def _next_key(self) -> K.Pointer:
+        self._counter += 1
+        return K.sequential_key(self._counter)
+
+    def add(self, time: int, values: tuple, key: K.Pointer | None = None, diff: int = 1) -> K.Pointer:
+        key = key if key is not None else self._next_key()
+        self._events.append((time, key, values, diff))
+        return key
+
+    def table_from_list_of_batches_by_workers(self, *args: Any, **kwargs: Any) -> Table:
+        raise NotImplementedError("multi-worker stream generation: single-worker build")

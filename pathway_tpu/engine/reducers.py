@@ -1,0 +1,369 @@
+"""Retraction-aware reducer implementations.
+
+Capability parity with reference ``src/engine/reduce.rs`` (count, sums,
+min/max, argmin/argmax, unique, any, sorted_tuple, tuple, earliest/latest,
+stateful Python reducers).  Each reducer maintains an accumulator that
+supports ``add``/``remove`` with multiplicities; non-invertible reducers
+(min/max/unique/...) keep a multiset counter and recompute on extract — the
+group sizes seen in streaming ETL make O(distinct) extraction acceptable, and
+only dirty groups are re-extracted per epoch.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals import api
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.engine.stream import hashable
+
+
+class ReducerImpl:
+    """One reducer instance bound to its argument extractors."""
+
+    name = "reducer"
+    # how many expression arguments the reducer consumes
+    n_args = 1
+
+    def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.ANY
+
+    def make_acc(self) -> Any:
+        raise NotImplementedError
+
+    def update(self, acc: Any, args: tuple, diff: int) -> None:
+        raise NotImplementedError
+
+    def extract(self, acc: Any) -> Any:
+        raise NotImplementedError
+
+
+class CountReducer(ReducerImpl):
+    name = "count"
+    n_args = 0
+
+    def return_dtype(self, arg_dtypes):
+        return dt.INT
+
+    def make_acc(self):
+        return [0]
+
+    def update(self, acc, args, diff):
+        acc[0] += diff
+
+    def extract(self, acc):
+        return acc[0]
+
+
+class SumReducer(ReducerImpl):
+    name = "sum"
+
+    def return_dtype(self, arg_dtypes):
+        return arg_dtypes[0] if arg_dtypes else dt.ANY
+
+    def make_acc(self):
+        return [None, 0]  # total, count
+
+    def update(self, acc, args, diff):
+        v = args[0]
+        if v is None or v is api.ERROR:
+            return
+        if acc[0] is None:
+            acc[0] = v * diff if not isinstance(v, np.ndarray) else v * diff
+        else:
+            acc[0] = acc[0] + v * diff
+        acc[1] += diff
+
+    def extract(self, acc):
+        if acc[1] == 0 and not isinstance(acc[0], np.ndarray):
+            return 0 if acc[0] is None else type(acc[0])(0) if isinstance(acc[0], (int, float)) else acc[0]
+        return acc[0]
+
+
+class AvgReducer(ReducerImpl):
+    name = "avg"
+
+    def return_dtype(self, arg_dtypes):
+        return dt.FLOAT
+
+    def make_acc(self):
+        return [0.0, 0]
+
+    def update(self, acc, args, diff):
+        v = args[0]
+        if v is None or v is api.ERROR:
+            return
+        acc[0] += v * diff
+        acc[1] += diff
+
+    def extract(self, acc):
+        return acc[0] / acc[1] if acc[1] else None
+
+
+class _MultisetReducer(ReducerImpl):
+    """Base for non-invertible reducers: keeps Counter of hashable args with
+    original values remembered for extraction."""
+
+    def make_acc(self):
+        return {"counter": Counter(), "orig": {}}
+
+    def update(self, acc, args, diff):
+        h = hashable(args)
+        acc["counter"][h] += diff
+        if acc["counter"][h] <= 0:
+            del acc["counter"][h]
+            acc["orig"].pop(h, None)
+        else:
+            acc["orig"].setdefault(h, args)
+
+    def _items(self, acc):
+        return [(acc["orig"][h], c) for h, c in acc["counter"].items()]
+
+
+class MinReducer(_MultisetReducer):
+    name = "min"
+
+    def return_dtype(self, arg_dtypes):
+        return arg_dtypes[0]
+
+    def extract(self, acc):
+        vals = [v[0] for v, _ in self._items(acc) if v[0] is not None]
+        return min(vals) if vals else None
+
+
+class MaxReducer(MinReducer):
+    name = "max"
+
+    def extract(self, acc):
+        vals = [v[0] for v, _ in self._items(acc) if v[0] is not None]
+        return max(vals) if vals else None
+
+
+class ArgMinReducer(_MultisetReducer):
+    """args = (value, key_pointer)."""
+
+    name = "argmin"
+    n_args = 2
+
+    def return_dtype(self, arg_dtypes):
+        return dt.POINTER
+
+    def _pick(self, acc, fn):
+        items = [v for v, _ in self._items(acc) if v[0] is not None]
+        if not items:
+            return None
+        best = fn(items, key=lambda p: (p[0], p[1]))
+        return best[1]
+
+    def extract(self, acc):
+        return self._pick(acc, min)
+
+
+class ArgMaxReducer(ArgMinReducer):
+    name = "argmax"
+
+    def extract(self, acc):
+        return self._pick(acc, max)
+
+
+class UniqueReducer(_MultisetReducer):
+    name = "unique"
+
+    def return_dtype(self, arg_dtypes):
+        return arg_dtypes[0]
+
+    def extract(self, acc):
+        items = self._items(acc)
+        distinct = {hashable(v[0]) for v, _ in items}
+        if len(distinct) > 1:
+            return api.ERROR
+        return items[0][0][0] if items else None
+
+
+class AnyReducer(_MultisetReducer):
+    name = "any"
+
+    def return_dtype(self, arg_dtypes):
+        return arg_dtypes[0]
+
+    def extract(self, acc):
+        items = self._items(acc)
+        if not items:
+            return None
+        return min(items, key=lambda it: repr(hashable(it[0])))[0][0]
+
+
+class SortedTupleReducer(_MultisetReducer):
+    name = "sorted_tuple"
+
+    def __init__(self, skip_nones: bool = False):
+        self.skip_nones = skip_nones
+
+    def return_dtype(self, arg_dtypes):
+        return dt.List(arg_dtypes[0] if arg_dtypes else dt.ANY)
+
+    def extract(self, acc):
+        out = []
+        for v, c in self._items(acc):
+            if self.skip_nones and v[0] is None:
+                continue
+            out.extend([v[0]] * c)
+        return tuple(sorted(out, key=lambda x: (x is None, x)))
+
+
+class TupleReducer(ReducerImpl):
+    """Collects values; ordered by insertion sequence (stable across
+    retraction of any copy)."""
+
+    name = "tuple"
+
+    def __init__(self, skip_nones: bool = False):
+        self.skip_nones = skip_nones
+
+    def return_dtype(self, arg_dtypes):
+        return dt.List(arg_dtypes[0] if arg_dtypes else dt.ANY)
+
+    def make_acc(self):
+        return {"seq": 0, "items": {}}  # seq_id -> value ; plus index by hash
+
+    def update(self, acc, args, diff):
+        v = args[0]
+        if diff > 0:
+            for _ in range(diff):
+                acc["items"][acc["seq"]] = v
+                acc["seq"] += 1
+        else:
+            h = hashable(v)
+            to_remove = -diff
+            for sid in sorted(acc["items"], reverse=True):
+                if to_remove == 0:
+                    break
+                if hashable(acc["items"][sid]) == h:
+                    del acc["items"][sid]
+                    to_remove -= 1
+
+    def extract(self, acc):
+        vals = [acc["items"][sid] for sid in sorted(acc["items"])]
+        if self.skip_nones:
+            vals = [v for v in vals if v is not None]
+        return tuple(vals)
+
+
+class EarliestReducer(ReducerImpl):
+    name = "earliest"
+
+    def return_dtype(self, arg_dtypes):
+        return arg_dtypes[0]
+
+    def make_acc(self):
+        return TupleReducer().make_acc()
+
+    def update(self, acc, args, diff):
+        TupleReducer().update(acc, args, diff)
+
+    def extract(self, acc):
+        if not acc["items"]:
+            return None
+        return acc["items"][min(acc["items"])]
+
+
+class LatestReducer(EarliestReducer):
+    name = "latest"
+
+    def extract(self, acc):
+        if not acc["items"]:
+            return None
+        return acc["items"][max(acc["items"])]
+
+
+class NdarrayReducer(ReducerImpl):
+    name = "ndarray"
+
+    def return_dtype(self, arg_dtypes):
+        return dt.ANY_ARRAY
+
+    def make_acc(self):
+        return TupleReducer().make_acc()
+
+    def update(self, acc, args, diff):
+        TupleReducer().update(acc, args, diff)
+
+    def extract(self, acc):
+        vals = [acc["items"][sid] for sid in sorted(acc["items"])]
+        return np.array(vals)
+
+
+class NpSumReducer(ReducerImpl):
+    name = "npsum"
+
+    def return_dtype(self, arg_dtypes):
+        return dt.ANY_ARRAY
+
+    def make_acc(self):
+        return [None, 0]
+
+    def update(self, acc, args, diff):
+        v = args[0]
+        if v is None:
+            return
+        v = np.asarray(v)
+        acc[0] = v * diff if acc[0] is None else acc[0] + v * diff
+        acc[1] += diff
+
+    def extract(self, acc):
+        return acc[0]
+
+
+class StatefulReducer(ReducerImpl):
+    """Python custom reducer (reference ``stateful_many``/
+    ``BaseCustomAccumulator``, ``internals/custom_reducers.py``).  Keeps the
+    multiset of rows; folds the user accumulator on extraction, using
+    ``retract`` only when available — otherwise replays from scratch."""
+
+    name = "stateful"
+
+    def __init__(self, fold: Callable[[list[tuple]], Any], n_args: int = 1):
+        self.fold = fold
+        self.n_args = n_args
+        self._ms = _MultisetReducer()
+
+    def return_dtype(self, arg_dtypes):
+        return dt.ANY
+
+    def make_acc(self):
+        return self._ms.make_acc()
+
+    def update(self, acc, args, diff):
+        self._ms.update(acc, args, diff)
+
+    def extract(self, acc):
+        rows: list[tuple] = []
+        for v, c in self._ms._items(acc):
+            rows.extend([v] * c)
+        return self.fold(rows)
+
+
+def make_reducer(name: str, **kwargs: Any) -> ReducerImpl:
+    table: dict[str, Callable[[], ReducerImpl]] = {
+        "count": CountReducer,
+        "sum": SumReducer,
+        "avg": AvgReducer,
+        "min": MinReducer,
+        "max": MaxReducer,
+        "argmin": ArgMinReducer,
+        "argmax": ArgMaxReducer,
+        "unique": UniqueReducer,
+        "any": AnyReducer,
+        "earliest": EarliestReducer,
+        "latest": LatestReducer,
+        "ndarray": NdarrayReducer,
+        "npsum": NpSumReducer,
+    }
+    if name == "sorted_tuple":
+        return SortedTupleReducer(skip_nones=kwargs.get("skip_nones", False))
+    if name == "tuple":
+        return TupleReducer(skip_nones=kwargs.get("skip_nones", False))
+    return table[name]()

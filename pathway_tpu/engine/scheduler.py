@@ -1,0 +1,178 @@
+"""Epoch scheduler: the engine main loop.
+
+Equivalent of the reference worker main loop (``run_with_new_dataflow_graph``
++ ``step_or_park`` + pollers/flushers, ``src/engine/dataflow.rs:5506-5717``):
+drains connector event queues, cuts consistent epochs (micro-batches), and
+propagates update batches through the node graph in topological order.
+
+Consistency contract: outputs observe only closed epochs — within an epoch
+every operator sees the complete batch, so downstream tables are always a
+consistent snapshot (same guarantee the reference gets from timely frontiers).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+from collections import defaultdict
+from typing import Any
+
+from pathway_tpu.engine.graph import EngineGraph, InputNode, Node, RunContext
+from pathway_tpu.engine.stream import TIME_STEP, Batch, Update
+from pathway_tpu.internals.keys import Pointer
+
+
+class ConnectorEvents:
+    """Callback bundle handed to a connector subject's reader thread."""
+
+    def __init__(self, q: "queue.Queue", node_id: int):
+        self._q = q
+        self._node_id = node_id
+
+    def add(self, key: Pointer, values: tuple) -> None:
+        self._q.put((self._node_id, "add", key, values))
+
+    def remove(self, key: Pointer, values: tuple) -> None:
+        self._q.put((self._node_id, "remove", key, values))
+
+    def commit(self) -> None:
+        self._q.put((self._node_id, "commit", None, None))
+
+    def close(self) -> None:
+        self._q.put((self._node_id, "close", None, None))
+
+
+class Scheduler:
+    def __init__(
+        self,
+        graph: EngineGraph,
+        *,
+        autocommit_ms: int = 50,
+        n_workers: int = 1,
+        worker_id: int = 0,
+    ):
+        self.graph = graph
+        self.autocommit_ms = autocommit_ms
+        self.consumers: dict[int, list[tuple[Node, int]]] = defaultdict(list)
+        for node in graph.nodes:
+            for port, inp in enumerate(node.inputs):
+                self.consumers[inp.id].append((node, port))
+        self.ctx = RunContext(n_workers=n_workers, worker_id=worker_id)
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    def run_epoch(self, time: int, inject: dict[int, Batch]) -> None:
+        ctx = self.ctx
+        ctx.time = time
+        pending: dict[int, dict[int, list[Update]]] = defaultdict(lambda: defaultdict(list))
+        for nid, batch in inject.items():
+            pending[nid][0] = list(batch)
+        for node in self.graph.nodes:
+            ins = pending.pop(node.id, None)
+            has_input = ins is not None and any(ins.values())
+            if not has_input and not node.always_tick and not getattr(ctx, "finalizing", False):
+                continue
+            n_ports = max(1, len(node.inputs))
+            inbatches = [ins.get(i, []) if ins else [] for i in range(n_ports)]
+            out = node.process(ctx, time, inbatches)
+            if out:
+                for consumer, port in self.consumers.get(node.id, ()):  # fan-out
+                    pending[consumer.id][port].extend(out)
+        for node in self.graph.nodes:
+            node.on_time_end(ctx, time)
+
+    def _finish(self) -> None:
+        # final flush epoch: frontier advances to +inf; buffering operators release
+        self.ctx.finalizing = True  # type: ignore[attr-defined]
+        self.run_epoch(self.ctx.time + TIME_STEP, {})
+        for node in self.graph.nodes:
+            node.on_end(self.ctx)
+
+    # ------------------------------------------------------------------
+    def run(self) -> RunContext:
+        static_inject: dict[int, Batch] = {}
+        live_inputs: list[InputNode] = []
+        for node in self.graph.nodes:
+            if isinstance(node, InputNode):
+                if node.static_rows:
+                    static_inject[node.id] = [
+                        Update(k, v, 1) for k, v in node.static_rows
+                    ]
+                if node.subject is not None:
+                    live_inputs.append(node)
+
+        if not live_inputs:
+            self.run_epoch(0, static_inject)
+            self.ctx.time = 0
+            self._finish()
+            return self.ctx
+
+        # --- streaming mode -------------------------------------------
+        q: "queue.Queue" = queue.Queue()
+        threads: list[threading.Thread] = []
+        for node in live_inputs:
+            events = ConnectorEvents(q, node.id)
+            t = threading.Thread(
+                target=self._run_subject, args=(node, events), daemon=True
+            )
+            t.start()
+            threads.append(t)
+
+        open_subjects = {n.id for n in live_inputs}
+        buffers: dict[int, list[Update]] = defaultdict(list)
+        t = 0
+        if static_inject:
+            self.run_epoch(t, static_inject)
+            t += TIME_STEP
+        last_cut = _time.monotonic()
+        commit_requested = False
+        while True:
+            timeout = self.autocommit_ms / 1000.0
+            try:
+                nid, kind, key, values = q.get(timeout=timeout)
+                if kind == "add":
+                    buffers[nid].append(Update(key, values, 1))
+                elif kind == "remove":
+                    buffers[nid].append(Update(key, values, -1))
+                elif kind == "commit":
+                    commit_requested = True
+                elif kind == "close":
+                    open_subjects.discard(nid)
+            except queue.Empty:
+                pass
+            now = _time.monotonic()
+            have_data = any(buffers.values())
+            should_cut = have_data and (
+                commit_requested or (now - last_cut) * 1000.0 >= self.autocommit_ms
+            )
+            if should_cut:
+                inject = {nid: b for nid, b in buffers.items() if b}
+                buffers = defaultdict(list)
+                commit_requested = False
+                self.run_epoch(t, inject)
+                t += TIME_STEP
+                last_cut = now
+            if not open_subjects and q.empty() and not any(buffers.values()):
+                break
+            if self._stop.is_set():
+                break
+        self.ctx.time = t
+        self._finish()
+        return self.ctx
+
+    @staticmethod
+    def _run_subject(node: InputNode, events: ConnectorEvents) -> None:
+        try:
+            node.subject.run(events)
+        except Exception as e:  # reader errors must not hang the run
+            import logging
+
+            logging.getLogger("pathway_tpu").error(
+                "connector %s failed: %r", node.name, e
+            )
+        finally:
+            events.close()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,54 @@
+"""Engine-level sentinels and error values.
+
+Mirrors the capability of reference ``Value::Error`` / ``Value::Pending``
+(``src/engine/value.rs:207-231``): a poisoned cell value that propagates
+through expressions without aborting the run, and a pending marker for async
+results.
+"""
+
+from __future__ import annotations
+
+
+class _Error:
+    _instance: "_Error | None" = None
+
+    def __new__(cls) -> "_Error":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Error"
+
+    def __bool__(self) -> bool:
+        raise ValueError("Cannot use pw Error value in a boolean context")
+
+
+class _Pending:
+    _instance: "_Pending | None" = None
+
+    def __new__(cls) -> "_Pending":
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "Pending"
+
+
+ERROR = _Error()
+PENDING = _Pending()
+
+
+def is_error(value: object) -> bool:
+    return value is ERROR
+
+
+class EngineError(Exception):
+    """Raised for unrecoverable engine failures."""
+
+
+class EngineErrorWithTrace(EngineError):
+    def __init__(self, message: str, trace: str | None = None):
+        super().__init__(message if trace is None else f"{message}\n{trace}")
+        self.trace = trace

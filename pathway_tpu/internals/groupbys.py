@@ -1,0 +1,156 @@
+"""GroupedTable: ``table.groupby(...).reduce(...)``.
+
+Capability parity with reference ``python/pathway/internals/groupbys.py``:
+reduction over grouping columns with retraction-aware reducers, including
+expressions that mix reducers with grouping columns
+(``pw.reducers.sum(t.x) + pw.this.g``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine import graph as eg
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    ReducerExpression,
+    _wrap,
+    smart_name,
+)
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.thisclass import this as THIS
+
+
+class GroupedTable:
+    def __init__(
+        self,
+        source: Any,
+        grouping: list[ColumnExpression],
+        set_id: bool = False,
+    ):
+        self._source = source
+        self._grouping = grouping
+        self._set_id = set_id
+        for g in self._grouping:
+            if not isinstance(g, ColumnReference):
+                raise NotImplementedError(
+                    "groupby currently supports column references as grouping keys; "
+                    "select the computed expression into a column first"
+                )
+
+    def _match_grouping(self, ref: ColumnReference) -> int | None:
+        for i, g in enumerate(self._grouping):
+            assert isinstance(g, ColumnReference)
+            same_table = g._table is ref._table or getattr(
+                g._table, "_layout_token", object()
+            ) is getattr(ref._table, "_layout_token", None)
+            if same_table and g._name == ref._name:
+                return i
+        return None
+
+    def reduce(self, *args: Any, **kwargs: Any) -> Any:
+        from pathway_tpu.internals.table import Table
+
+        source: Table = self._source
+        named: list[tuple[str, ColumnExpression]] = []
+        for a in args:
+            e = _wrap(a)._substitute({THIS: source})
+            n = smart_name(e)
+            if n is None:
+                raise ValueError(
+                    "Positional reduce() arguments must be column references"
+                )
+            named.append((n, e))
+        for n, a in kwargs.items():
+            named.append((n, _wrap(a)._substitute({THIS: source})))
+
+        # --- rewrite each output expression: reducers and grouping refs
+        # become slots of the intermediate groupby output table
+        reducer_slots: list[ReducerExpression] = []
+
+        n_group = len(self._grouping)
+        inter_names = [f"__g{i}" for i in range(n_group)]
+
+        def alloc_reducer(e: ReducerExpression) -> int:
+            reducer_slots.append(e)
+            return len(reducer_slots) - 1
+
+        inter_ref_holder: list[Any] = [None]
+
+        def rewrite(e: ColumnExpression) -> ColumnExpression:
+            if isinstance(e, ReducerExpression):
+                i = alloc_reducer(e)
+                return ColumnReference(inter_ref_holder, f"__r{i}")
+            if isinstance(e, ColumnReference):
+                if e._name == "id" and self._match_grouping(e) is None:
+                    # group key pointer
+                    return ColumnReference(inter_ref_holder, "id")
+                gi = self._match_grouping(e)
+                if gi is None:
+                    raise ValueError(
+                        f"Column {e._name!r} must appear in groupby(...) or inside "
+                        "a reducer"
+                    )
+                return ColumnReference(inter_ref_holder, f"__g{gi}")
+            children = [rewrite(c) for c in e._children()]
+            return e._rebuild(children)
+
+        rewritten = [(n, rewrite(e)) for n, e in named]
+
+        # --- build engine groupby
+        layout = source._layout()
+        gfns = [
+            g._substitute({THIS: source})._compile(layout.resolver)
+            for g in self._grouping
+        ]
+
+        def group_fn(key: Any, values: tuple) -> tuple:
+            kv = (key, values)
+            return tuple(f(kv) for f in gfns)
+
+        reducer_args: list[tuple[Any, Callable]] = []
+        for re_expr in reducer_slots:
+            impl = re_expr._reducer.make_impl(**re_expr._reducer_kwargs)
+            arg_fns = [a._compile(layout.resolver) for a in re_expr._args]
+            if impl.name in ("argmin", "argmax"):
+                def arg_fn(key, values, arg_fns=arg_fns):
+                    kv = (key, values)
+                    return (arg_fns[0](kv), key)
+
+            else:
+                def arg_fn(key, values, arg_fns=arg_fns):
+                    kv = (key, values)
+                    return tuple(f(kv) for f in arg_fns)
+
+            reducer_args.append((impl, arg_fn))
+
+        node = eg.GroupByNode(
+            G.engine_graph,
+            source._node,
+            group_fn,
+            reducer_args,
+            include_group_values=True,
+            name="groupby",
+        )
+        inter_cols = inter_names + [f"__r{i}" for i in range(len(reducer_slots))]
+        inter_dtypes: dict[str, dt.DType] = {}
+        for i, g in enumerate(self._grouping):
+            inter_dtypes[f"__g{i}"] = g._dtype
+        for i, re_expr in enumerate(reducer_slots):
+            inter_dtypes[f"__r{i}"] = re_expr._dtype
+        inter = Table(node, inter_cols, inter_dtypes, name="groupby_inter")
+
+        # Re-point rewritten references at the concrete intermediate table.
+        def repoint(e: ColumnExpression) -> ColumnExpression:
+            if isinstance(e, ColumnReference) and e._table is inter_ref_holder:
+                if e._name == "id":
+                    return inter.id
+                return ColumnReference(inter, e._name)
+            children = [repoint(c) for c in e._children()]
+            return e._rebuild(children)
+
+        final = {n: repoint(e) for n, e in rewritten}
+        return inter.select(**final)

@@ -1,0 +1,35 @@
+"""Global graph holder ``G``.
+
+Reference: ``python/pathway/internals/parse_graph.py`` keeps a global
+``ParseGraph`` rebuilt per test.  Here the user API constructs engine nodes
+eagerly (no separate replay layer is needed because nodes are stateless
+descriptions — execution state lives in a per-run ``RunContext``), so ``G``
+holds the single :class:`EngineGraph` plus the error log and run bookkeeping.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+from pathway_tpu.engine.graph import EngineGraph
+
+logger = logging.getLogger("pathway_tpu")
+
+
+class ParseGraph:
+    def __init__(self) -> None:
+        self.engine_graph = EngineGraph()
+        self.errors: list[str] = []
+        self.last_run_ctx: Any = None
+        self._cache: dict[Any, Any] = {}
+
+    def clear(self) -> None:
+        self.__init__()
+
+    def log_error(self, message: str) -> None:
+        self.errors.append(message)
+        logger.warning("pathway_tpu error value produced: %s", message)
+
+
+G = ParseGraph()

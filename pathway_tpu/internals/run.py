@@ -1,0 +1,56 @@
+"""``pw.run`` — execute the constructed dataflow.
+
+Reference: ``python/pathway/internals/run.py`` + ``GraphRunner``
+(``internals/graph_runner/__init__.py:36-252``).  Runs the epoch scheduler
+over the global graph; with live connectors it blocks until all sources
+close (streaming mode), mirroring ``pw.run`` blocking semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.scheduler import Scheduler
+from pathway_tpu.internals.parse_graph import G
+
+
+class MonitoringLevel:
+    NONE = "none"
+    IN_OUT = "in_out"
+    ALL = "all"
+    AUTO = "auto"
+
+
+def run(
+    *,
+    monitoring_level: Any = MonitoringLevel.AUTO,
+    with_http_server: bool = False,
+    autocommit_duration_ms: int | None = 50,
+    persistence_config: Any = None,
+    runtime_typechecking: bool | None = None,
+    **kwargs: Any,
+):
+    """Run the whole computation graph (blocking until sources finish)."""
+    from pathway_tpu.internals import config as cfg
+
+    if persistence_config is None:
+        persistence_config = cfg.pathway_config.persistence_config
+    sched = Scheduler(
+        G.engine_graph,
+        autocommit_ms=autocommit_duration_ms or 50,
+    )
+    if with_http_server or cfg.pathway_config.monitoring_http_port:
+        from pathway_tpu.internals.monitoring_server import start_http_server
+
+        start_http_server(sched)
+    if persistence_config is not None:
+        from pathway_tpu.persistence import attach_persistence
+
+        attach_persistence(sched, persistence_config)
+    ctx = sched.run()
+    G.last_run_ctx = ctx
+    return ctx
+
+
+def run_all(**kwargs: Any):
+    return run(**kwargs)

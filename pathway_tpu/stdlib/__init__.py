@@ -1,0 +1,23 @@
+"""stdlib: algorithms written against the Table API (reference
+``python/pathway/stdlib/``): temporal, indexing, ml, graphs, stateful,
+statistical, ordered, utils, viz."""
+
+from typing import Any
+
+
+def __getattr__(name: str) -> Any:
+    import importlib
+
+    if name in (
+        "temporal",
+        "indexing",
+        "ml",
+        "graphs",
+        "stateful",
+        "statistical",
+        "ordered",
+        "utils",
+        "viz",
+    ):
+        return importlib.import_module(f"pathway_tpu.stdlib.{name}")
+    raise AttributeError(name)

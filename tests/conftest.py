@@ -1,0 +1,19 @@
+import os
+
+# Sharding tests run on a virtual 8-device CPU mesh; the engine host plane
+# doesn't need the TPU, and tests must not depend on one being attached.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_graph():
+    """Reset the global graph between tests (reference
+    ``python/pathway/conftest.py`` resets ParseGraph per test)."""
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    yield
+    G.clear()

@@ -1,0 +1,665 @@
+"""Core Table API tests (modeled on the reference's test_common.py areas:
+select/filter/expressions/groupby/join/concat/update/ix)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import (
+    T,
+    assert_table_equality,
+    assert_table_equality_wo_index,
+)
+
+
+def test_select_arithmetic():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = t.select(s=t.a + t.b, d=pw.this.b - pw.this.a, m=t.a * t.b)
+    expected = T(
+        """
+        s | d | m
+        3 | 1 | 2
+        7 | 1 | 12
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_select_this_splat():
+    t = T(
+        """
+        a | b
+        1 | 2
+        """
+    )
+    res = t.select(pw.this, c=pw.this.a + 10)
+    expected = T(
+        """
+        a | b | c
+        1 | 2 | 11
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_filter():
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        4
+        """
+    )
+    res = t.filter(t.a > 2)
+    expected = T(
+        """
+        a
+        3
+        4
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_filter_then_select_parent_column():
+    t = T(
+        """
+        a | b
+        1 | 10
+        2 | 20
+        3 | 30
+        """
+    )
+    filtered = t.filter(t.a >= 2)
+    res = filtered.select(t.b)
+    expected = T(
+        """
+        b
+        20
+        30
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_if_else_coalesce():
+    t = T(
+        """
+        a | b
+        1 | 5
+        7 | 2
+        """
+    )
+    res = t.select(mx=pw.if_else(t.a > t.b, t.a, t.b))
+    expected = T(
+        """
+        mx
+        5
+        7
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+    t2 = T(
+        """
+        x
+        1
+        None
+        """
+    )
+    res2 = t2.select(y=pw.coalesce(pw.this.x, 0))
+    expected2 = T(
+        """
+        y
+        1
+        0
+        """
+    )
+    assert_table_equality_wo_index(res2, expected2)
+
+
+def test_apply():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    res = t.select(b=pw.apply(lambda x: x * 100, t.a))
+    expected = T(
+        """
+        b
+        100
+        200
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_udf():
+    @pw.udf
+    def inc(x: int) -> int:
+        return x + 1
+
+    t = T(
+        """
+        a
+        1
+        5
+        """
+    )
+    res = t.select(b=inc(t.a))
+    expected = T(
+        """
+        b
+        2
+        6
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_async_udf():
+    import asyncio
+
+    @pw.udf
+    async def double(x: int) -> int:
+        await asyncio.sleep(0.001)
+        return 2 * x
+
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    res = t.select(b=double(t.a))
+    expected = T(
+        """
+        b
+        2
+        4
+        6
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_groupby_reduce():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 2
+        b | 10
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v), c=pw.reducers.count())
+    expected = T(
+        """
+        g | s  | c
+        a | 3  | 2
+        b | 10 | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_groupby_min_max_avg():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 5
+        b | 2
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        t.g,
+        mn=pw.reducers.min(t.v),
+        mx=pw.reducers.max(t.v),
+        av=pw.reducers.avg(t.v),
+    )
+    expected = T(
+        """
+        g | mn | mx | av
+        a | 1  | 5  | 3.0
+        b | 2  | 2  | 2.0
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_global_reduce():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        """
+    )
+    res = t.reduce(s=pw.reducers.sum(t.v))
+    expected = T(
+        """
+        s
+        6
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_reduce_expression_over_reducers():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 3
+        b | 10
+        """
+    )
+    res = t.groupby(t.g).reduce(
+        t.g, mean=pw.cast(float, pw.reducers.sum(t.v)) / pw.reducers.count()
+    )
+    expected = T(
+        """
+        g | mean
+        a | 2.0
+        b | 10.0
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_join_inner():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        2 | y
+        3 | z
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        10 | x
+        20 | y
+        """
+    )
+    res = t1.join(t2, t1.k == t2.k).select(t1.a, t2.b, pw.left.k)
+    expected = T(
+        """
+        a | b  | k
+        1 | 10 | x
+        2 | 20 | y
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_join_left():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        3 | z
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        10 | x
+        """
+    )
+    res = t1.join_left(t2, t1.k == t2.k).select(t1.a, b=t2.b)
+    expected = T(
+        """
+        a | b
+        1 | 10
+        3 | None
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_join_outer():
+    t1 = T(
+        """
+        a | k
+        1 | x
+        """
+    )
+    t2 = T(
+        """
+        b | k
+        10 | x
+        20 | y
+        """
+    )
+    res = t1.join_outer(t2, t1.k == t2.k).select(a=t1.a, b=t2.b)
+    expected = T(
+        """
+        a    | b
+        1    | 10
+        None | 20
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_concat_reindex():
+    t1 = T(
+        """
+        a
+        1
+        """
+    )
+    t2 = T(
+        """
+        a
+        2
+        """
+    )
+    res = t1.concat_reindex(t2)
+    expected = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_update_cells():
+    t1 = T(
+        """
+        id | a | b
+        1  | 1 | x
+        2  | 2 | y
+        """
+    )
+    t2 = T(
+        """
+        id | b
+        1  | z
+        """
+    )
+    res = t1.update_cells(t2)
+    expected = T(
+        """
+        id | a | b
+        1  | 1 | z
+        2  | 2 | y
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_update_rows():
+    t1 = T(
+        """
+        id | a
+        1  | 1
+        2  | 2
+        """
+    )
+    t2 = T(
+        """
+        id | a
+        2  | 20
+        3  | 30
+        """
+    )
+    res = t1.update_rows(t2)
+    expected = T(
+        """
+        id | a
+        1  | 1
+        2  | 20
+        3  | 30
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_intersect_difference():
+    t1 = T(
+        """
+        id | a
+        1  | 1
+        2  | 2
+        3  | 3
+        """
+    )
+    t2 = T(
+        """
+        id | b
+        2  | x
+        3  | y
+        """
+    )
+    assert_table_equality_wo_index(
+        t1.intersect(t2),
+        T(
+            """
+            a
+            2
+            3
+            """
+        ),
+    )
+    assert_table_equality_wo_index(
+        t1.difference(t2),
+        T(
+            """
+            a
+            1
+            """
+        ),
+    )
+
+
+def test_flatten():
+    t = T(
+        """
+        g
+        a
+        """
+    ).select(pw.this.g, parts=pw.apply(lambda g: (1, 2, 3), pw.this.g))
+    res = t.flatten(pw.this.parts)
+    expected = T(
+        """
+        g | parts
+        a | 1
+        a | 2
+        a | 3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_ix():
+    target = T(
+        """
+        id | v
+        1  | 100
+        2  | 200
+        """
+    )
+    req = T(
+        """
+        ptr
+        1
+        2
+        1
+        """
+    ).select(p=pw.apply(lambda x: x, pw.this.ptr))
+    req = req.select(p=target.pointer_from(pw.this.p))
+    # pointer_from hashes the value; target ids are hashed from markdown `id`
+    res = target.ix(req.p).select(v=pw.this.v)
+    expected = T(
+        """
+        v
+        100
+        200
+        100
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_with_id_from():
+    t = T(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    res = t.with_id_from(t.a)
+    res2 = res.select(res.a, res.b)
+    assert_table_equality_wo_index(
+        res2,
+        T(
+            """
+            a | b
+            1 | x
+            2 | y
+            """
+        ),
+    )
+
+
+def test_pointer_from_consistency():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    keyed = t.with_id_from(t.a)
+    looked = keyed.ix(keyed.pointer_from(t.a, instance=None), context=t)
+    assert_table_equality_wo_index(
+        looked,
+        T(
+            """
+            a
+            1
+            2
+            """
+        ),
+    )
+
+
+def test_deduplicate():
+    t = T(
+        """
+        v
+        1
+        2
+        5
+        3
+        """
+    )
+    res = t.deduplicate(value=pw.this.v, acceptor=lambda new, old: old is None or new > old)
+    expected = T(
+        """
+        v
+        5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_argmax_argmin():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 5
+        b | 7
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, am=pw.reducers.argmax(t.v))
+    rows = __import__("tests.utils", fromlist=["_rows_of"])._rows_of(res)
+    assert len(rows) == 2
+
+
+def test_tuple_reducers():
+    t = T(
+        """
+        g | v
+        a | 3
+        a | 1
+        b | 2
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, st=pw.reducers.sorted_tuple(t.v))
+    expected_rows = {("a", (1, 3)), ("b", (2,))}
+    from tests.utils import _rows_of
+
+    rows = set(tuple(v) for v in _rows_of(res).values())
+    assert rows == expected_rows
+
+
+def test_error_value_propagates():
+    t = T(
+        """
+        a | b
+        1 | 0
+        6 | 3
+        """
+    )
+    res = t.select(d=t.a // t.b)
+    from tests.utils import _rows_of
+
+    rows = sorted(_rows_of(res).values(), key=repr)
+    assert (2,) in rows
+    assert any(v[0] is pw.Error for v in rows)
+
+
+def test_string_namespace():
+    t = T(
+        """
+        s
+        hello
+        """
+    )
+    res = t.select(
+        up=t.s.str.upper(), ln=t.s.str.len(), sw=t.s.str.startswith("he")
+    )
+    from tests.utils import _rows_of
+
+    assert list(_rows_of(res).values()) == [("HELLO", 5, True)]
+
+
+def test_concat_same_universe_raises_or_works():
+    t1 = T(
+        """
+        id | a
+        1  | 1
+        """
+    )
+    t2 = T(
+        """
+        id | a
+        1  | 2
+        """
+    )
+    res = t1.concat_reindex(t2)
+    from tests.utils import _rows_of
+
+    assert sorted(_rows_of(res).values()) == [(1,), (2,)]

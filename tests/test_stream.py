@@ -1,0 +1,115 @@
+"""Streaming semantics: update streams, retractions, epoch consistency."""
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality_wo_index, stream_rows
+
+
+def test_stream_markdown_final_state():
+    t = T(
+        """
+        id | v | __time__ | __diff__
+        1  | 1 | 2        | 1
+        2  | 5 | 2        | 1
+        1  | 1 | 4        | -1
+        1  | 7 | 4        | 1
+        """
+    )
+    expected = T(
+        """
+        id | v
+        1  | 7
+        2  | 5
+        """
+    )
+    from tests.utils import assert_table_equality
+
+    assert_table_equality(t, expected)
+
+
+def test_stream_groupby_updates():
+    t = T(
+        """
+        id | g | v | __time__ | __diff__
+        1  | a | 1 | 2        | 1
+        2  | a | 2 | 4        | 1
+        3  | b | 9 | 4        | 1
+        """
+    )
+    res = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+    stream = stream_rows(res)
+    # epoch 1: (a,1)+1 ; epoch 2: (a,1)-1, (a,3)+1, (b,9)+1
+    diffs = [(vals, diff) for _, vals, _, diff in stream]
+    assert (("a", 1), 1) in diffs
+    assert (("a", 1), -1) in diffs
+    assert (("a", 3), 1) in diffs
+    assert (("b", 9), 1) in diffs
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | s
+            a | 3
+            b | 9
+            """
+        ),
+    )
+
+
+def test_stream_join_incremental():
+    left = T(
+        """
+        id | k | a | __time__ | __diff__
+        1  | x | 1 | 2        | 1
+        2  | y | 2 | 4        | 1
+        """
+    )
+    right = T(
+        """
+        id | k | b  | __time__ | __diff__
+        7  | x | 10 | 2        | 1
+        8  | y | 20 | 6        | 1
+        """
+    )
+    res = left.join(right, left.k == right.k).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            a | b
+            1 | 10
+            2 | 20
+            """
+        ),
+    )
+
+
+def test_stream_retraction_in_filter():
+    t = T(
+        """
+        id | v | __time__ | __diff__
+        1  | 10 | 2       | 1
+        1  | 10 | 4       | -1
+        """
+    )
+    res = t.filter(t.v > 5)
+    stream = stream_rows(res)
+    assert len(stream) == 2
+    assert stream[0][3] == 1 and stream[1][3] == -1
+    from tests.utils import _rows_of
+
+    assert _rows_of(res) == {}
+
+
+def test_deduplicate_streaming():
+    t = T(
+        """
+        id | v | __time__ | __diff__
+        1  | 3 | 2        | 1
+        2  | 1 | 4        | 1
+        3  | 5 | 6        | 1
+        """
+    )
+    res = t.deduplicate(value=pw.this.v, acceptor=lambda new, old: old is None or new > old)
+    stream = stream_rows(res)
+    vals = [(v[0], d) for _, v, _, d in stream]
+    assert vals == [(3, 1), (3, -1), (5, 1)]

@@ -1,0 +1,72 @@
+"""Test helpers (reference ``python/pathway/tests/utils.py:470-560``):
+``assert_table_equality`` and friends execute both tables in one run and
+diff the captured final states / update streams."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.debug import _run_capture, table_from_markdown
+from pathway_tpu.engine.stream import hashable_row
+
+T = table_from_markdown
+
+
+def _rows_of(table: pw.Table) -> dict:
+    (rows, _), = _run_capture(table)
+    return rows
+
+
+def run_tables(*tables: pw.Table) -> list[tuple[dict, list]]:
+    return _run_capture(*tables)
+
+
+def assert_table_equality(actual: pw.Table, expected: pw.Table) -> None:
+    (arows, _), (erows, _) = _run_capture(actual, expected)
+    assert set(arows.keys()) == set(erows.keys()), (
+        f"key sets differ:\nactual: {sorted(arows.items(), key=repr)}\n"
+        f"expected: {sorted(erows.items(), key=repr)}"
+    )
+    for k in arows:
+        assert hashable_row(arows[k]) == hashable_row(erows[k]), (
+            f"row {k!r} differs: actual {arows[k]!r} != expected {erows[k]!r}"
+        )
+
+
+def assert_table_equality_wo_index(actual: pw.Table, expected: pw.Table) -> None:
+    from collections import Counter
+
+    (arows, _), (erows, _) = _run_capture(actual, expected)
+    ac = Counter(hashable_row(v) for v in arows.values())
+    ec = Counter(hashable_row(v) for v in erows.values())
+    assert ac == ec, f"multisets differ:\nactual:   {sorted(ac.items(), key=repr)}\nexpected: {sorted(ec.items(), key=repr)}"
+
+
+assert_table_equality_wo_types = assert_table_equality
+assert_table_equality_wo_index_types = assert_table_equality_wo_index
+
+
+def assert_stream_equality(actual: pw.Table, expected: pw.Table) -> None:
+    """Compare full update streams grouped by time (reference
+    ``assert_stream_equality``)."""
+    from collections import Counter, defaultdict
+
+    (_, astream), (_, estream) = _run_capture(actual, expected)
+
+    def by_time(stream: list) -> dict[int, Counter]:
+        out: dict[int, Counter] = defaultdict(Counter)
+        for key, vals, time, diff in stream:
+            out[time][(key, hashable_row(vals), diff)] += 1
+        return dict(out)
+
+    a, e = by_time(astream), by_time(estream)
+    a_times, e_times = sorted(a), sorted(e)
+    assert len(a_times) == len(e_times), f"epoch counts differ: {a_times} vs {e_times}"
+    for at, et in zip(a_times, e_times):
+        assert a[at] == e[et], f"updates at epoch {at}/{et} differ:\n{a[at]}\nvs\n{e[et]}"
+
+
+def stream_rows(table: pw.Table) -> list[tuple[Any, tuple, int, int]]:
+    (_, stream), = _run_capture(table)
+    return stream
