@@ -1,0 +1,185 @@
+"""Connector framework: reader subjects feeding the engine, writer sinks.
+
+Capability parity with the reference connector layer
+(``src/connectors/mod.rs`` ``Connector::run``, ``data_storage.rs`` readers,
+``data_format.rs`` parsers/formatters): a reader thread parses events into
+keyed rows and commits epochs; a writer subscribes to a table's update
+stream and formats rows out.  The engine side is
+:class:`pathway_tpu.engine.graph.InputNode` (+ scheduler event queue).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time as _time
+from typing import Any, Callable, Iterable
+
+from pathway_tpu.engine import graph as eg
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+_autogen_counter = itertools.count()
+
+
+class RowSource:
+    """Engine-facing subject: ``run(events)`` called on a reader thread with
+    an event sink (add/remove/commit/close)."""
+
+    def run(self, events: Any) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+def key_for_row(
+    values: dict[str, Any],
+    pk_columns: list[str] | None,
+    seq: int | None = None,
+    source_tag: str = "",
+) -> K.Pointer:
+    """Row key: hash of primary-key values when declared, else sequential
+    (reference keys from pk columns or connector offsets)."""
+    if pk_columns:
+        return K.ref_scalar(*[values[c] for c in pk_columns])
+    return K.ref_scalar("__autogen__", source_tag, seq if seq is not None else next(_autogen_counter))
+
+
+def coerce_row(values: dict[str, Any], schema: sch.SchemaMetaclass) -> tuple:
+    out = []
+    for name, col in schema.__columns__.items():
+        v = values.get(name)
+        if v is None and col.has_default:
+            v = col.default_value
+        out.append(dt.coerce(v, col.dtype))
+    return tuple(out)
+
+
+def input_table(
+    subject: RowSource | None,
+    schema: sch.SchemaMetaclass,
+    *,
+    static_rows: Iterable[tuple[K.Pointer, tuple]] = (),
+    name: str = "connector",
+    upsert: bool = False,
+) -> Table:
+    cols = schema.column_names()
+    node = eg.InputNode(
+        G.engine_graph,
+        n_cols=len(cols),
+        static_rows=static_rows,
+        subject=subject,
+        name=name,
+        upsert=upsert,
+    )
+    dtypes = {c: schema.__columns__[c].dtype for c in cols}
+    return Table(node, cols, dtypes, name=name)
+
+
+class DictSource(RowSource):
+    """Reader emitting parsed dict rows via a user-supplied generator; commits
+    an epoch per ``commit_every`` rows or ``commit_interval`` seconds."""
+
+    def __init__(
+        self,
+        row_iter: Callable[[], Iterable[dict[str, Any] | tuple[str, dict[str, Any]]]],
+        schema: sch.SchemaMetaclass,
+        *,
+        commit_every: int | None = None,
+        commit_interval: float | None = None,
+        tag: str = "",
+    ):
+        self.row_iter = row_iter
+        self.schema = schema
+        self.commit_every = commit_every
+        self.commit_interval = commit_interval
+        self.tag = tag
+
+    def run(self, events: Any) -> None:
+        pk = self.schema.primary_key_columns()
+        n = 0
+        last_commit = _time.monotonic()
+        for item in self.row_iter():
+            if getattr(events, "stopped", False):
+                break
+            if isinstance(item, tuple) and len(item) == 2 and item[0] in ("add", "remove"):
+                op, values = item
+            else:
+                op, values = "add", item
+            key = key_for_row(values, pk, seq=None, source_tag=self.tag)
+            row = coerce_row(values, self.schema)
+            if op == "add":
+                events.add(key, row)
+            else:
+                events.remove(key, row)
+            n += 1
+            now = _time.monotonic()
+            if (self.commit_every and n % self.commit_every == 0) or (
+                self.commit_interval and now - last_commit >= self.commit_interval
+            ):
+                events.commit()
+                last_commit = now
+        events.commit()
+
+
+# ---------------------------------------------------------------------------
+# Writers
+
+
+class Writer:
+    """Formats and persists one row update (reference ``trait Writer``,
+    ``src/connectors/data_storage.rs:619``)."""
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def attach_writer(table: Table, writer: Writer, *, name: str = "output") -> None:
+    cols = table._column_names
+
+    def on_change(key: K.Pointer, values: tuple, time: int, diff: int) -> None:
+        row = dict(zip(cols, values))
+        row["id"] = key
+        writer.write(row, time, diff)
+
+    def on_time_end(time: int) -> None:
+        writer.flush()
+
+    def on_end() -> None:
+        writer.flush()
+        writer.close()
+
+    eg.OutputNode(G.engine_graph, table._node, on_change, on_time_end, on_end, name=name)
+
+
+def fmt_value(v: Any) -> Any:
+    import datetime
+
+    import numpy as np
+
+    from pathway_tpu.internals.api import ERROR
+    from pathway_tpu.internals.json import Json
+
+    if isinstance(v, K.Pointer):
+        return repr(v)
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, (datetime.datetime, datetime.timedelta)):
+        return str(v)
+    if v is ERROR:
+        return "Error"
+    if isinstance(v, tuple):
+        return [fmt_value(x) for x in v]
+    return v

@@ -1,0 +1,48 @@
+"""Helper for service-backed connectors whose client libraries are not in
+this environment: expose the reference API shape, fail with a clear message
+at call time (not import time)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+class MissingDependency(ImportError):
+    pass
+
+
+def require(*candidates: str) -> Any:
+    """Import the first available client module or raise MissingDependency."""
+    import importlib
+
+    errors = []
+    for name in candidates:
+        try:
+            return importlib.import_module(name)
+        except ImportError as e:
+            errors.append(str(e))
+    raise MissingDependency(
+        f"none of the client libraries {candidates} are installed in this "
+        "environment; this connector keeps the reference API surface and "
+        "activates when a client is available"
+    )
+
+
+def gated_reader(connector: str, *deps: str) -> Callable:
+    def read(*args: Any, **kwargs: Any) -> Any:
+        require(*deps)
+        raise NotImplementedError(
+            f"pw.io.{connector}.read: client available but integration not wired"
+        )
+
+    return read
+
+
+def gated_writer(connector: str, *deps: str) -> Callable:
+    def write(*args: Any, **kwargs: Any) -> Any:
+        require(*deps)
+        raise NotImplementedError(
+            f"pw.io.{connector}.write: client available but integration not wired"
+        )
+
+    return write

@@ -1,0 +1,16 @@
+"""``pw.io.airbyte`` — Airbyte serverless source (reference python/pathway/io/airbyte + vendored airbyte_serverless).
+
+API-surface parity module: the row/format plumbing routes through the shared
+connector framework; the transport activates when the client library is
+available (external services are unreachable in this build environment).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("airbyte", "airbyte_serverless")
+
+__all__ = ["read"]

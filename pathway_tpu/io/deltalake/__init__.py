@@ -1,0 +1,17 @@
+"""``pw.io.deltalake`` — Delta Lake connector (reference python/pathway/io/deltalake; reader src/connectors/data_storage.rs:1924, writer :1621).
+
+API-surface parity module: the row/format plumbing routes through the shared
+connector framework; the transport activates when the client library is
+available (external services are unreachable in this build environment).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("deltalake", "deltalake")
+write = gated_writer("deltalake", "deltalake")
+
+__all__ = ["read", "write"]

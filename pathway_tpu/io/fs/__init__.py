@@ -1,0 +1,206 @@
+"""``pw.io.fs`` — filesystem connector: single files or directories, static
+or watched-streaming (reference ``python/pathway/io/fs``; engine POSIX-like
+scanner ``src/connectors/posix_like.rs``, ``scanner/filesystem.rs``)."""
+
+from __future__ import annotations
+
+import os
+import time as _time
+from typing import Any, Callable
+
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.keys import ref_scalar
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import (
+    RowSource,
+    Writer,
+    attach_writer,
+    coerce_row,
+    fmt_value,
+    input_table,
+)
+
+__all__ = ["read", "write"]
+
+
+def _list_files(path: str) -> list[str]:
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                out.append(os.path.join(root, f))
+        return sorted(out)
+    import glob
+
+    if any(ch in path for ch in "*?["):
+        return sorted(glob.glob(path))
+    return [path] if os.path.exists(path) else []
+
+
+class _FilesSource(RowSource):
+    """Reads lines of files under a path; in streaming mode polls for new
+    files and appended lines (reference filesystem scanner + dir watching)."""
+
+    def __init__(
+        self,
+        path: str,
+        schema: sch.SchemaMetaclass,
+        *,
+        parse_line: Callable[[str], dict | None] | None = None,
+        parser_factory: Callable[[str], Callable[[str], dict | None]] | None = None,
+        mode: str = "streaming",
+        poll_interval: float = 0.2,
+        with_metadata: bool = False,
+        tag: str = "fs",
+    ):
+        self.path = path
+        self.schema = schema
+        # parser_factory(fp) -> line parser with per-file state (CSV headers);
+        # plain parse_line is wrapped as a stateless factory
+        if parser_factory is None:
+            assert parse_line is not None
+            parser_factory = lambda fp, p=parse_line: p
+        self.parser_factory = parser_factory
+        self.mode = mode
+        self.poll_interval = poll_interval
+        self.with_metadata = with_metadata
+        self.tag = tag
+
+    def _emit_file(
+        self, events: Any, fp: str, start_offset: int, seq_start: int, parser: Callable
+    ) -> tuple[int, int]:
+        pk = self.schema.primary_key_columns()
+        seq = seq_start
+        # binary mode: byte-accurate offsets (text-mode tell() is unusable
+        # inside line iteration), manual splitting on b"\n"
+        with open(fp, "rb") as f:
+            f.seek(start_offset)
+            offset = start_offset
+            while True:
+                raw = f.readline()
+                if not raw:
+                    break
+                if not raw.endswith(b"\n") and self.mode != "static":
+                    # partial trailing line (writer mid-append): retry later
+                    break
+                offset += len(raw)
+                try:
+                    values = parser(raw.decode(errors="replace"))
+                except Exception:
+                    values = None  # unparseable line: skip, keep the stream alive
+                if not isinstance(values, dict):
+                    continue
+                if self.with_metadata:
+                    values["_metadata"] = {
+                        "path": fp,
+                        "modified_at": int(os.path.getmtime(fp)),
+                    }
+                if pk:
+                    key = ref_scalar(*[values[c] for c in pk])
+                else:
+                    seq += 1
+                    key = ref_scalar("__fs__", self.tag, fp, seq)
+                events.add(key, coerce_row(values, self.schema))
+            return offset, seq
+
+    def run(self, events: Any) -> None:
+        offsets: dict[str, int] = {}
+        seqs: dict[str, int] = {}
+        parsers: dict[str, Callable] = {}
+        while True:
+            emitted = False
+            for fp in _list_files(self.path):
+                start = offsets.get(fp, 0)
+                try:
+                    size = os.path.getsize(fp)
+                except OSError:
+                    continue
+                if size > start:
+                    if fp not in parsers:
+                        parsers[fp] = self.parser_factory(fp)
+                    offsets[fp], seqs[fp] = self._emit_file(
+                        events, fp, start, seqs.get(fp, 0), parsers[fp]
+                    )
+                    emitted = True
+            if emitted:
+                events.commit()
+            if self.mode == "static":
+                return
+            if events.stopped:
+                return
+            _time.sleep(self.poll_interval)
+
+
+def read(
+    path: str | os.PathLike,
+    *,
+    format: str = "plaintext",
+    schema: sch.SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    csv_settings: Any = None,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "fs",
+    **kwargs: Any,
+) -> Table:
+    if format in ("plaintext", "plaintext_by_file", "binary"):
+        if schema is None:
+            schema = sch.schema_from_types(data=str)
+
+        def parse_plain(line: str) -> dict | None:
+            line = line.rstrip("\n")
+            return {"data": line} if line else None
+
+        src = _FilesSource(
+            str(path), schema, parse_line=parse_plain, mode=mode,
+            with_metadata=with_metadata, tag=f"fs:{path}",
+        )
+        return input_table(src, schema, name=name)
+    if format == "json" or format == "jsonlines":
+        from pathway_tpu.io import jsonlines
+
+        return jsonlines.read(
+            path, schema=schema, mode=mode, name=name,
+            with_metadata=with_metadata, **kwargs
+        )
+    if format == "csv":
+        from pathway_tpu.io import csv as csv_io
+
+        return csv_io.read(
+            path, schema=schema, mode=mode, name=name,
+            csv_settings=csv_settings, with_metadata=with_metadata, **kwargs
+        )
+    raise ValueError(f"unsupported fs format {format!r}")
+
+
+class _PlainWriter(Writer):
+    def __init__(self, path: str):
+        self._f = open(path, "w")
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        vals = {k: fmt_value(v) for k, v in row.items() if k != "id"}
+        import json
+
+        vals["time"] = time
+        vals["diff"] = diff
+        self._f.write(json.dumps(vals) + "\n")
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def write(table: Table, filename: str | os.PathLike, format: str = "json", **kwargs: Any) -> None:
+    if format in ("json", "jsonlines"):
+        from pathway_tpu.io import jsonlines
+
+        jsonlines.write(table, filename)
+        return
+    if format == "csv":
+        from pathway_tpu.io import csv as csv_io
+
+        csv_io.write(table, filename)
+        return
+    attach_writer(table, _PlainWriter(str(filename)))

@@ -1,0 +1,234 @@
+"""``pw.io.http`` — REST ingress/egress.
+
+Capability parity with reference ``python/pathway/io/http/_server.py``:
+``rest_connector(...) -> (Table, response_writer)`` (``:624``),
+``PathwayWebserver`` (aiohttp + OpenAPI docs, ``:329``),
+``RestServerSubject`` (``:490``).  Each HTTP request becomes a row; the
+response is resolved when the paired response table produces the row's
+result (future-per-key, exactly the reference's mechanism).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from typing import Any, Callable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import RowSource, coerce_row, fmt_value, input_table
+from pathway_tpu.io._subscribe import subscribe
+
+__all__ = ["rest_connector", "PathwayWebserver"]
+
+logger = logging.getLogger("pathway_tpu.http")
+
+
+class PathwayWebserver:
+    """One aiohttp server shared by any number of routes (reference
+    ``PathwayWebserver``).  Runs on its own thread + event loop."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8080, with_cors: bool = False):
+        self.host = host
+        self.port = port
+        self.with_cors = with_cors
+        self._routes: dict[tuple[str, str], Callable] = {}
+        self._openapi_paths: dict[str, Any] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    def register(self, route: str, methods: tuple[str, ...], handler: Callable, doc: Any = None) -> None:
+        for m in methods:
+            self._routes[(m.upper(), route)] = handler
+        if doc is not None:
+            self._openapi_paths[route] = doc
+
+    def openapi_description_json(self) -> dict:
+        return {
+            "openapi": "3.0.3",
+            "info": {"title": "pathway_tpu app", "version": "1.0"},
+            "paths": self._openapi_paths,
+        }
+
+    def _ensure_started(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(target=self._serve, daemon=True)
+            self._thread.start()
+        self._started.wait(timeout=10)
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        app = web.Application()
+
+        async def dispatch(request: "web.Request") -> "web.Response":
+            handler = self._routes.get((request.method, request.path))
+            if handler is None:
+                return web.json_response({"error": "not found"}, status=404)
+            try:
+                payload: dict[str, Any] = {}
+                if request.can_read_body:
+                    text = await request.text()
+                    if text:
+                        payload = json.loads(text)
+                payload.update(request.query)
+                result = await handler(payload, request)
+                if isinstance(result, web.Response):
+                    return result
+                return web.json_response(result, dumps=lambda o: json.dumps(o, default=str))
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("handler failed")
+                return web.json_response({"error": repr(e)}, status=500)
+
+        async def docs(_request: "web.Request") -> "web.Response":
+            return web.json_response(self.openapi_description_json())
+
+        app.router.add_route("*", "/_schema", docs)
+        app.router.add_route("*", "/{tail:.*}", dispatch)
+
+        async def start() -> None:
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self._started.set()
+
+        loop.run_until_complete(start())
+        loop.run_forever()
+
+
+class RestServerSubject(RowSource):
+    """Bridges HTTP requests into the engine stream (reference
+    ``RestServerSubject`` ``io/http/_server.py:490``)."""
+
+    def __init__(
+        self,
+        webserver: PathwayWebserver,
+        route: str,
+        methods: tuple[str, ...],
+        schema: sch.SchemaMetaclass,
+        delete_completed_queries: bool,
+        request_validator: Callable | None = None,
+    ):
+        self.webserver = webserver
+        self.route = route
+        self.methods = methods
+        self.schema = schema
+        self.delete_completed_queries = delete_completed_queries
+        self.request_validator = request_validator
+        self.futures: dict[K.Pointer, asyncio.Future] = {}
+        self._seq = 0
+        self._events: Any = None
+        self._closed = threading.Event()
+
+    def run(self, events: Any) -> None:
+        self._events = events
+        doc = {
+            "post": {
+                "requestBody": {
+                    "content": {
+                        "application/json": {
+                            "schema": {
+                                "type": "object",
+                                "properties": {
+                                    n: {"type": "string"}
+                                    for n in self.schema.column_names()
+                                },
+                            }
+                        }
+                    }
+                },
+                "responses": {"200": {"description": "result"}},
+            }
+        }
+        self.webserver.register(self.route, self.methods, self._handle, doc)
+        self.webserver._ensure_started()
+        # REST source stays open for the lifetime of the run (or until the
+        # scheduler shuts down)
+        while not self._closed.is_set() and not events.stopped:
+            self._closed.wait(timeout=0.25)
+
+    async def _handle(self, payload: dict[str, Any], request: Any) -> Any:
+        if self.request_validator is not None:
+            maybe_error = self.request_validator(payload)
+            if maybe_error is not None:
+                raise ValueError(str(maybe_error))
+        self._seq += 1
+        key = K.ref_scalar("__rest__", id(self), self._seq)
+        row = coerce_row(payload, self.schema)
+        loop = asyncio.get_running_loop()
+        future: asyncio.Future = loop.create_future()
+        self.futures[key] = future
+        self._events.add(key, row)
+        self._events.commit()
+        try:
+            result = await asyncio.wait_for(future, timeout=120)
+        finally:
+            self.futures.pop(key, None)
+            if self.delete_completed_queries:
+                self._events.remove(key, row)
+                self._events.commit()
+        return result
+
+    def resolve(self, key: K.Pointer, value: Any) -> None:
+        future = self.futures.get(key)
+        if future is not None and not future.done():
+            loop = future.get_loop()
+            loop.call_soon_threadsafe(
+                lambda: None if future.done() else future.set_result(value)
+            )
+
+    def stop(self) -> None:
+        self._closed.set()
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    methods: tuple[str, ...] = ("POST",),
+    schema: sch.SchemaMetaclass | None = None,
+    autocommit_duration_ms: int | None = 50,
+    keep_queries: bool | None = None,
+    delete_completed_queries: bool = False,
+    request_validator: Callable | None = None,
+    documentation: Any = None,
+) -> tuple[Table, Callable[[Table], None]]:
+    """Expose an HTTP endpoint as an input table; returns the table and a
+    ``response_writer(responses)`` that resolves each request's HTTP response
+    from the row in ``responses`` with the same key (column ``result``)."""
+    if schema is None:
+        schema = sch.schema_from_types(query=str)
+    if webserver is None:
+        webserver = PathwayWebserver(host or "0.0.0.0", port or 8080)
+    subject = RestServerSubject(
+        webserver, route, methods, schema, delete_completed_queries, request_validator
+    )
+    table = input_table(subject, schema, name=f"rest:{route}")
+
+    def response_writer(responses: Table) -> None:
+        result_col = "result" if "result" in responses._column_names else responses._column_names[-1]
+
+        def on_change(key: K.Pointer, row: dict, time: int, is_addition: bool) -> None:
+            if not is_addition:
+                return
+            subject.resolve(key, fmt_value(row[result_col]))
+
+        subscribe(responses, on_change=on_change, name="rest_response")
+
+    return table, response_writer

@@ -1,0 +1,80 @@
+"""``pw.io.jsonlines`` — JSON Lines file connector (reference
+``python/pathway/io/jsonlines``; engine parser ``JsonLinesParser``
+``src/connectors/data_format.rs:1439``)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import Writer, attach_writer, fmt_value, input_table
+from pathway_tpu.io.fs import _FilesSource, _list_files
+
+__all__ = ["read", "write"]
+
+
+def read(
+    path: str | os.PathLike,
+    *,
+    schema: sch.SchemaMetaclass | None = None,
+    mode: str = "streaming",
+    json_field_paths: dict[str, str] | None = None,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    name: str = "jsonlines",
+    **kwargs: Any,
+) -> Table:
+    if schema is None:
+        schema = sch.schema_from_types(data=dict)
+
+    def parse_line(line: str) -> dict[str, Any] | None:
+        line = line.strip()
+        if not line:
+            return None
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            return None
+        if not isinstance(obj, dict):
+            return None  # valid JSON but not an object: skip
+        if json_field_paths:
+            for col, jpath in json_field_paths.items():
+                cur: Any = obj
+                for part in jpath.strip("/").split("/"):
+                    if isinstance(cur, dict):
+                        cur = cur.get(part)
+                    else:
+                        cur = None
+                        break
+                obj[col] = cur
+        return obj
+
+    source = _FilesSource(
+        str(path), schema, parse_line=parse_line, mode=mode,
+        with_metadata=with_metadata, tag=f"jsonlines:{path}",
+    )
+    return input_table(source, schema, name=name)
+
+
+class _JsonLinesWriter(Writer):
+    def __init__(self, path: str):
+        self._f = open(path, "w")
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        out = {k: fmt_value(v) for k, v in row.items() if k != "id"}
+        out["time"] = time
+        out["diff"] = diff
+        self._f.write(json.dumps(out) + "\n")
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def write(table: Table, filename: str | os.PathLike, *, name: str = "jsonlines_out", **kwargs: Any) -> None:
+    attach_writer(table, _JsonLinesWriter(str(filename)), name=name)

@@ -1,0 +1,16 @@
+"""``pw.io.logstash`` — Logstash sink (reference python/pathway/io/logstash).
+
+API-surface parity module: the row/format plumbing routes through the shared
+connector framework; the transport activates when the client library is
+available (external services are unreachable in this build environment).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+write = gated_writer("logstash", "aiohttp")
+
+__all__ = ["write"]

@@ -1,0 +1,17 @@
+"""``pw.io.nats`` — NATS connector (reference python/pathway/io/nats; reader src/connectors/data_storage.rs:2271, writer :2345).
+
+API-surface parity module: the row/format plumbing routes through the shared
+connector framework; the transport activates when the client library is
+available (external services are unreachable in this build environment).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("nats", "nats")
+write = gated_writer("nats", "nats")
+
+__all__ = ["read", "write"]

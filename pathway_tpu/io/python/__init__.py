@@ -1,0 +1,106 @@
+"""``pw.io.python`` — custom Python connectors.
+
+Capability parity with reference ``python/pathway/io/python/__init__.py``
+(``ConnectorSubject`` ``:49-308``): subclass :class:`ConnectorSubject`,
+override ``run()``, push rows with ``next``/``next_json``/``next_str``/
+``next_bytes``, delete with ``_remove``, cut epochs with ``commit()``.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import threading
+from typing import Any
+
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import RowSource, coerce_row, input_table, key_for_row
+
+__all__ = ["ConnectorSubject", "read"]
+
+
+class ConnectorSubject:
+    """Base class for custom streaming sources."""
+
+    def __init__(self, datasource_name: str = "python") -> None:
+        self._events: Any = None
+        self._schema: sch.SchemaMetaclass | None = None
+        self._seq = 0
+        self._name = datasource_name
+        self._deletions_enabled = True
+
+    # -- user API -----------------------------------------------------------
+    def run(self) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def next(self, **kwargs: Any) -> None:
+        self._add_values(kwargs)
+
+    def next_json(self, message: dict | str | bytes) -> None:
+        if isinstance(message, (str, bytes)):
+            message = _json.loads(message)
+        self._add_values(dict(message))
+
+    def next_str(self, message: str) -> None:
+        self._add_values({"data": message})
+
+    def next_bytes(self, message: bytes) -> None:
+        self._add_values({"data": message})
+
+    def commit(self) -> None:
+        if self._events is not None:
+            self._events.commit()
+
+    def close(self) -> None:
+        pass
+
+    def on_stop(self) -> None:
+        pass
+
+    # -- plumbing -----------------------------------------------------------
+    def _add_values(self, values: dict[str, Any]) -> None:
+        assert self._schema is not None and self._events is not None
+        key = self._key_of(values)
+        self._events.add(key, coerce_row(values, self._schema))
+
+    def _remove(self, values: dict[str, Any]) -> None:
+        assert self._schema is not None and self._events is not None
+        key = self._key_of(values)
+        self._events.remove(key, coerce_row(values, self._schema))
+
+    def _key_of(self, values: dict[str, Any]) -> K.Pointer:
+        pk = self._schema.primary_key_columns()  # type: ignore[union-attr]
+        if pk:
+            return K.ref_scalar(*[values[c] for c in pk])
+        self._seq += 1
+        return K.ref_scalar("__py_connector__", id(self), self._seq)
+
+
+class _SubjectAdapter(RowSource):
+    def __init__(self, subject: ConnectorSubject, schema: sch.SchemaMetaclass):
+        self.subject = subject
+        self.schema = schema
+
+    def run(self, events: Any) -> None:
+        self.subject._events = events
+        self.subject._schema = self.schema
+        try:
+            self.subject.run()
+        finally:
+            self.subject.on_stop()
+            self.subject.close()
+
+
+def read(
+    subject: ConnectorSubject,
+    *,
+    schema: sch.SchemaMetaclass,
+    autocommit_duration_ms: int | None = None,
+    name: str = "python",
+    **kwargs: Any,
+) -> Table:
+    """Read a stream produced by a :class:`ConnectorSubject`."""
+    adapter = _SubjectAdapter(subject, schema)
+    upsert = bool(schema.primary_key_columns())
+    return input_table(adapter, schema, name=name, upsert=upsert)

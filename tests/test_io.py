@@ -1,0 +1,261 @@
+"""Connector tests (modeled on reference test_io.py): jsonlines/csv/plaintext
+round-trips, python ConnectorSubject, subscribe, kafka mock broker, sqlite,
+REST connector."""
+
+import json
+import os
+import sqlite3
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, _rows_of, assert_table_equality_wo_index
+
+
+def test_jsonlines_read_static(tmp_path):
+    p = tmp_path / "in.jsonl"
+    p.write_text('{"a": 1, "b": "x"}\n{"a": 2, "b": "y"}\n')
+
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    t = pw.io.jsonlines.read(str(p), schema=S, mode="static")
+    assert sorted(_rows_of(t).values()) == [(1, "x"), (2, "y")]
+
+
+def test_jsonlines_write(tmp_path):
+    src = tmp_path / "in.jsonl"
+    out = tmp_path / "out.jsonl"
+    src.write_text('{"a": 1}\n{"a": 5}\n')
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.jsonlines.read(str(src), schema=S, mode="static")
+    res = t.select(b=pw.this.a * 2)
+    pw.io.jsonlines.write(res, str(out))
+    pw.run()
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert sorted(l["b"] for l in lines) == [2, 10]
+    assert all(l["diff"] == 1 for l in lines)
+
+
+def test_csv_roundtrip(tmp_path):
+    src = tmp_path / "in.csv"
+    out = tmp_path / "out.csv"
+    src.write_text("a,b\n1,x\n2,y\n")
+
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    t = pw.io.csv.read(str(src), schema=S, mode="static")
+    pw.io.csv.write(t, str(out))
+    pw.run()
+    body = out.read_text().splitlines()
+    assert body[0].startswith("a,b")
+    assert len(body) == 3
+
+
+def test_plaintext(tmp_path):
+    p = tmp_path / "doc.txt"
+    p.write_text("hello\nworld\n")
+    t = pw.io.plaintext.read(str(p), mode="static")
+    assert sorted(_rows_of(t).values()) == [("hello",), ("world",)]
+
+
+def test_python_connector_subject():
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(a=1)
+            self.next(a=2)
+            self.commit()
+            self.next(a=3)
+            self.commit()
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.python.read(Subject(), schema=S)
+    res = t.reduce(s=pw.reducers.sum(t.a))
+    assert list(_rows_of(res).values()) == [(6,)]
+
+
+def test_python_connector_upsert():
+    class Subject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="a", v=1)
+            self.commit()
+            self.next(k="a", v=5)  # overwrite by primary key
+            self.next(k="b", v=2)
+            self.commit()
+
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    t = pw.io.python.read(Subject(), schema=S)
+    assert sorted(_rows_of(t).values()) == [("a", 5), ("b", 2)]
+
+
+def test_subscribe_callbacks():
+    t = T(
+        """
+        id | v | __time__ | __diff__
+        1  | 5 | 2        | 1
+        1  | 5 | 4        | -1
+        """
+    )
+    seen = []
+    times = []
+    done = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_add: seen.append((row["v"], is_add)),
+        on_time_end=lambda time: times.append(time),
+        on_end=lambda: done.append(True),
+    )
+    pw.run(autocommit_duration_ms=5)
+    assert seen == [(5, True), (5, False)]
+    assert done == [True]
+    assert len(times) >= 2
+
+
+def test_kafka_mock_broker():
+    broker = pw.io.kafka.MockBroker.get("mock://test1")
+    for i in range(5):
+        broker.produce("topic", json.dumps({"v": i}).encode())
+    broker.close_topic("topic")
+
+    class S(pw.Schema):
+        v: int
+
+    t = pw.io.kafka.read(
+        {"bootstrap.servers": "mock://test1"}, topic="topic", schema=S, format="json"
+    )
+    res = t.reduce(s=pw.reducers.sum(t.v), c=pw.reducers.count())
+    assert list(_rows_of(res).values()) == [(10, 5)]
+
+
+def test_sqlite_static(tmp_path):
+    db = tmp_path / "test.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (k TEXT PRIMARY KEY, v INTEGER)")
+    conn.execute("INSERT INTO items VALUES ('a', 1), ('b', 2)")
+    conn.commit()
+    conn.close()
+
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        v: int
+
+    t = pw.io.sqlite.read(str(db), "items", S, mode="static")
+    assert sorted(_rows_of(t).values()) == [("a", 1), ("b", 2)]
+
+
+def test_demo_range_stream():
+    t = pw.demo.range_stream(nb_rows=5, input_rate=1000)
+    res = t.reduce(s=pw.reducers.sum(pw.this.value))
+    assert list(_rows_of(res).values()) == [(10.0,)]
+
+
+def test_fs_streaming_appends(tmp_path):
+    """Files appended mid-run are picked up (dir watching)."""
+    p = tmp_path / "stream.jsonl"
+    p.write_text('{"a": 1}\n')
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.jsonlines.read(str(tmp_path), schema=S, mode="streaming")
+    got = []
+    pw.io.subscribe(t, on_change=lambda k, row, time, add: got.append(row["a"]))
+
+    from pathway_tpu.engine.scheduler import Scheduler
+    from pathway_tpu.internals.parse_graph import G
+
+    sched = Scheduler(G.engine_graph, autocommit_ms=20)
+    run_t = threading.Thread(target=sched.run, daemon=True)
+    run_t.start()
+    deadline = time.monotonic() + 5
+    while 1 not in got and time.monotonic() < deadline:
+        time.sleep(0.02)
+    with open(p, "a") as f:
+        f.write('{"a": 2}\n')
+    while 2 not in got and time.monotonic() < deadline:
+        time.sleep(0.02)
+    sched.stop()
+    run_t.join(timeout=2)
+    assert got[:2] == [1, 2]
+
+
+def test_fs_partial_trailing_line(tmp_path):
+    """A file whose last line lacks a newline must not crash the reader; the
+    partial line is held back until completed (streaming) or read (static)."""
+    p = tmp_path / "partial.jsonl"
+    p.write_text('{"a": 1}\n{"a": 2}')  # no trailing newline
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.jsonlines.read(str(p), schema=S, mode="static")
+    assert sorted(_rows_of(t).values()) == [(1,), (2,)]
+
+
+def test_csv_multiple_files_headers(tmp_path):
+    (tmp_path / "f1.csv").write_text("a,b\n1,x\n")
+    (tmp_path / "f2.csv").write_text("a,b\n2,y\n")
+
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    t = pw.io.csv.read(str(tmp_path), schema=S, mode="static")
+    assert sorted(_rows_of(t).values()) == [(1, "x"), (2, "y")]
+
+
+def test_jsonlines_non_object_lines_skipped(tmp_path):
+    p = tmp_path / "odd.jsonl"
+    p.write_text('3\n[1,2]\n{"a": 7}\n')
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.jsonlines.read(str(p), schema=S, mode="static")
+    assert sorted(_rows_of(t).values()) == [(7,)]
+
+
+def test_kafka_dsv_format():
+    broker = pw.io.kafka.MockBroker.get("mock://dsv")
+    broker.produce("t", b"x;1")
+    broker.produce("t", b"y;2")
+    broker.close_topic("t")
+
+    class S(pw.Schema):
+        k: str
+        v: int
+
+    t = pw.io.kafka.read(
+        {"bootstrap.servers": "mock://dsv"}, topic="t", schema=S, format="dsv"
+    )
+    assert sorted(_rows_of(t).values()) == [("x", 1), ("y", 2)]
+
+
+def test_fs_csv_delimiter_passthrough(tmp_path):
+    (tmp_path / "f.csv").write_text("a;b\n1;x\n")
+
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    t = pw.io.fs.read(
+        str(tmp_path),
+        format="csv",
+        schema=S,
+        mode="static",
+        csv_settings=pw.io.csv.CsvParserSettings(delimiter=";"),
+    )
+    assert sorted(_rows_of(t).values()) == [(1, "x")]
