@@ -26,9 +26,20 @@ from pathway_tpu.internals.keys import Pointer
 class ConnectorEvents:
     """Callback bundle handed to a connector subject's reader thread."""
 
-    def __init__(self, q: "queue.Queue", node_id: int):
+    def __init__(
+        self,
+        q: "queue.Queue",
+        node_id: int,
+        stop_event: threading.Event | None = None,
+    ):
         self._q = q
         self._node_id = node_id
+        self._stop_event = stop_event
+
+    @property
+    def stopped(self) -> bool:
+        """True once the scheduler is shutting down; readers should return."""
+        return self._stop_event is not None and self._stop_event.is_set()
 
     def add(self, key: Pointer, values: tuple) -> None:
         self._q.put((self._node_id, "add", key, values))
@@ -112,7 +123,7 @@ class Scheduler:
         q: "queue.Queue" = queue.Queue()
         threads: list[threading.Thread] = []
         for node in live_inputs:
-            events = ConnectorEvents(q, node.id)
+            events = ConnectorEvents(q, node.id, self._stop)
             t = threading.Thread(
                 target=self._run_subject, args=(node, events), daemon=True
             )
