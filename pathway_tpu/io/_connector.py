@@ -102,7 +102,7 @@ class DictSource(RowSource):
         n = 0
         last_commit = _time.monotonic()
         for item in self.row_iter():
-            if getattr(events, "stopped", False):
+            if events.stopped:
                 break
             if isinstance(item, tuple) and len(item) == 2 and item[0] in ("add", "remove"):
                 op, values = item

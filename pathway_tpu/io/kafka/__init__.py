@@ -120,7 +120,7 @@ class _MockKafkaSource(RowSource):
         pk = self.schema.primary_key_columns()
         offset = 0
         seq = 0
-        while not getattr(events, "stopped", False):
+        while not events.stopped:
             msgs = self.broker.consume_from(self.topic, offset)
             for _key, raw in msgs:
                 values = _parse_message(raw, self.format, self.schema)
@@ -157,14 +157,25 @@ class _KafkaClientSource(RowSource):
         )
         pk = self.schema.primary_key_columns()
         seq = 0
-        for msg in consumer:
-            values = _parse_message(msg.value, self.format, self.schema)
-            if values is None:
-                continue
-            seq += 1
-            key = key_for_row(values, pk, seq=seq, source_tag=f"kafka:{self.topic}")
-            events.add(key, coerce_row(values, self.schema))
-            events.commit()
+        try:
+            # poll with a timeout (instead of blocking iteration) so scheduler
+            # shutdown is observed between batches
+            while not events.stopped:
+                batches = consumer.poll(timeout_ms=500)
+                for msgs in batches.values():
+                    for msg in msgs:
+                        values = _parse_message(msg.value, self.format, self.schema)
+                        if values is None:
+                            continue
+                        seq += 1
+                        key = key_for_row(
+                            values, pk, seq=seq, source_tag=f"kafka:{self.topic}"
+                        )
+                        events.add(key, coerce_row(values, self.schema))
+                if batches:
+                    events.commit()
+        finally:
+            consumer.close()
 
 
 def read(

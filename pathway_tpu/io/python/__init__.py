@@ -58,6 +58,12 @@ class ConnectorSubject:
     def on_stop(self) -> None:
         pass
 
+    @property
+    def stopped(self) -> bool:
+        """True once the scheduler is shutting down; long-running ``run()``
+        loops should poll this and return."""
+        return self._events is not None and self._events.stopped
+
     # -- plumbing -----------------------------------------------------------
     def _add_values(self, values: dict[str, Any]) -> None:
         assert self._schema is not None and self._events is not None

@@ -54,7 +54,7 @@ class _SqliteSource(RowSource):
             if self.mode == "static":
                 return
             last_version = conn.execute("PRAGMA data_version").fetchone()[0]
-            while not getattr(events, "stopped", False):
+            while not events.stopped:
                 _time.sleep(self.poll_interval)
                 version = conn.execute("PRAGMA data_version").fetchone()[0]
                 if version == last_version:

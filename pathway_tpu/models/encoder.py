@@ -1,0 +1,249 @@
+"""BERT-family text encoders and cross-encoders, TPU-first.
+
+Brand-new flax implementation of the model families the reference drives
+through torch SentenceTransformers (MiniLM, BGE, E5 —
+``xpacks/llm/embedders.py:270``) and torch CrossEncoder
+(``xpacks/llm/rerankers.py:186``).  Design for the MXU:
+
+- bf16 activations / f32 params (configurable), static shapes via
+  bucketed padding (see :mod:`pathway_tpu.ops.bucketing`);
+- post-LN BERT blocks expressed as einsum-shaped flax modules so XLA
+  fuses bias+gelu+residual into the matmuls;
+- tensor-parallel sharding RULES (:func:`encoder_param_specs`) mapping
+  each param to a ``PartitionSpec`` over a mesh "model" axis: attention
+  heads and MLP hidden dim are split, embeddings/LN replicated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.ops.pooling import cls_pool, masked_mean_pool
+
+__all__ = [
+    "EncoderConfig",
+    "TextEncoderModel",
+    "CrossEncoderModel",
+    "encoder_param_specs",
+    "MINILM_L6",
+    "BGE_SMALL",
+    "BGE_BASE",
+    "BGE_LARGE",
+    "E5_BASE",
+    "BGE_RERANKER_BASE",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    """Architecture hyperparameters (BERT-style post-LN encoder)."""
+
+    vocab_size: int = 30522
+    hidden: int = 384
+    layers: int = 6
+    heads: int = 12
+    mlp_dim: int = 1536
+    max_len: int = 512
+    type_vocab: int = 2
+    pool: str = "mean"  # mean | cls
+    normalize: bool = True  # L2-normalize sentence embedding
+    num_labels: int = 0  # >0 => cross-encoder classification head
+    dtype: Any = jnp.bfloat16  # activation dtype
+    param_dtype: Any = jnp.float32
+    ln_eps: float = 1e-12
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+# Presets mirroring the model families in the reference's xpack docs/tests.
+MINILM_L6 = EncoderConfig(hidden=384, layers=6, heads=12, mlp_dim=1536)
+BGE_SMALL = EncoderConfig(hidden=384, layers=12, heads=12, mlp_dim=1536, pool="cls")
+BGE_BASE = EncoderConfig(hidden=768, layers=12, heads=12, mlp_dim=3072, pool="cls")
+BGE_LARGE = EncoderConfig(hidden=1024, layers=24, heads=16, mlp_dim=4096, pool="cls")
+E5_BASE = EncoderConfig(hidden=768, layers=12, heads=12, mlp_dim=3072, pool="mean")
+BGE_RERANKER_BASE = dataclasses.replace(
+    BGE_BASE, num_labels=1, pool="cls", normalize=False
+)
+
+
+class SelfAttention(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(cfg.heads, cfg.head_dim),
+            axis=-1,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name=name,
+        )
+        q = dense("query")(x)  # [B, L, h, d]
+        k = dense("key")(x)
+        v = dense("value")(x)
+        scale = 1.0 / jnp.sqrt(jnp.float32(cfg.head_dim))
+        logits = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
+        bias = jnp.where(mask.astype(bool)[:, None, None, :], 0.0, -1e30)
+        probs = jax.nn.softmax(logits + bias, axis=-1).astype(cfg.dtype)
+        ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
+        out = nn.DenseGeneral(
+            features=cfg.hidden,
+            axis=(-2, -1),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="out",
+        )(ctx)
+        return out
+
+
+class EncoderBlock(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        a = SelfAttention(cfg, name="attention")(x, mask)
+        x = nn.LayerNorm(
+            epsilon=cfg.ln_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="attention_ln",
+        )(x + a)
+        h = nn.Dense(
+            cfg.mlp_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="mlp_up"
+        )(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(
+            cfg.hidden, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="mlp_down"
+        )(h)
+        return nn.LayerNorm(
+            epsilon=cfg.ln_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="mlp_ln",
+        )(x + h)
+
+
+class Embeddings(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids: jax.Array, type_ids: jax.Array | None) -> jax.Array:
+        cfg = self.cfg
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="word",
+        )(ids)
+        pos = nn.Embed(
+            cfg.max_len, cfg.hidden, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="position",
+        )(jnp.arange(ids.shape[1])[None, :])
+        emb = emb + pos
+        if cfg.type_vocab:
+            t = type_ids if type_ids is not None else jnp.zeros_like(ids)
+            emb = emb + nn.Embed(
+                cfg.type_vocab, cfg.hidden, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="type",
+            )(t)
+        return nn.LayerNorm(
+            epsilon=cfg.ln_eps, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="ln",
+        )(emb)
+
+
+class TextEncoderModel(nn.Module):
+    """Sentence encoder: token ids -> pooled (optionally normalized)
+    embedding [B, hidden]."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        ids: jax.Array,
+        mask: jax.Array,
+        type_ids: jax.Array | None = None,
+    ) -> jax.Array:
+        cfg = self.cfg
+        x = Embeddings(cfg, name="embeddings")(ids, type_ids)
+        for i in range(cfg.layers):
+            x = EncoderBlock(cfg, name=f"layer_{i}")(x, mask)
+        pooled = cls_pool(x) if cfg.pool == "cls" else masked_mean_pool(x, mask)
+        if cfg.normalize:
+            norm = jnp.sqrt(
+                jnp.sum(pooled.astype(jnp.float32) ** 2, axis=-1, keepdims=True)
+            )
+            pooled = (pooled.astype(jnp.float32) / jnp.maximum(norm, 1e-12))
+        return pooled.astype(jnp.float32)
+
+
+class CrossEncoderModel(nn.Module):
+    """(query, doc) pair scorer: encoder + classification head -> [B] or
+    [B, num_labels] logits (reference CrossEncoderReranker's model)."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        ids: jax.Array,
+        mask: jax.Array,
+        type_ids: jax.Array | None = None,
+    ) -> jax.Array:
+        cfg = self.cfg
+        x = Embeddings(cfg, name="embeddings")(ids, type_ids)
+        for i in range(cfg.layers):
+            x = EncoderBlock(cfg, name=f"layer_{i}")(x, mask)
+        cls = cls_pool(x)
+        h = nn.Dense(
+            cfg.hidden, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="pooler"
+        )(cls)
+        h = jnp.tanh(h)
+        logits = nn.Dense(
+            max(cfg.num_labels, 1), dtype=jnp.float32,
+            param_dtype=cfg.param_dtype, name="classifier",
+        )(h)
+        return logits[:, 0] if max(cfg.num_labels, 1) == 1 else logits
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel sharding rules
+
+
+def encoder_param_specs(params: Any, model_axis: str = "model") -> Any:
+    """PartitionSpec tree for encoder params: heads + MLP hidden split over
+    ``model_axis``, everything else replicated.
+
+    Works for both :class:`TextEncoderModel` and :class:`CrossEncoderModel`
+    (and the towers of :class:`DualEncoderModel`), because the rules key on
+    leaf path names, not tree structure.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf) -> Any:
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        joined = "/".join(str(n) for n in names)
+        nd = leaf.ndim
+        if "kernel" in joined:
+            if any(s in joined for s in ("query", "key", "value")):
+                # [hidden, heads, head_dim] -> split heads
+                return P(*([None] * (nd - 2)), model_axis, None)
+            if "attention/out" in joined or joined.endswith("out/kernel"):
+                # [heads, head_dim, hidden] -> split heads
+                return P(model_axis, *([None] * (nd - 1)))
+            if "mlp_up" in joined:
+                return P(*([None] * (nd - 1)), model_axis)
+            if "mlp_down" in joined:
+                return P(model_axis, *([None] * (nd - 1)))
+        if "bias" in joined:
+            if any(s in joined for s in ("query", "key", "value")):
+                return P(model_axis, *([None] * (nd - 1)))
+            if "mlp_up" in joined:
+                return P(*([None] * (nd - 1)), model_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

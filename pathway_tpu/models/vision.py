@@ -1,0 +1,120 @@
+"""SigLIP-class dual (image+text) encoder, TPU-first flax.
+
+Covers the reference's multimodal path (vision-LLM image parsing /
+SigLIP-style multimodal retrieval configs in BASELINE.md): a ViT image
+tower + text tower projected into a shared embedding space.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models.encoder import EncoderBlock, EncoderConfig, TextEncoderModel
+
+__all__ = ["VisionConfig", "VisionEncoderModel", "DualEncoderModel", "SIGLIP_BASE"]
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch: int = 16
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp_dim: int = 3072
+    embed_dim: int = 768  # shared space
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch) ** 2
+
+    def as_encoder_cfg(self) -> EncoderConfig:
+        return EncoderConfig(
+            hidden=self.hidden,
+            layers=self.layers,
+            heads=self.heads,
+            mlp_dim=self.mlp_dim,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+
+
+SIGLIP_BASE = VisionConfig()
+
+
+class VisionEncoderModel(nn.Module):
+    """ViT tower: images [B, H, W, 3] -> [B, embed_dim] (mean-pooled)."""
+
+    cfg: VisionConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        x = nn.Conv(
+            features=cfg.hidden,
+            kernel_size=(cfg.patch, cfg.patch),
+            strides=(cfg.patch, cfg.patch),
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        b = x.shape[0]
+        x = x.reshape(b, -1, cfg.hidden)  # [B, P, H]
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, cfg.n_patches, cfg.hidden),
+            cfg.param_dtype,
+        )
+        x = x + pos.astype(cfg.dtype)
+        mask = jnp.ones(x.shape[:2], dtype=jnp.int32)
+        ecfg = cfg.as_encoder_cfg()
+        for i in range(cfg.layers):
+            x = EncoderBlock(ecfg, name=f"layer_{i}")(x, mask)
+        pooled = jnp.mean(x.astype(jnp.float32), axis=1)
+        out = nn.Dense(
+            cfg.embed_dim, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            name="projection",
+        )(pooled)
+        norm = jnp.sqrt(jnp.sum(out**2, axis=-1, keepdims=True))
+        return out / jnp.maximum(norm, 1e-12)
+
+
+class DualEncoderModel(nn.Module):
+    """SigLIP-style contrastive pair: embed_image / embed_text entry points
+    plus a combined call returning the pairwise logit matrix."""
+
+    vision_cfg: VisionConfig
+    text_cfg: EncoderConfig
+
+    def setup(self) -> None:
+        self.vision = VisionEncoderModel(self.vision_cfg)
+        self.text = TextEncoderModel(
+            dataclasses.replace(self.text_cfg, normalize=True)
+        )
+        self.logit_scale = self.param(
+            "logit_scale", nn.initializers.constant(1.0), (), jnp.float32
+        )
+        self.logit_bias = self.param(
+            "logit_bias", nn.initializers.constant(0.0), (), jnp.float32
+        )
+
+    def embed_image(self, images: jax.Array) -> jax.Array:
+        return self.vision(images)
+
+    def embed_text(self, ids: jax.Array, mask: jax.Array) -> jax.Array:
+        return self.text(ids, mask)
+
+    def __call__(
+        self, images: jax.Array, ids: jax.Array, mask: jax.Array
+    ) -> jax.Array:
+        img = self.embed_image(images)
+        txt = self.embed_text(ids, mask)
+        return img @ txt.T * jnp.exp(self.logit_scale) + self.logit_bias

@@ -1,0 +1,32 @@
+"""TPU compute ops: the jitted numeric plane primitives.
+
+These are the building blocks the reference implements as Rust loops
+(e.g. brute-force KNN distance loops,
+``src/external_integration/brute_force_knn_integration.rs:22-120``) —
+re-designed as XLA-friendly batched array ops: matmul-based distances on
+the MXU, masked top-k, mask-aware pooling, and shape bucketing to bound
+recompilation under live streaming input.
+"""
+
+from pathway_tpu.ops.bucketing import bucket_size, pad_dim, pad_rows
+from pathway_tpu.ops.distances import (
+    cosine_scores,
+    dot_scores,
+    l2sq_distances,
+    normalize,
+)
+from pathway_tpu.ops.pooling import cls_pool, masked_mean_pool
+from pathway_tpu.ops.topk import masked_top_k
+
+__all__ = [
+    "bucket_size",
+    "pad_dim",
+    "pad_rows",
+    "cosine_scores",
+    "dot_scores",
+    "l2sq_distances",
+    "normalize",
+    "masked_mean_pool",
+    "cls_pool",
+    "masked_top_k",
+]

@@ -1,0 +1,45 @@
+"""Distance/similarity kernels: matmul-shaped so XLA maps them to the MXU.
+
+TPU re-design of the reference's scalar distance loops
+(``src/external_integration/brute_force_knn_integration.rs:40-76``):
+one ``[nq, d] @ [d, n]`` matmul computes every query-corpus pair at once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["normalize", "dot_scores", "cosine_scores", "l2sq_distances"]
+
+
+def normalize(x: jax.Array, eps: float = 1e-12) -> jax.Array:
+    """L2-normalize rows (f32 accumulation even for bf16 inputs)."""
+    norm = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2, axis=-1, keepdims=True))
+    return (x / jnp.maximum(norm, eps).astype(x.dtype)).astype(x.dtype)
+
+
+def dot_scores(queries: jax.Array, corpus: jax.Array) -> jax.Array:
+    """``[nq, d] x [n, d] -> [nq, n]`` inner-product scores (higher=closer)."""
+    return jax.lax.dot_general(
+        queries,
+        corpus,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def cosine_scores(queries: jax.Array, corpus: jax.Array) -> jax.Array:
+    """Cosine similarity, normalizing both sides."""
+    return dot_scores(normalize(queries), normalize(corpus))
+
+
+def l2sq_distances(queries: jax.Array, corpus: jax.Array) -> jax.Array:
+    """Squared L2 distance via the ||q||^2 - 2qc + ||c||^2 expansion
+    (keeps the O(nq*n*d) term on the MXU; lower=closer)."""
+    q32 = queries.astype(jnp.float32)
+    c32 = corpus.astype(jnp.float32)
+    qq = jnp.sum(q32 * q32, axis=-1, keepdims=True)  # [nq, 1]
+    cc = jnp.sum(c32 * c32, axis=-1)  # [n]
+    qc = dot_scores(queries, corpus)  # [nq, n]
+    return jnp.maximum(qq - 2.0 * qc + cc[None, :], 0.0)

@@ -1,0 +1,21 @@
+"""Mask-aware sequence pooling for sentence encoders."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["masked_mean_pool", "cls_pool"]
+
+
+def masked_mean_pool(hidden: jax.Array, mask: jax.Array) -> jax.Array:
+    """Mean over valid positions. hidden [B, L, H], mask [B, L] {0,1}."""
+    m = mask.astype(jnp.float32)[..., None]
+    summed = jnp.sum(hidden.astype(jnp.float32) * m, axis=1)
+    counts = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    return (summed / counts).astype(hidden.dtype)
+
+
+def cls_pool(hidden: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    """First-token ([CLS]) pooling."""
+    return hidden[:, 0, :]

@@ -1,0 +1,23 @@
+"""Distributed plane: device meshes, sharded indexes, batched executors.
+
+The reference scales by key-sharding rows over timely workers connected
+by TCP (``src/engine/dataflow.rs:1068-1072``, SURVEY.md §2.8).  The TPU
+build splits the two planes:
+
+- host plane: epoch-synchronous engine + connectors (see
+  :mod:`pathway_tpu.engine`), shardable across processes;
+- numeric plane: jit/shard_map programs over a ``jax.sharding.Mesh`` —
+  XLA collectives over ICI/DCN replace NCCL/MPI-style transports.
+"""
+
+from pathway_tpu.parallel.mesh import best_mesh, make_mesh, mesh_axis_size
+from pathway_tpu.parallel.executor import JittedEncoder
+from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex
+
+__all__ = [
+    "make_mesh",
+    "best_mesh",
+    "mesh_axis_size",
+    "JittedEncoder",
+    "ShardedKnnIndex",
+]

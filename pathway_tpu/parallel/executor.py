@@ -1,0 +1,143 @@
+"""Batched jitted model executor: the TPU replacement for per-row torch.
+
+The reference embeds/reranks one row at a time inside a torch UDF
+(``xpacks/llm/embedders.py:270-327``, ``rerankers.py:186-235``).  Here a
+whole epoch's rows are tokenized into one bucketed batch and pushed
+through a single jit-compiled flax program; with a mesh, the batch is
+data-parallel over ``"data"`` and the params tensor-parallel over
+``"model"`` (see :func:`pathway_tpu.models.encoder_param_specs`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from pathway_tpu.models.encoder import (
+    CrossEncoderModel,
+    EncoderConfig,
+    TextEncoderModel,
+    encoder_param_specs,
+)
+from pathway_tpu.models.tokenizer import Tokenizer, get_tokenizer
+from pathway_tpu.ops.bucketing import bucket_size
+
+__all__ = ["JittedEncoder"]
+
+
+class JittedEncoder:
+    """Holds (possibly sharded) params + compiled apply fns per shape bucket.
+
+    cross=False: ``encode(texts) -> [n, hidden] float32`` embeddings.
+    cross=True:  ``score_pairs(queries, docs) -> [n] float32`` logits.
+    """
+
+    def __init__(
+        self,
+        config: EncoderConfig,
+        *,
+        cross: bool = False,
+        tokenizer: Tokenizer | None = None,
+        model_name: str | None = None,
+        mesh: Mesh | None = None,
+        data_axis: str = "data",
+        model_axis: str = "model",
+        max_batch: int = 1024,
+        max_len: int | None = None,
+        seed: int = 0,
+        params: Any = None,
+    ):
+        self.config = config
+        self.cross = cross
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.max_batch = max_batch
+        self.max_len = max_len or config.max_len
+        self.tokenizer = tokenizer or get_tokenizer(model_name, config.vocab_size)
+        self.model = (CrossEncoderModel if cross else TextEncoderModel)(config)
+
+        if params is None:
+            rng = jax.random.PRNGKey(seed)
+            dummy = jnp.zeros((1, 8), jnp.int32)
+            params = self.model.init(rng, dummy, jnp.ones((1, 8), jnp.int32))
+        if mesh is not None and model_axis in mesh.shape:
+            specs = encoder_param_specs(params, model_axis)
+            shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+            params = jax.device_put(params, shardings)
+            self._in_batch_sharding = NamedSharding(mesh, P(data_axis, None))
+            self._out_sharding = NamedSharding(mesh, P())
+        elif mesh is not None:
+            params = jax.device_put(
+                params, jax.tree.map(lambda _: NamedSharding(mesh, P()), params)
+            )
+            self._in_batch_sharding = NamedSharding(mesh, P(data_axis, None))
+            self._out_sharding = NamedSharding(mesh, P())
+        else:
+            self._in_batch_sharding = None
+            self._out_sharding = None
+        self.params = params
+        self._apply = jax.jit(self.model.apply, out_shardings=self._out_sharding)
+        self._dp = 1 if mesh is None else mesh.shape.get(data_axis, 1)
+
+    # ------------------------------------------------------------------
+    def _pad_batch(self, ids: np.ndarray, mask: np.ndarray, tps: np.ndarray):
+        """Round the batch up so it divides the data-parallel degree."""
+        n = ids.shape[0]
+        b = bucket_size(n, min_bucket=max(8, self._dp))
+        b = ((b + self._dp - 1) // self._dp) * self._dp
+        if b > n:
+            pad = ((0, b - n), (0, 0))
+            ids = np.pad(ids, pad)
+            mask = np.pad(mask, pad)
+            tps = np.pad(tps, pad)
+        # padded rows must still be valid encoder input: one non-masked token
+        mask[n:, 0] = 1
+        return ids, mask, tps, n
+
+    def _run(self, ids: np.ndarray, mask: np.ndarray, tps: np.ndarray) -> np.ndarray:
+        ids, mask, tps, n = self._pad_batch(ids, mask, tps)
+        args = [jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(tps)]
+        if self._in_batch_sharding is not None:
+            args = [jax.device_put(a, self._in_batch_sharding) for a in args]
+        out = self._apply(self.params, *args)
+        return np.asarray(out)[:n]
+
+    def _chunks(self, texts: Sequence[str], pair: Sequence[str] | None):
+        for i in range(0, len(texts), self.max_batch):
+            sl = slice(i, i + self.max_batch)
+            yield texts[sl], None if pair is None else pair[sl]
+
+    # ------------------------------------------------------------------
+    def encode(self, texts: Sequence[str]) -> np.ndarray:
+        """Embed a list of texts -> [n, hidden] float32."""
+        if self.cross:
+            raise TypeError("cross-encoder executor: use score_pairs()")
+        if not texts:
+            return np.zeros((0, self.config.hidden), np.float32)
+        outs = []
+        for chunk, _ in self._chunks(list(texts), None):
+            ids, mask, tps = self.tokenizer.encode_batch(chunk, max_len=self.max_len)
+            outs.append(self._run(ids, mask, tps))
+        return np.concatenate(outs, axis=0)
+
+    def score_pairs(self, queries: Sequence[str], docs: Sequence[str]) -> np.ndarray:
+        """Cross-encoder scores for aligned (query, doc) pairs -> [n]."""
+        if not self.cross:
+            raise TypeError("bi-encoder executor: use encode()")
+        if len(queries) != len(docs):
+            raise ValueError("queries and docs must align")
+        if not queries:
+            return np.zeros((0,), np.float32)
+        outs = []
+        for q_chunk, d_chunk in self._chunks(list(queries), list(docs)):
+            ids, mask, tps = self.tokenizer.encode_batch(
+                q_chunk, pair=d_chunk, max_len=self.max_len
+            )
+            outs.append(self._run(ids, mask, tps))
+        return np.concatenate(outs, axis=0)

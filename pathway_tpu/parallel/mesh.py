@@ -1,0 +1,52 @@
+"""Device-mesh construction helpers.
+
+Conventions across the framework:
+
+- axis ``"data"``: batch / corpus sharding (DP + index shards);
+- axis ``"model"``: tensor parallelism inside encoders.
+
+A mesh is always optional — every numeric-plane component has a
+single-device fast path.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "best_mesh", "mesh_axis_size"]
+
+
+def make_mesh(
+    axes: dict[str, int] | None = None, devices: list | None = None
+) -> Mesh:
+    """Build a Mesh from {axis: size}; sizes must multiply to len(devices).
+    Default: 1-D ``("data",)`` over all devices."""
+    devs = devices if devices is not None else jax.devices()
+    if axes is None:
+        axes = {"data": len(devs)}
+    shape = tuple(axes.values())
+    if int(np.prod(shape)) != len(devs):
+        raise ValueError(
+            f"mesh axes {axes} need {int(np.prod(shape))} devices, have {len(devs)}"
+        )
+    arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def best_mesh(model_parallel: int = 1, devices: list | None = None) -> Mesh:
+    """2-D ("data", "model") mesh with the requested TP degree; TP is
+    clamped to a divisor of the device count."""
+    devs = devices if devices is not None else jax.devices()
+    n = len(devs)
+    mp = max(1, model_parallel)
+    while n % mp != 0:
+        mp -= 1
+    return make_mesh({"data": n // mp, "model": mp}, devs)
+
+
+def mesh_axis_size(mesh: Mesh | None, axis: str) -> int:
+    if mesh is None or axis not in mesh.shape:
+        return 1
+    return mesh.shape[axis]

@@ -2,8 +2,18 @@ import os
 
 # Sharding tests run on a virtual 8-device CPU mesh; the engine host plane
 # doesn't need the TPU, and tests must not depend on one being attached.
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# NOTE: env vars alone are not enough — this environment's JAX plugin
+# overrides JAX_PLATFORMS, so also force the config flag before any
+# backend initialization.
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

@@ -1,0 +1,227 @@
+"""Numeric plane: ops, models, sharded KNN, jitted executors.
+
+Runs on the virtual 8-device CPU mesh (see conftest.py) — sharding
+semantics are identical on TPU; only speed differs.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pathway_tpu.models import (
+    BGE_RERANKER_BASE,
+    MINILM_L6,
+    EncoderConfig,
+    HashTokenizer,
+    TextEncoderModel,
+    encoder_param_specs,
+)
+from pathway_tpu.ops import (
+    bucket_size,
+    cosine_scores,
+    l2sq_distances,
+    masked_top_k,
+    normalize,
+)
+from pathway_tpu.parallel import JittedEncoder, ShardedKnnIndex, best_mesh, make_mesh
+
+TINY = dataclasses.replace(
+    MINILM_L6, layers=2, hidden=64, heads=4, mlp_dim=128, dtype=jnp.float32
+)
+
+
+# ---------------------------------------------------------------------------
+# ops
+
+
+def test_bucket_size():
+    assert bucket_size(1) == 8
+    assert bucket_size(8) == 8
+    assert bucket_size(9) == 16
+    assert bucket_size(1000) == 1024
+    assert bucket_size(100, max_bucket=64) == 64
+
+
+def test_distances_match_numpy():
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(3, 16)).astype(np.float32)
+    c = rng.normal(size=(10, 16)).astype(np.float32)
+    cos = np.asarray(cosine_scores(jnp.asarray(q), jnp.asarray(c)))
+    qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+    cn = c / np.linalg.norm(c, axis=1, keepdims=True)
+    np.testing.assert_allclose(cos, qn @ cn.T, atol=1e-5)
+    l2 = np.asarray(l2sq_distances(jnp.asarray(q), jnp.asarray(c)))
+    expected = ((q[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(l2, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_masked_top_k():
+    scores = jnp.asarray([[1.0, 5.0, 3.0, 4.0]])
+    valid = jnp.asarray([1.0, 0.0, 1.0, 1.0])
+    vals, idx = masked_top_k(scores, valid, 2)
+    assert idx.tolist() == [[3, 2]]
+    np.testing.assert_allclose(np.asarray(vals), [[4.0, 3.0]])
+
+
+def test_normalize():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 8)).astype(np.float32))
+    n = np.linalg.norm(np.asarray(normalize(x)), axis=1)
+    np.testing.assert_allclose(n, np.ones(4), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+
+
+def test_hash_tokenizer_deterministic_and_bucketed():
+    tok = HashTokenizer()
+    ids, mask, tps = tok.encode_batch(["hello world", "a much longer sentence here ok"])
+    ids2, _, _ = tok.encode_batch(["hello world", "a much longer sentence here ok"])
+    np.testing.assert_array_equal(ids, ids2)
+    assert ids.shape == mask.shape == tps.shape
+    assert ids.shape[1] in (16, 32)  # bucketed
+    assert mask[0].sum() == 4  # CLS hello world SEP
+    assert tok.count_tokens("hello world") == 2
+
+
+def test_hash_tokenizer_pairs():
+    tok = HashTokenizer()
+    ids, mask, tps = tok.encode_batch(["query"], pair=["doc text"])
+    assert tps[0].max() == 1  # second segment present
+    assert mask[0].sum() == 6  # CLS q SEP d t SEP
+
+
+# ---------------------------------------------------------------------------
+# models
+
+
+def test_encoder_forward_shapes():
+    model = TextEncoderModel(TINY)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    mask = jnp.ones((2, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids, mask)
+    out = model.apply(params, ids, mask)
+    assert out.shape == (2, 64)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=1), np.ones(2), atol=1e-4
+    )
+
+
+def test_encoder_param_specs_split_heads_and_mlp():
+    model = TextEncoderModel(TINY)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32)
+    )
+    specs = encoder_param_specs(params)
+    flat = jax.tree_util.tree_flatten_with_path(specs)[0]
+    by_name = {"/".join(str(getattr(p, "key", p)) for p in path): s for path, s in flat}
+    q = [s for n, s in by_name.items() if "query/kernel" in n][0]
+    up = [s for n, s in by_name.items() if "mlp_up/kernel" in n][0]
+    ln = [s for n, s in by_name.items() if "ln/scale" in n][0]
+    assert "model" in str(q) and "model" in str(up)
+    assert str(ln) == "PartitionSpec()"
+
+
+# ---------------------------------------------------------------------------
+# sharded KNN
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh()
+
+
+def test_knn_basic_single_device():
+    idx = ShardedKnnIndex(8, metric="l2sq", capacity=16)
+    idx.add([("a", np.ones(8)), ("b", np.zeros(8)), ("c", 2 * np.ones(8))])
+    res = idx.search(np.zeros((1, 8)), 2)
+    assert [k for k, _ in res[0]] == ["b", "a"]
+
+
+def test_knn_sharded_matches_bruteforce(mesh8):
+    rng = np.random.default_rng(42)
+    corpus = rng.normal(size=(200, 32)).astype(np.float32)
+    idx = ShardedKnnIndex(32, metric="cos", capacity=64, mesh=mesh8)
+    idx.add([(i, corpus[i]) for i in range(200)])
+    queries = rng.normal(size=(5, 32)).astype(np.float32)
+    res = idx.search(queries, 10)
+    qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+    cn = corpus / np.linalg.norm(corpus, axis=1, keepdims=True)
+    scores = qn @ cn.T
+    for qi in range(5):
+        expect = list(np.argsort(-scores[qi])[:10])
+        got = [k for k, _ in res[qi]]
+        assert got == expect
+
+
+def test_knn_upsert_and_remove(mesh8):
+    idx = ShardedKnnIndex(4, metric="cos", capacity=8, mesh=mesh8)
+    idx.add([("x", np.array([1, 0, 0, 0.0])), ("y", np.array([0, 1, 0, 0.0]))])
+    r = idx.search(np.array([[1, 0, 0, 0.0]]), 1)
+    assert r[0][0][0] == "x"
+    # upsert x to point away from the query
+    idx.add([("x", np.array([-1, 0, 0, 0.0]))])
+    r = idx.search(np.array([[1, 0, 0, 0.0]]), 2)
+    assert r[0][0][0] == "y"
+    idx.remove(["y"])
+    r = idx.search(np.array([[0, 1, 0, 0.0]]), 2)
+    assert all(k != "y" for k, _ in r[0])
+    assert len(idx) == 1
+
+
+def test_knn_growth_preserves_data(mesh8):
+    rng = np.random.default_rng(7)
+    idx = ShardedKnnIndex(16, metric="cos", capacity=10, mesh=mesh8)
+    first = rng.normal(size=16).astype(np.float32)
+    idx.add([("first", first)])
+    cap0 = idx.capacity
+    idx.add([(f"n{i}", rng.normal(size=16).astype(np.float32)) for i in range(5000)])
+    assert idx.capacity > cap0
+    assert idx.search(first[None, :], 1)[0][0][0] == "first"
+
+
+def test_knn_empty_search():
+    idx = ShardedKnnIndex(4)
+    assert idx.search(np.zeros((2, 4)), 3) == [[], []]
+
+
+def test_knn_state_roundtrip():
+    idx = ShardedKnnIndex(4, capacity=8)
+    idx.add([("a", np.array([1, 0, 0, 0.0])), ("b", np.array([0, 1, 0, 0.0]))])
+    state = idx.state_dict()
+    idx2 = ShardedKnnIndex(4, capacity=8)
+    idx2.load_state_dict(state)
+    assert idx2.search(np.array([[0, 1, 0, 0.0]]), 1)[0][0][0] == "b"
+
+
+# ---------------------------------------------------------------------------
+# executors
+
+
+def test_jitted_encoder_batches(mesh8):
+    enc = JittedEncoder(TINY, mesh=None)
+    out = enc.encode(["one", "two", "three"])
+    assert out.shape == (3, 64)
+    # deterministic across calls
+    out2 = enc.encode(["one", "two", "three"])
+    np.testing.assert_allclose(out, out2, atol=1e-5)
+
+
+def test_jitted_encoder_tp_dp():
+    mesh = best_mesh(model_parallel=2)
+    enc = JittedEncoder(TINY, mesh=mesh)
+    out = enc.encode(["alpha", "beta", "gamma", "delta", "eps"])
+    assert out.shape == (5, 64)
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), np.ones(5), atol=1e-4)
+
+
+def test_cross_encoder_scores():
+    cfg = dataclasses.replace(
+        BGE_RERANKER_BASE, layers=2, hidden=64, heads=4, mlp_dim=128, dtype=jnp.float32
+    )
+    ce = JittedEncoder(cfg, cross=True)
+    s = ce.score_pairs(["q", "q"], ["relevant doc", "other"])
+    assert s.shape == (2,) and s.dtype == np.float32
