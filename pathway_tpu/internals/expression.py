@@ -552,6 +552,14 @@ class FullyAsyncApplyExpression(ApplyExpression):
     column dtype becomes Future (reference fully-async UDF executor)."""
 
 
+class BatchApplyExpression(AsyncApplyExpression):
+    """Epoch-batched UDF: ``_fun`` receives one LIST per argument (all the
+    epoch's rows at once) and returns an aligned list of results.  This is
+    the host contract for jitted TPU executors — one compiled call per
+    epoch instead of the reference's per-row torch calls
+    (``xpacks/llm/embedders.py:270-327``)."""
+
+
 class CastExpression(ColumnExpression):
     def __init__(self, target: dt.DType, expr: ColumnExpression):
         self._target = target

@@ -47,6 +47,7 @@ def run(
         from pathway_tpu.persistence import attach_persistence
 
         attach_persistence(sched, persistence_config)
+    G.active_scheduler = sched  # handle for stopping threaded servers
     ctx = sched.run()
     G.last_run_ctx = ctx
     return ctx

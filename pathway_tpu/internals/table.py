@@ -283,6 +283,8 @@ class Table:
         executor (reference ``map_named_async`` micro-batching)."""
         from pathway_tpu.internals.udfs import run_async_batch
 
+        from pathway_tpu.internals.expression import BatchApplyExpression
+
         async_exprs = [(i, e) for i, e in enumerate(exprs) if _contains_async(e)]
         sync_exprs = [(i, e) for i, e in enumerate(exprs) if not _contains_async(e)]
         sync_fns = [(i, e._compile(layout.resolver)) for i, e in sync_exprs]
@@ -291,7 +293,16 @@ class Table:
             assert isinstance(e, AsyncApplyExpression)
             arg_fns = [a._compile(layout.resolver) for a in e._args]
             kw_fns = {k: v._compile(layout.resolver) for k, v in e._kwargs.items()}
-            async_plans.append((i, e._fun, arg_fns, kw_fns))
+            async_plans.append(
+                (
+                    i,
+                    e._fun,
+                    arg_fns,
+                    kw_fns,
+                    isinstance(e, BatchApplyExpression),
+                    e._propagate_none,
+                )
+            )
 
         if in_node is None:
             in_node = self._node
@@ -306,16 +317,55 @@ class Table:
             for i, fn in sync_fns:
                 for j, kv in enumerate(kvs):
                     results[j][i] = fn(kv)
-            for i, fun, arg_fns, kw_fns in async_plans:
-                calls = []
-                for kv in kvs:
-                    calls.append(
-                        (
-                            [f(kv) for f in arg_fns],
-                            {k: f(kv) for k, f in kw_fns.items()},
+            for i, fun, arg_fns, kw_fns, is_batch, prop_none in async_plans:
+                if is_batch:
+                    # one call with per-argument LISTS (jitted TPU batch).
+                    # Rows with ERROR (or None under propagate_none) inputs
+                    # are screened out so one bad row can't poison the batch.
+                    all_args = [[f(kv) for f in arg_fns] for kv in kvs]
+                    all_kw = [{k: f(kv) for k, f in kw_fns.items()} for kv in kvs]
+
+                    def _bad(vals: Iterable) -> Any:
+                        for v in vals:
+                            if v is api.ERROR:
+                                return api.ERROR
+                            if v is None and prop_none:
+                                return None
+                        return False
+
+                    sentinel = [
+                        _bad(list(a) + list(k.values()))
+                        for a, k in zip(all_args, all_kw)
+                    ]
+                    clean = [j for j, s in enumerate(sentinel) if s is False]
+                    outs_clean: list[Any] = []
+                    if clean:
+                        arg_lists = [
+                            [all_args[j][ai] for j in clean]
+                            for ai in range(len(arg_fns))
+                        ]
+                        kw_lists = {
+                            k: [all_kw[j][k] for j in clean] for k in kw_fns
+                        }
+                        outs_clean = list(fun(*arg_lists, **kw_lists))
+                        if len(outs_clean) != len(clean):
+                            raise ValueError(
+                                f"batch UDF returned {len(outs_clean)} results "
+                                f"for {len(clean)} rows"
+                            )
+                    outs = list(sentinel)
+                    for j, o in zip(clean, outs_clean):
+                        outs[j] = o
+                else:
+                    calls = []
+                    for kv in kvs:
+                        calls.append(
+                            (
+                                [f(kv) for f in arg_fns],
+                                {k: f(kv) for k, f in kw_fns.items()},
+                            )
                         )
-                    )
-                outs = run_async_batch(fun, calls)
+                    outs = run_async_batch(fun, calls)
                 for j, o in enumerate(outs):
                     results[j][i] = o
             return [tuple(r) for r in results]

@@ -32,6 +32,8 @@ from pathway_tpu.internals.expression import (
 __all__ = [
     "udf",
     "UDF",
+    "BatchUDF",
+    "batch_udf",
     "async_executor",
     "sync_executor",
     "auto_executor",
@@ -336,6 +338,11 @@ class UDF:
     def __wrapped__(self, *args: Any, **kwargs: Any) -> Any:
         raise NotImplementedError
 
+    #: subclasses may instead define ``__batch__(self, xs: list, ...) ->
+    #: list`` to run ONCE per epoch with per-argument lists (the jitted
+    #: TPU executor contract; see ``BatchApplyExpression``)
+    __batch__: Callable | None = None
+
     def _resolve_fun(self) -> tuple[Callable, bool]:
         fun = self._wrapped if self._wrapped is not None else self.__wrapped__
         executor = self.executor
@@ -368,6 +375,18 @@ class UDF:
             return dt.ANY
 
     def __call__(self, *args: Any, **kwargs: Any) -> ColumnExpression:
+        from pathway_tpu.internals.expression import BatchApplyExpression
+
+        batch = getattr(self, "__batch__", None)
+        if batch is not None:
+            ret = self._return_dtype()
+            fun = batch if not isinstance(batch, staticmethod) else batch.__func__
+            if self.max_batch_size is not None:
+                fun = _chunk_batches(fun, self.max_batch_size)
+            return BatchApplyExpression(
+                fun, ret, args, kwargs, propagate_none=self.propagate_none,
+                deterministic=self.deterministic,
+            )
         fun, is_async = self._resolve_fun()
         ret = self._return_dtype()
         if is_async:
@@ -379,6 +398,56 @@ class UDF:
             fun, ret, args, kwargs, propagate_none=self.propagate_none,
             deterministic=self.deterministic,
         )
+
+
+def _chunk_batches(fun: Callable, max_batch: int) -> Callable:
+    """Split oversize epoch batches into chunks of ``max_batch`` rows."""
+
+    @functools.wraps(fun)
+    def wrapper(*arg_lists: list, **kw_lists: list) -> list:
+        n = len(arg_lists[0]) if arg_lists else len(next(iter(kw_lists.values())))
+        if n <= max_batch:
+            return fun(*arg_lists, **kw_lists)
+        out: list = []
+        for s in range(0, n, max_batch):
+            sl = slice(s, s + max_batch)
+            out.extend(
+                fun(
+                    *[a[sl] for a in arg_lists],
+                    **{k: v[sl] for k, v in kw_lists.items()},
+                )
+            )
+        return out
+
+    return wrapper
+
+
+class BatchUDF(UDF):
+    """UDF whose function takes per-argument LISTS covering the whole epoch
+    (one jitted TPU call per epoch)."""
+
+    def __init__(self, fun: Callable, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.__batch__ = fun
+        functools.update_wrapper(self, fun)
+
+
+def batch_udf(
+    fun: Callable | None = None,
+    /,
+    *,
+    return_type: Any = None,
+    max_batch_size: int | None = None,
+    **kwargs: Any,
+) -> Any:
+    """Decorator: epoch-batched UDF (``fun(list, ...) -> list``)."""
+
+    def wrap(f: Callable) -> BatchUDF:
+        return BatchUDF(
+            f, return_type=return_type, max_batch_size=max_batch_size, **kwargs
+        )
+
+    return wrap(fun) if fun is not None else wrap
 
 
 class _FunctionUDF(UDF):

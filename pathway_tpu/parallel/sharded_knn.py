@@ -78,6 +78,10 @@ class ShardedKnnIndex:
         self._free: list[int] = []
         self._cursor = 0  # next never-used slot
         self._search_cache: dict[tuple[int, int], Callable] = {}
+        # freed slots are quarantined while dispatch handles are in flight,
+        # so collect() never resolves a reused slot to the wrong key
+        self._inflight = 0
+        self._quarantine: list[int] = []
 
     # ------------------------------------------------------------------
     def _round_capacity(self, cap: int) -> int:
@@ -151,7 +155,10 @@ class ShardedKnnIndex:
             slot = self._slot_of.pop(key, None)
             if slot is not None:
                 self._key_of.pop(slot, None)
-                self._free.append(slot)
+                if self._inflight > 0:
+                    self._quarantine.append(slot)
+                else:
+                    self._free.append(slot)
                 slots.append(slot)
         if not slots:
             return
@@ -240,23 +247,35 @@ class ShardedKnnIndex:
         self._search_cache[(k, self.capacity)] = run
         return run
 
-    def search(
-        self, queries: np.ndarray, k: int
-    ) -> list[list[tuple[Any, float]]]:
-        """Top-k per query: [[(key, score), ...], ...].  Scores: higher =
-        closer for cos/dot; for l2sq the NEGATED squared distance."""
+    def dispatch(self, queries: np.ndarray, k: int):
+        """Asynchronously dispatch a search; returns an opaque handle.
+        Dispatches pipeline on-device without host sync — a serving loop
+        can keep several in flight and pay the host link latency once."""
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         nq = queries.shape[0]
         if nq == 0 or not self._slot_of:
-            return [[] for _ in range(nq)]
+            return (None, nq, k)
         k_eff = min(k, self.capacity)
         qb = pad_rows(queries, bucket_size(nq, min_bucket=1))
-        vals, idx = self._search_jit(k_eff)(
-            jnp.asarray(qb), self._vectors, self._valid
-        )
-        vals = np.asarray(vals)[:nq]
-        idx = np.asarray(idx)[:nq]
-        out: list[list[tuple[Any, float]]] = []
+        out = self._search_jit(k_eff)(jnp.asarray(qb), self._vectors, self._valid)
+        self._inflight += 1
+        return (out, nq, k)
+
+    def collect(self, handle) -> list[list[tuple[Any, float]]]:
+        """Resolve a :meth:`dispatch` handle to [[(key, score), ...], ...]."""
+        out, nq, k = handle
+        if out is None:
+            return [[] for _ in range(nq)]
+        self._inflight = max(0, self._inflight - 1)
+        if self._inflight == 0 and self._quarantine:
+            self._free.extend(self._quarantine)
+            self._quarantine.clear()
+        # one host readback for both arrays (each device_get is a full
+        # host<->device round trip; they dominate single-query latency)
+        vals, idx = jax.device_get(out)
+        vals = vals[:nq]
+        idx = idx[:nq]
+        rows: list[list[tuple[Any, float]]] = []
         for qi in range(nq):
             row = []
             for slot, score in zip(idx[qi], vals[qi]):
@@ -265,8 +284,15 @@ class ShardedKnnIndex:
                 key = self._key_of.get(int(slot))
                 if key is not None:
                     row.append((key, float(score)))
-            out.append(row[:k])
-        return out
+            rows.append(row[:k])
+        return rows
+
+    def search(
+        self, queries: np.ndarray, k: int
+    ) -> list[list[tuple[Any, float]]]:
+        """Top-k per query: [[(key, score), ...], ...].  Scores: higher =
+        closer for cos/dot; for l2sq the NEGATED squared distance."""
+        return self.collect(self.dispatch(queries, k))
 
     # ------------------------------------------------------------------
     # persistence support
@@ -280,7 +306,7 @@ class ShardedKnnIndex:
             "valid": np.asarray(self._valid),
             "slot_of": dict(self._slot_of),
             "cursor": self._cursor,
-            "free": list(self._free),
+            "free": list(self._free) + list(self._quarantine),
         }
 
     def load_state_dict(self, state: dict) -> None:

@@ -1,0 +1,64 @@
+"""``pw.indexing`` — live retrieval indexes over streaming tables.
+
+Capability parity with reference ``python/pathway/stdlib/indexing/``:
+``DataIndex`` (``data_index.py:206-473``), brute-force / usearch / LSH
+KNN (``nearest_neighbors.py:65-547``), ``TantivyBM25`` (``bm25.py``),
+``HybridIndex`` RRF fusion (``hybrid_index.py``), sorting index
+(``sorting.py``).  The KNN path is TPU-native: a sharded HBM slab
+searched by jitted matmul + top-k (see
+:mod:`pathway_tpu.parallel.sharded_knn`).
+"""
+
+from pathway_tpu.stdlib.indexing.adapters import BM25Adapter, HybridAdapter, KnnAdapter
+from pathway_tpu.stdlib.indexing.data_index import (
+    BruteForceKnn,
+    BruteForceKnnFactory,
+    BruteForceKnnMetricKind,
+    DataIndex,
+    HybridIndex,
+    HybridIndexFactory,
+    InnerIndex,
+    InnerIndexFactory,
+    LshKnn,
+    LshKnnFactory,
+    TantivyBM25,
+    TantivyBM25Factory,
+    UsearchKnn,
+    UsearchKnnFactory,
+)
+from pathway_tpu.stdlib.indexing.filters import compile_filter
+from pathway_tpu.stdlib.indexing.sorting import retrieve_prev_next_values
+from pathway_tpu.stdlib.indexing.vector_document_index import (
+    VectorDocumentIndex,
+    default_brute_force_knn_document_index,
+    default_full_text_document_index,
+    default_usearch_knn_document_index,
+    default_vector_document_index,
+)
+
+__all__ = [
+    "DataIndex",
+    "InnerIndex",
+    "InnerIndexFactory",
+    "BruteForceKnn",
+    "BruteForceKnnFactory",
+    "BruteForceKnnMetricKind",
+    "UsearchKnn",
+    "UsearchKnnFactory",
+    "LshKnn",
+    "LshKnnFactory",
+    "TantivyBM25",
+    "TantivyBM25Factory",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "KnnAdapter",
+    "BM25Adapter",
+    "HybridAdapter",
+    "compile_filter",
+    "retrieve_prev_next_values",
+    "VectorDocumentIndex",
+    "default_vector_document_index",
+    "default_brute_force_knn_document_index",
+    "default_usearch_knn_document_index",
+    "default_full_text_document_index",
+]

@@ -1,0 +1,214 @@
+"""Host adapters bridging engine index operators to concrete indexes.
+
+Equivalent of the reference's ``ExternalIndex`` implementations
+(``src/external_integration/*.rs``): the KNN adapter fronts the
+TPU-resident :class:`~pathway_tpu.parallel.ShardedKnnIndex`; BM25 is a
+host inverted index (the tantivy equivalent).  Metadata filtering
+(JMESPath-subset, see :mod:`.filters`) is applied host-side with
+over-fetch, mirroring the reference's filter-then-trim flow
+(``src/external_integration/mod.rs:92-181``).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+__all__ = ["KnnAdapter", "BM25Adapter", "HybridAdapter"]
+
+_OVERFETCH = 4
+
+
+class KnnAdapter:
+    """(key, vector) index over :class:`ShardedKnnIndex` + host metadata."""
+
+    def __init__(
+        self,
+        dim: int,
+        *,
+        metric: str = "cos",
+        capacity: int = 1024,
+        mesh: Any = None,
+        dtype: Any = None,
+    ):
+        import jax.numpy as jnp
+
+        from pathway_tpu.parallel import ShardedKnnIndex
+
+        self.index = ShardedKnnIndex(
+            dim,
+            metric=metric,
+            capacity=capacity,
+            mesh=mesh,
+            dtype=dtype or jnp.float32,
+        )
+        self.meta: dict[Any, dict | None] = {}
+
+    def add(self, items: Sequence[tuple[Any, Any]]) -> None:
+        prepared = []
+        for key, payload in items:
+            if isinstance(payload, tuple) and len(payload) == 2 and isinstance(payload[1], dict):
+                vec, meta = payload
+            else:
+                vec, meta = payload, None
+            self.meta[key] = meta
+            prepared.append((key, np.asarray(vec, np.float32)))
+        self.index.add(prepared)
+
+    def remove(self, keys: Sequence[Any]) -> None:
+        for k in keys:
+            self.meta.pop(k, None)
+        self.index.remove(keys)
+
+    def set_meta(self, key: Any, meta: dict | None) -> None:
+        self.meta[key] = meta
+
+    def search(
+        self,
+        payloads: Sequence[Any],
+        k: Sequence[int],
+        filters: Sequence[Callable[[dict], bool] | None],
+    ) -> list[list[tuple[Any, float]]]:
+        if not payloads:
+            return []
+        kmax = max(list(k) + [0])
+        if kmax == 0:
+            return [[] for _ in payloads]
+        fetch = kmax * (_OVERFETCH if any(f is not None for f in filters) else 1)
+        fetch = min(max(fetch, kmax), max(len(self.index), 1))
+        q = np.stack([np.asarray(p, np.float32).reshape(-1) for p in payloads])
+        raw = self.index.search(q, fetch)
+        out = []
+        for qi, reply in enumerate(raw):
+            f = filters[qi]
+            if f is not None:
+                reply = [(key, s) for key, s in reply if f(self.meta.get(key) or {})]
+            out.append(reply[: k[qi]])
+        return out
+
+
+class BM25Adapter:
+    """Incremental BM25 full-text index (tantivy-equivalent,
+    ``src/external_integration/tantivy_integration.rs``)."""
+
+    def __init__(self, k1: float = 1.2, b: float = 0.75, tokenizer: Callable[[str], list[str]] | None = None):
+        self.k1 = k1
+        self.b = b
+        self._tokenize = tokenizer or (lambda s: [t for t in _simple_tokens(s)])
+        self.postings: dict[str, dict[Any, int]] = defaultdict(dict)
+        self.doc_len: dict[Any, int] = {}
+        self.doc_terms: dict[Any, list[str]] = {}
+        self.meta: dict[Any, dict | None] = {}
+        self.total_len = 0
+
+    def add(self, items: Sequence[tuple[Any, Any]]) -> None:
+        for key, payload in items:
+            if isinstance(payload, tuple) and len(payload) == 2 and isinstance(payload[1], dict):
+                text, meta = payload
+            else:
+                text, meta = payload, None
+            if key in self.doc_len:
+                self._remove_one(key)
+            toks = self._tokenize(str(text))
+            self.doc_terms[key] = toks
+            self.doc_len[key] = len(toks)
+            self.total_len += len(toks)
+            self.meta[key] = meta
+            for t in toks:
+                self.postings[t][key] = self.postings[t].get(key, 0) + 1
+
+    def _remove_one(self, key: Any) -> None:
+        toks = self.doc_terms.pop(key, [])
+        self.total_len -= self.doc_len.pop(key, 0)
+        self.meta.pop(key, None)
+        for t in set(toks):
+            d = self.postings.get(t)
+            if d is not None:
+                d.pop(key, None)
+                if not d:
+                    del self.postings[t]
+
+    def remove(self, keys: Sequence[Any]) -> None:
+        for k in keys:
+            self._remove_one(k)
+
+    def set_meta(self, key: Any, meta: dict | None) -> None:
+        self.meta[key] = meta
+
+    def __len__(self) -> int:
+        return len(self.doc_len)
+
+    def search(
+        self,
+        payloads: Sequence[Any],
+        k: Sequence[int],
+        filters: Sequence[Callable[[dict], bool] | None],
+    ) -> list[list[tuple[Any, float]]]:
+        n = len(self.doc_len)
+        avgdl = (self.total_len / n) if n else 1.0
+        out = []
+        for qi, payload in enumerate(payloads):
+            scores: dict[Any, float] = defaultdict(float)
+            for term in self._tokenize(str(payload)):
+                plist = self.postings.get(term)
+                if not plist:
+                    continue
+                idf = math.log(1.0 + (n - len(plist) + 0.5) / (len(plist) + 0.5))
+                for key, tf in plist.items():
+                    dl = self.doc_len[key]
+                    denom = tf + self.k1 * (1 - self.b + self.b * dl / avgdl)
+                    scores[key] += idf * tf * (self.k1 + 1) / denom
+            f = filters[qi]
+            ranked = sorted(scores.items(), key=lambda kv: (-kv[1], str(kv[0])))
+            if f is not None:
+                ranked = [(key, s) for key, s in ranked if f(self.meta.get(key) or {})]
+            out.append([(key, float(s)) for key, s in ranked[: k[qi]]])
+        return out
+
+
+class HybridAdapter:
+    """Reciprocal-rank fusion over child adapters (reference
+    ``HybridIndex``, ``stdlib/indexing/hybrid_index.py:14-147``).
+    Payloads are tuples with one element per child."""
+
+    def __init__(self, children: Sequence[Any], rrf_k: float = 60.0):
+        self.children = list(children)
+        self.rrf_k = rrf_k
+
+    def add(self, items: Sequence[tuple[Any, Any]]) -> None:
+        for ci, child in enumerate(self.children):
+            child.add([(key, payload[ci]) for key, payload in items])
+
+    def remove(self, keys: Sequence[Any]) -> None:
+        for child in self.children:
+            child.remove(keys)
+
+    def set_meta(self, key: Any, meta: dict | None) -> None:
+        for child in self.children:
+            if hasattr(child, "set_meta"):
+                child.set_meta(key, meta)
+
+    def search(self, payloads, k, filters):
+        per_child = []
+        for ci, child in enumerate(self.children):
+            child_payloads = [p[ci] for p in payloads]
+            fetch = [kk * 2 for kk in k]
+            per_child.append(child.search(child_payloads, fetch, filters))
+        out = []
+        for qi in range(len(payloads)):
+            fused: dict[Any, float] = defaultdict(float)
+            for replies in per_child:
+                for rank, (key, _s) in enumerate(replies[qi]):
+                    fused[key] += 1.0 / (self.rrf_k + rank + 1)
+            ranked = sorted(fused.items(), key=lambda kv: (-kv[1], str(kv[0])))
+            out.append([(key, float(s)) for key, s in ranked[: k[qi]]])
+        return out
+
+
+def _simple_tokens(s: str):
+    import re
+
+    return re.findall(r"[a-z0-9]+", s.lower())

@@ -1,0 +1,36 @@
+"""Sorting-index helpers (reference ``stdlib/indexing/sorting.py``).
+
+The engine's :class:`~pathway_tpu.engine.graph.SortNode` maintains
+prev/next pointers per row (reference ``prev_next.rs``); this module adds
+the value-retrieval convenience used by ``statistical.interpolate``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals.table import Table
+
+__all__ = ["retrieve_prev_next_values"]
+
+
+def retrieve_prev_next_values(
+    ordered_table: Table, value: Any = None
+) -> Table:
+    """Given a table with ``prev``/``next`` pointer columns and a ``value``
+    column, return ``prev_value``/``next_value`` columns holding the nearest
+    non-None value in each direction (reference
+    ``sorting.py retrieve_prev_next_values``)."""
+    import pathway_tpu as pw
+
+    if value is None:
+        value = ordered_table.value
+    name = value._name
+
+    prev_rows = ordered_table.ix(ordered_table.prev, optional=True)
+    next_rows = ordered_table.ix(ordered_table.next, optional=True)
+    return ordered_table.select(
+        *[ordered_table[c] for c in ordered_table._column_names],
+        prev_value=prev_rows[name],
+        next_value=next_rows[name],
+    )

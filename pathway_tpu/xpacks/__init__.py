@@ -1,0 +1,11 @@
+"""Extension packs (reference ``python/pathway/xpacks/``)."""
+
+from typing import Any
+
+
+def __getattr__(name: str) -> Any:
+    import importlib
+
+    if name in ("llm",):
+        return importlib.import_module(f"pathway_tpu.xpacks.{name}")
+    raise AttributeError(name)

@@ -1,0 +1,31 @@
+"""``pw.xpacks.llm`` — the live RAG stack (reference
+``python/pathway/xpacks/llm/``): embedders, llms, parsers, splitters,
+rerankers, DocumentStore, VectorStore, question answering, servers,
+prompts.  TPU-native where the reference uses torch."""
+
+from pathway_tpu.xpacks.llm._typing import Doc, DocTransformer, DocTransformerCallable
+from pathway_tpu.xpacks.llm import (
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    rerankers,
+    splitters,
+)
+from pathway_tpu.xpacks.llm import document_store, question_answering, servers, vector_store
+
+__all__ = [
+    "Doc",
+    "DocTransformer",
+    "DocTransformerCallable",
+    "embedders",
+    "llms",
+    "parsers",
+    "prompts",
+    "rerankers",
+    "splitters",
+    "document_store",
+    "question_answering",
+    "servers",
+    "vector_store",
+]

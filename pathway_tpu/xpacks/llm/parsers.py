@@ -1,0 +1,149 @@
+"""Document parsers: bytes -> list[(text, metadata)] UDFs
+(reference ``xpacks/llm/parsers.py``).
+
+``ParseUtf8`` is the always-available core; the heavyweight parsers
+(unstructured / pypdf / vision-LLM) keep the reference API shape and are
+gated on their optional packages.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals.udfs import UDF
+
+__all__ = [
+    "ParseUtf8",
+    "Utf8Parser",
+    "ParseUnstructured",
+    "UnstructuredParser",
+    "PypdfParser",
+    "ImageParser",
+    "SlideParser",
+    "OpenParse",
+]
+
+
+class ParseUtf8(UDF):
+    """Decode bytes/str to one UTF-8 text chunk (reference
+    ``parsers.py:53``)."""
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        if isinstance(contents, bytes):
+            text = contents.decode("utf-8", errors="replace")
+        else:
+            text = str(contents)
+        return [(text, {})]
+
+
+Utf8Parser = ParseUtf8
+
+
+class _GatedParser(UDF):
+    _pkg = ""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        super().__init__()
+        try:
+            __import__(self._pkg)
+        except ImportError as e:
+            raise ImportError(
+                f"{type(self).__name__} requires the optional {self._pkg!r} "
+                "package; ParseUtf8 is always available"
+            ) from e
+        self._args = args
+        self._kwargs = kwargs
+
+
+class ParseUnstructured(_GatedParser):
+    """reference ``parsers.py:79`` (unstructured-io)"""
+
+    _pkg = "unstructured"
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        import io
+
+        from unstructured.partition.auto import partition
+
+        elements = partition(file=io.BytesIO(contents))
+        mode = self._kwargs.get("mode", "single")
+        if mode == "elements":
+            return [(str(e), {"category": getattr(e, "category", None)}) for e in elements]
+        return [("\n\n".join(str(e) for e in elements), {})]
+
+
+UnstructuredParser = ParseUnstructured
+
+
+class PypdfParser(_GatedParser):
+    """reference ``parsers.py:746`` (pypdf)"""
+
+    _pkg = "pypdf"
+
+    def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
+        import io
+
+        from pypdf import PdfReader
+
+        reader = PdfReader(io.BytesIO(contents))
+        return [
+            (page.extract_text() or "", {"page": i})
+            for i, page in enumerate(reader.pages)
+        ]
+
+
+class ImageParser(UDF):
+    """Vision-LLM image description parser (reference ``parsers.py:396``);
+    requires a multimodal ``llm`` chat UDF."""
+
+    def __init__(
+        self,
+        llm: Any = None,
+        parse_prompt: str = "Describe the image contents.",
+        parse_fn: Callable | None = None,
+        **kwargs: Any,
+    ):
+        super().__init__()
+        self.llm = llm
+        self.parse_prompt = parse_prompt
+        self.parse_fn = parse_fn
+
+    def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
+        if self.parse_fn is not None:
+            return [(str(self.parse_fn(contents)), {})]
+        if self.llm is None:
+            raise ValueError("ImageParser needs an llm or a parse_fn")
+        import base64
+
+        b64 = base64.b64encode(contents).decode()
+        text = self.llm.__wrapped__(
+            [
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "text", "text": self.parse_prompt},
+                        {
+                            "type": "image_url",
+                            "image_url": {"url": f"data:image/png;base64,{b64}"},
+                        },
+                    ],
+                }
+            ]
+        )
+        return [(str(text), {})]
+
+
+class SlideParser(ImageParser):
+    """Slide-deck vision parser (reference ``parsers.py:569``,
+    license-gated there; here simply ImageParser over rendered pages)."""
+
+
+class OpenParse(_GatedParser):
+    """reference ``parsers.py:235`` (openparse)"""
+
+    _pkg = "openparse"
+
+    def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
+        raise NotImplementedError(
+            "openparse is unavailable in this environment"
+        )

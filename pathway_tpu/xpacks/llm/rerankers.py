@@ -1,0 +1,134 @@
+"""Rerankers (reference ``xpacks/llm/rerankers.py``).
+
+TPU re-design: :class:`CrossEncoderReranker` (reference ``:186-235``,
+per-row torch ``CrossEncoder.predict``) runs the flax cross-encoder as an
+epoch-batched jitted call; :class:`EncoderReranker` (``:251``) scores with
+the bi-encoder dot product.  ``rerank_topk_filter`` (``:15``) and
+:class:`LLMReranker` (``:58``) are faithful ports of the host logic.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.internals.udfs import UDF, udf
+
+__all__ = [
+    "rerank_topk_filter",
+    "CrossEncoderReranker",
+    "EncoderReranker",
+    "LLMReranker",
+    "FlashRankReranker",
+]
+
+
+@udf
+def rerank_topk_filter(
+    docs: list[dict], scores: list[float], k: int = 5
+) -> tuple[list[dict], list[float]]:
+    """Keep the k best (docs, scores) pairs (reference ``rerankers.py:15``)."""
+    order = np.argsort(-np.asarray(scores, dtype=np.float64))[: int(k)]
+    return [docs[i] for i in order], [float(scores[i]) for i in order]
+
+
+class CrossEncoderReranker(UDF):
+    """(doc, query) -> relevance score via the TPU cross-encoder."""
+
+    def __init__(
+        self,
+        model_name: str = "BAAI/bge-reranker-base",
+        *,
+        mesh: Any = None,
+        params: Any = None,
+        config: Any = None,
+        max_batch_size: int | None = 256,
+        **kwargs: Any,
+    ):
+        super().__init__(max_batch_size=max_batch_size, **kwargs)
+        from pathway_tpu.models import BGE_RERANKER_BASE
+        from pathway_tpu.parallel import JittedEncoder
+
+        cfg = config if config is not None else BGE_RERANKER_BASE
+        self.encoder = JittedEncoder(
+            cfg, cross=True, mesh=mesh, model_name=model_name, params=params,
+            max_batch=max_batch_size or 256,
+        )
+
+    def __batch__(self, docs: list, queries: list) -> list[float]:
+        texts = [d["text"] if isinstance(d, dict) else str(d) for d in docs]
+        scores = self.encoder.score_pairs([str(q) for q in queries], texts)
+        return [float(s) for s in scores]
+
+    def __wrapped__(self, doc: Any, query: str) -> float:
+        return self.__batch__([doc], [query])[0]
+
+
+class EncoderReranker(UDF):
+    """Bi-encoder similarity reranker (reference ``rerankers.py:251``)."""
+
+    def __init__(self, embedder: Any = None, model_name: str = "all-MiniLM-L6-v2", **kwargs: Any):
+        super().__init__(**kwargs)
+        if embedder is None:
+            from pathway_tpu.xpacks.llm.embedders import TPUEncoderEmbedder
+
+            embedder = TPUEncoderEmbedder(model_name)
+        self.embedder = embedder
+
+    def __batch__(self, docs: list, queries: list) -> list[float]:
+        texts = [d["text"] if isinstance(d, dict) else str(d) for d in docs]
+        demb = np.stack(
+            [np.asarray(v) for v in self.embedder._embed_batch(texts)]
+        )
+        qemb = np.stack(
+            [np.asarray(v) for v in self.embedder._embed_batch([str(q) for q in queries])]
+        )
+        return [float(x) for x in np.sum(demb * qemb, axis=1)]
+
+    def __wrapped__(self, doc: Any, query: str) -> float:
+        return self.__batch__([doc], [query])[0]
+
+
+class LLMReranker(UDF):
+    """Chat-based 1-5 relevance scoring (reference ``rerankers.py:58``)."""
+
+    PROMPT = (
+        "Given a query and a document, rate how relevant the document is "
+        "to the query on an integer scale of 1 to 5. Answer with ONLY the "
+        "number.\nQuery: {query}\nDocument: {doc}"
+    )
+
+    def __init__(self, llm: Any, **kwargs: Any):
+        super().__init__(**kwargs)
+        self.llm = llm
+
+    def __wrapped__(self, doc: Any, query: str) -> float:
+        text = doc["text"] if isinstance(doc, dict) else str(doc)
+        msg = [{"role": "user", "content": self.PROMPT.format(query=query, doc=text)}]
+        fun = self.llm.__wrapped__ if hasattr(self.llm, "__wrapped__") else self.llm
+        import inspect
+
+        out = fun(msg)
+        if inspect.isawaitable(out):
+            import asyncio
+
+            out = asyncio.run(out)
+        try:
+            return float(str(out).strip().split()[0])
+        except (ValueError, IndexError):
+            return 1.0
+
+
+class FlashRankReranker(UDF):
+    """reference ``rerankers.py:319`` — gated on the flashrank package."""
+
+    def __init__(self, model: str = "ms-marco-TinyBERT-L-2-v2", **kwargs: Any):
+        super().__init__(**kwargs)
+        try:
+            import flashrank  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "FlashRankReranker needs the 'flashrank' package; use "
+                "CrossEncoderReranker (TPU) instead"
+            ) from e

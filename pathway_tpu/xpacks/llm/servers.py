@@ -1,0 +1,113 @@
+"""REST servers for RAG apps (reference ``xpacks/llm/servers.py:16-272``)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+import pathway_tpu as pw
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io.http import PathwayWebserver, rest_connector
+
+__all__ = [
+    "BaseRestServer",
+    "DocumentStoreServer",
+    "QARestServer",
+    "QASummaryRestServer",
+]
+
+
+class BaseRestServer:
+    """Route registry over one webserver (reference ``servers.py:16``)."""
+
+    def __init__(self, host: str, port: int, **kwargs: Any):
+        self.host = host
+        self.port = port
+        self.webserver = PathwayWebserver(host=host, port=port)
+
+    def serve(
+        self,
+        route: str,
+        schema: Any,
+        handler: Callable[[Table], Table],
+        **kwargs: Any,
+    ) -> None:
+        queries, writer = rest_connector(
+            webserver=self.webserver,
+            route=route,
+            schema=schema,
+            delete_completed_queries=kwargs.get("delete_completed_queries", False),
+        )
+        writer(handler(queries))
+
+    def run(
+        self,
+        threaded: bool = False,
+        with_cache: bool = True,
+        cache_backend: Any = None,
+        terminate_on_error: bool = False,
+        **kwargs: Any,
+    ) -> threading.Thread | None:
+        """Start the engine (reference ``servers.py:58`` ``run``)."""
+        if threaded:
+            t = threading.Thread(target=pw.run, daemon=True, name="pw_server")
+            t.start()
+            return t
+        pw.run()
+        return None
+
+    run_server = run
+
+
+class DocumentStoreServer(BaseRestServer):
+    """reference ``servers.py:92`` — exposes a DocumentStore over REST:
+    /v1/retrieve, /v1/statistics, /v1/inputs."""
+
+    def __init__(self, host: str, port: int, document_store: Any, **kwargs: Any):
+        super().__init__(host, port, **kwargs)
+        self.document_store = document_store
+        ds = document_store
+        self.serve("/v1/retrieve", ds.RetrieveQuerySchema, ds.retrieve_query)
+        self.serve("/v1/statistics", ds.StatisticsQuerySchema, ds.statistics_query)
+        self.serve("/v1/inputs", ds.InputsQuerySchema, ds.inputs_query)
+
+
+class QARestServer(BaseRestServer):
+    """reference ``servers.py:140`` — /v1/pw_ai_answer + document listing
+    for a question answerer."""
+
+    def __init__(self, host: str, port: int, rag_question_answerer: Any, **kwargs: Any):
+        super().__init__(host, port, **kwargs)
+        self.rag = rag_question_answerer
+        self.serve(
+            "/v1/pw_ai_answer",
+            self.rag.AnswerQuerySchema,
+            self.rag.answer_query,
+        )
+        self.serve(
+            "/v1/retrieve",
+            self.rag.RetrieveQuerySchema,
+            self.rag.retrieve,
+        )
+        self.serve(
+            "/v1/statistics",
+            self.rag.StatisticsQuerySchema,
+            self.rag.statistics,
+        )
+        self.serve(
+            "/v1/pw_list_documents",
+            self.rag.InputsQuerySchema,
+            self.rag.list_documents,
+        )
+
+
+class QASummaryRestServer(QARestServer):
+    """reference ``servers.py:193`` — adds /v1/pw_ai_summary."""
+
+    def __init__(self, host: str, port: int, rag_question_answerer: Any, **kwargs: Any):
+        super().__init__(host, port, rag_question_answerer, **kwargs)
+        self.serve(
+            "/v1/pw_ai_summary",
+            self.rag.SummarizeQuerySchema,
+            self.rag.summarize_query,
+        )

@@ -1,0 +1,161 @@
+"""VectorStoreServer / VectorStoreClient
+(reference ``xpacks/llm/vector_store.py:39-766``).
+
+The server is DocumentStore + REST routes with embedding done inside the
+server (TPU-batched); the client is a thin HTTP wrapper.  LangChain /
+LlamaIndex adapter constructors keep the reference API shape.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Any, Callable
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import (
+    BruteForceKnnFactory,
+    InnerIndexFactory,
+)
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.servers import DocumentStoreServer
+
+__all__ = ["VectorStoreServer", "VectorStoreClient"]
+
+
+class VectorStoreServer:
+    """reference ``vector_store.py:39``"""
+
+    def __init__(
+        self,
+        *docs: Table,
+        embedder: Any = None,
+        parser: Any = None,
+        splitter: Any = None,
+        doc_post_processors: list[Callable] | None = None,
+        index_factory: InnerIndexFactory | None = None,
+        reserved_space: int = 1024,
+        mesh: Any = None,
+    ):
+        if embedder is None and index_factory is None:
+            from pathway_tpu.xpacks.llm.embedders import TPUEncoderEmbedder
+
+            embedder = TPUEncoderEmbedder()
+        if index_factory is None:
+            index_factory = BruteForceKnnFactory(
+                embedder=embedder, reserved_space=reserved_space, mesh=mesh
+            )
+        self.docs = docs
+        self.document_store = DocumentStore(
+            list(docs),
+            retriever_factory=index_factory,
+            parser=parser,
+            splitter=splitter,
+            doc_post_processors=doc_post_processors,
+        )
+        self._server: DocumentStoreServer | None = None
+
+    @classmethod
+    def from_langchain_components(
+        cls, *docs: Table, embedder: Any, splitter: Any = None, **kwargs: Any
+    ) -> "VectorStoreServer":
+        """reference ``vector_store.py:93``"""
+        from pathway_tpu.internals.udfs import udf
+
+        @udf
+        def lc_embed(text: str) -> Any:
+            return embedder.embed_documents([text])[0]
+
+        lc_split = None
+        if splitter is not None:
+
+            @udf
+            def lc_split(text: str) -> list[tuple[str, dict]]:  # noqa: F811
+                return [(c, {}) for c in splitter.split_text(text)]
+
+        factory = BruteForceKnnFactory(embedder=lc_embed)
+        return cls(*docs, index_factory=factory, splitter=lc_split, **kwargs)
+
+    @classmethod
+    def from_llamaindex_components(
+        cls, *docs: Table, transformations: list, **kwargs: Any
+    ) -> "VectorStoreServer":
+        """reference ``vector_store.py:137``"""
+        raise NotImplementedError(
+            "llama_index is unavailable in this environment"
+        )
+
+    def run_server(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        *,
+        threaded: bool = False,
+        with_cache: bool = True,
+        cache_backend: Any = None,
+        terminate_on_error: bool = False,
+    ) -> threading.Thread | None:
+        """reference ``vector_store.py:478``"""
+        self._server = DocumentStoreServer(host, port, self.document_store)
+        return self._server.run(threaded=threaded, with_cache=with_cache)
+
+
+class VectorStoreClient:
+    """reference ``vector_store.py:651``"""
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: float = 60,
+    ):
+        if url is None:
+            url = f"http://{host or '127.0.0.1'}:{port}"
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, route: str, payload: dict) -> Any:
+        req = urllib.request.Request(
+            self.url + route,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def query(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list[dict]:
+        return self._post(
+            "/v1/retrieve",
+            {
+                "query": query,
+                "k": k,
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+        )
+
+    __call__ = query
+
+    def get_vectorstore_statistics(self) -> dict:
+        return self._post("/v1/statistics", {})
+
+    def get_input_files(
+        self,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ) -> list[dict]:
+        return self._post(
+            "/v1/inputs",
+            {
+                "metadata_filter": metadata_filter,
+                "filepath_globpattern": filepath_globpattern,
+            },
+        )

@@ -1,0 +1,242 @@
+"""stdlib.indexing: DataIndex over the external-index engine operator."""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing import (
+    BM25Adapter,
+    BruteForceKnnFactory,
+    HybridIndexFactory,
+    TantivyBM25Factory,
+    compile_filter,
+)
+from tests.utils import T, run_to_rows
+
+
+def _vec(*xs):
+    return tuple(float(x) for x in xs)
+
+
+def _make_docs():
+    return T(
+        """
+    doc     | vx | vy
+    apple   | 1  | 0
+    banana  | 0  | 1
+    cherry  | 1  | 1
+    """
+    ).select(
+        doc=pw.this.doc,
+        vec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.vx, pw.this.vy),
+    )
+
+
+def test_knn_query_as_of_now():
+    docs = _make_docs()
+    queries = T(
+        """
+    qid | qx | qy
+    q1  | 1  | 0
+    q2  | 0  | 1
+    """
+    ).select(
+        qid=pw.this.qid,
+        qvec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.qx, pw.this.qy),
+    )
+    factory = BruteForceKnnFactory(dimensions=2, reserved_space=16)
+    index = factory.build_data_index(docs.vec, docs)
+    res = index.query_as_of_now(queries.qvec, number_of_matches=2)
+    rows = run_to_rows(res)
+    by_q = {r[0]: r for r in rows}
+    # q1 -> apple then cherry; q2 -> banana then cherry
+    assert [d["doc"] for d in by_q["q1"][4]] == ["apple", "cherry"]
+    assert [d["doc"] for d in by_q["q2"][4]] == ["banana", "cherry"]
+    scores = by_q["q1"][3]
+    assert scores[0] == pytest.approx(1.0, abs=1e-5)
+
+
+def test_knn_query_flattened():
+    docs = _make_docs()
+    queries = T(
+        """
+    qid | qx | qy
+    q1  | 1  | 0
+    """
+    ).select(
+        qid=pw.this.qid,
+        qvec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.qx, pw.this.qy),
+    )
+    index = BruteForceKnnFactory(dimensions=2, reserved_space=16).build_data_index(
+        docs.vec, docs
+    )
+    res = index.query_as_of_now(queries.qvec, number_of_matches=2, collapse_rows=False)
+    rows = run_to_rows(res)
+    assert len(rows) == 2
+    docs_returned = [r[4]["doc"] for r in rows]
+    assert docs_returned == ["apple", "cherry"]
+
+
+def test_bm25_index():
+    docs = T(
+        """
+    d | text
+    1 | the quick brown fox jumps
+    2 | a lazy dog sleeps all day
+    3 | the dog chases the fox
+    """
+    )
+    queries = T(
+        """
+    q
+    fox
+    dog
+    """
+    )
+    index = TantivyBM25Factory().build_data_index(docs.text, docs)
+    res = index.query_as_of_now(queries.q, number_of_matches=2)
+    rows = run_to_rows(res)
+    by_q = {r[0]: r for r in rows}
+    fox_docs = [d["text"] for d in by_q["fox"][3]]
+    assert fox_docs and all("fox" in t for t in fox_docs)
+    dog_docs = [d["text"] for d in by_q["dog"][3]]
+    assert dog_docs and all("dog" in t for t in dog_docs)
+
+
+def test_hybrid_index_rrf():
+    docs = _make_docs()
+    queries = T(
+        """
+    qid | qx | qy
+    q1  | 1  | 0
+    """
+    ).select(
+        qid=pw.this.qid,
+        qvec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.qx, pw.this.qy),
+    )
+    factory = HybridIndexFactory(
+        retriever_factories=[
+            BruteForceKnnFactory(dimensions=2, reserved_space=16),
+            BruteForceKnnFactory(dimensions=2, reserved_space=16, metric="l2sq"),
+        ]
+    )
+    index = factory.build_data_index(docs.vec, docs)
+    res = index.query_as_of_now(queries.qvec, number_of_matches=2)
+    rows = run_to_rows(res)
+    assert [d["doc"] for d in rows[0][4]][0] == "apple"
+
+
+def test_metadata_filter():
+    docs = T(
+        """
+    doc | vx | vy | owner
+    a   | 1  | 0  | alice
+    b   | 1  | 0  | bob
+    """
+    ).select(
+        doc=pw.this.doc,
+        vec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.vx, pw.this.vy),
+        meta=pw.apply(lambda o: {"owner": o}, pw.this.owner),
+    )
+    queries = T(
+        """
+    qid
+    q1
+    """
+    ).select(
+        qid=pw.this.qid,
+        qvec=pw.apply(lambda _: (1.0, 0.0), pw.this.qid),
+    )
+    factory = BruteForceKnnFactory(dimensions=2, reserved_space=16)
+    index = factory.build_index(docs.vec, docs, metadata_column=docs.meta)
+    from pathway_tpu.stdlib.indexing import DataIndex
+
+    di = DataIndex(docs, index)
+    res = di.query_as_of_now(
+        queries.qvec, number_of_matches=5, metadata_filter="owner == 'bob'"
+    )
+    rows = run_to_rows(res)
+    assert [d["doc"] for d in rows[0][4]] == ["b"]
+
+
+def test_query_fully_consistent_updates():
+    """query() (non-as-of-now) revises answers when the corpus changes."""
+    import threading
+    import time as _time
+
+    import pathway_tpu.io.python as pwpy
+    from pathway_tpu.engine.scheduler import Scheduler
+    from pathway_tpu.internals.parse_graph import G
+
+    class DocSubject(pwpy.ConnectorSubject):
+        def run(self):
+            self.next(doc="first", vx=1.0, vy=0.0)
+            self.commit()
+            _time.sleep(0.3)
+            self.next(doc="better", vx=1.0, vy=0.05)
+            self.commit()
+
+    class DocsSchema(pw.Schema):
+        doc: str
+        vx: float
+        vy: float
+
+    docs_raw = pwpy.read(DocSubject(), schema=DocsSchema)
+    docs = docs_raw.select(
+        doc=pw.this.doc,
+        vec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.vx, pw.this.vy),
+    )
+    queries = T(
+        """
+    qid
+    q1
+    """
+    ).select(qid=pw.this.qid, qvec=pw.apply(lambda _: (1.0, 0.05), pw.this.qid))
+    index = BruteForceKnnFactory(dimensions=2, reserved_space=16).build_data_index(
+        docs.vec, docs
+    )
+    res = index.query(queries.qvec, number_of_matches=1)
+    updates = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: updates.append(
+            (is_addition, [d["doc"] for d in row["_pw_index_reply"]])
+        ),
+    )
+    sched = Scheduler(G.engine_graph, autocommit_ms=20)
+    th = threading.Thread(target=sched.run)
+    th.start()
+    th.join(timeout=10)
+    assert not th.is_alive()
+    # the static query is answered first (possibly before any doc arrives),
+    # then revised as the corpus streams in: ... -> [first] -> [better]
+    assert updates[-1] == (True, ["better"])
+    assert (True, ["first"]) in updates
+    assert (False, ["first"]) in updates
+
+
+def test_compile_filter():
+    f = compile_filter("owner == 'alice' && size > 10")
+    assert f({"owner": "alice", "size": 20})
+    assert not f({"owner": "alice", "size": 5})
+    assert not f({"owner": "bob", "size": 20})
+    g = compile_filter("contains(tags, 'x') || globmatch('*.pdf', path)")
+    assert g({"tags": ["x", "y"], "path": "a.txt"})
+    assert g({"tags": [], "path": "doc.pdf"})
+    assert not g({"tags": [], "path": "doc.txt"})
+    h = compile_filter("modified_at >= `100`")
+    assert h({"modified_at": 150}) and not h({"modified_at": 50})
+
+
+def test_bm25_adapter_incremental():
+    a = BM25Adapter()
+    a.add([(1, "apple pie recipe"), (2, "banana bread recipe")])
+    r = a.search(["apple"], [2], [None])
+    assert [k for k, _ in r[0]] == [1]
+    a.remove([1])
+    r = a.search(["apple"], [2], [None])
+    assert r[0] == []
+    # upsert
+    a.add([(2, "apple tart")])
+    r = a.search(["apple"], [2], [None])
+    assert [k for k, _ in r[0]] == [2]

@@ -1,0 +1,81 @@
+"""End-to-end VectorStoreServer slice: docs -> split -> TPU embed ->
+sharded KNN -> REST retrieve (BASELINE config #2 parity)."""
+
+import dataclasses
+import socket
+import time
+
+import jax.numpy as jnp
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.models import MINILM_L6
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm.embedders import TPUEncoderEmbedder
+from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient, VectorStoreServer
+from tests.utils import T
+
+TINY = dataclasses.replace(
+    MINILM_L6, layers=2, hidden=64, heads=4, mlp_dim=128, dtype=jnp.float32
+)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_vector_store_server_roundtrip():
+    port = _free_port()
+    docs = T(
+        """
+    d | data
+    1 | apples grow on trees in the orchard
+    2 | bananas are yellow tropical fruit
+    3 | the tpu runs matrix multiplications very fast indeed
+    """
+    ).select(
+        data=pw.this.data,
+        _metadata=pw.apply(lambda d: {"path": f"/docs/{d}.txt", "modified_at": int(d)}, pw.this.d),
+    )
+    server = VectorStoreServer(
+        docs,
+        index_factory=BruteForceKnnFactory(
+            embedder=TPUEncoderEmbedder(config=TINY), reserved_space=32
+        ),
+        splitter=TokenCountSplitter(min_tokens=1, max_tokens=100),
+    )
+    thread = server.run_server("127.0.0.1", port, threaded=True)
+    assert thread is not None
+
+    client = VectorStoreClient(port=port)
+    deadline = time.monotonic() + 60
+    result = None
+    while time.monotonic() < deadline:
+        try:
+            result = client.query("bananas", k=2)
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert result is not None, "server did not come up"
+    assert len(result) == 2
+    assert all("text" in d and "score" in d for d in result)
+
+    stats = client.get_vectorstore_statistics()
+    assert stats["file_count"] == 3
+
+    inputs = client.get_input_files(filepath_globpattern="*2.txt")
+    assert [f["path"] for f in inputs] == ["/docs/2.txt"]
+
+    # glob filter through retrieval
+    filtered = client.query("fruit", k=5, filepath_globpattern="*1.txt")
+    assert len(filtered) == 1
+
+    from pathway_tpu.internals.parse_graph import G
+
+    G.active_scheduler.stop()
+    thread.join(timeout=5)

@@ -70,3 +70,9 @@ def assert_stream_equality(actual: pw.Table, expected: pw.Table) -> None:
 def stream_rows(table: pw.Table) -> list[tuple[Any, tuple, int, int]]:
     (_, stream), = _run_capture(table)
     return stream
+
+
+def run_to_rows(table: pw.Table) -> list[tuple]:
+    """Final state as a deterministically ordered list of value tuples."""
+    (rows, _), = _run_capture(table)
+    return sorted(rows.values(), key=repr)
