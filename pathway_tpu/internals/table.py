@@ -789,6 +789,97 @@ class Table:
             )
         return out
 
+    # -- temporal (reference exposes these as Table methods too) -------------
+    def windowby(self, time_expr: Any, *, window: Any, behavior: Any = None, instance: Any = None, shard: Any = None) -> Any:
+        from pathway_tpu.stdlib.temporal import windowby as _windowby
+
+        return _windowby(self, time_expr, window=window, behavior=behavior, instance=instance, shard=shard)
+
+    def interval_join(self, other: "Table", self_time: Any, other_time: Any, interval: Any, *on: Any, **kw: Any) -> Any:
+        from pathway_tpu.stdlib.temporal import interval_join as _ij
+
+        return _ij(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_inner(self, other, self_time, other_time, interval, *on, **kw):
+        from pathway_tpu.stdlib.temporal import interval_join_inner as _f
+
+        return _f(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_left(self, other, self_time, other_time, interval, *on, **kw):
+        from pathway_tpu.stdlib.temporal import interval_join_left as _f
+
+        return _f(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_right(self, other, self_time, other_time, interval, *on, **kw):
+        from pathway_tpu.stdlib.temporal import interval_join_right as _f
+
+        return _f(self, other, self_time, other_time, interval, *on, **kw)
+
+    def interval_join_outer(self, other, self_time, other_time, interval, *on, **kw):
+        from pathway_tpu.stdlib.temporal import interval_join_outer as _f
+
+        return _f(self, other, self_time, other_time, interval, *on, **kw)
+
+    def asof_join(self, other, self_time, other_time, *on, **kw):
+        from pathway_tpu.stdlib.temporal import asof_join as _f
+
+        return _f(self, other, self_time, other_time, *on, **kw)
+
+    def asof_join_left(self, other, self_time, other_time, *on, **kw):
+        from pathway_tpu.stdlib.temporal import asof_join_left as _f
+
+        return _f(self, other, self_time, other_time, *on, **kw)
+
+    def asof_join_right(self, other, self_time, other_time, *on, **kw):
+        from pathway_tpu.stdlib.temporal import asof_join_right as _f
+
+        return _f(self, other, self_time, other_time, *on, **kw)
+
+    def asof_join_outer(self, other, self_time, other_time, *on, **kw):
+        from pathway_tpu.stdlib.temporal import asof_join_outer as _f
+
+        return _f(self, other, self_time, other_time, *on, **kw)
+
+    def asof_now_join(self, other, *on, **kw):
+        from pathway_tpu.stdlib.temporal import asof_now_join as _f
+
+        return _f(self, other, *on, **kw)
+
+    def asof_now_join_inner(self, other, *on, **kw):
+        from pathway_tpu.stdlib.temporal import asof_now_join_inner as _f
+
+        return _f(self, other, *on, **kw)
+
+    def asof_now_join_left(self, other, *on, **kw):
+        from pathway_tpu.stdlib.temporal import asof_now_join_left as _f
+
+        return _f(self, other, *on, **kw)
+
+    def window_join(self, other, self_time, other_time, window, *on, **kw):
+        from pathway_tpu.stdlib.temporal import window_join as _f
+
+        return _f(self, other, self_time, other_time, window, *on, **kw)
+
+    def window_join_inner(self, other, self_time, other_time, window, *on):
+        from pathway_tpu.stdlib.temporal import window_join_inner as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
+    def window_join_left(self, other, self_time, other_time, window, *on):
+        from pathway_tpu.stdlib.temporal import window_join_left as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
+    def window_join_right(self, other, self_time, other_time, window, *on):
+        from pathway_tpu.stdlib.temporal import window_join_right as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
+    def window_join_outer(self, other, self_time, other_time, window, *on):
+        from pathway_tpu.stdlib.temporal import window_join_outer as _f
+
+        return _f(self, other, self_time, other_time, window, *on)
+
     # -- sorting / misc -------------------------------------------------------
     def sort(self, key: Any = None, instance: Any = None) -> "Table":
         from pathway_tpu.stdlib.ordered import sort as _sort

@@ -14,7 +14,9 @@ from pathway_tpu.internals.expression import ColumnReference
 
 class ThisMetaclass(type):
     def __getattr__(cls, name: str) -> Any:
-        if name.startswith("_"):
+        # block python-internal probes but allow framework columns
+        # (pw.this._pw_window_start etc.)
+        if name.startswith("_") and not name.startswith("_pw_"):
             raise AttributeError(name)
         return ColumnReference(cls, name)
 

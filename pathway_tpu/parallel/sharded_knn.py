@@ -120,7 +120,8 @@ class ShardedKnnIndex:
         """Upsert (key, vector) pairs; one donated scatter per epoch batch."""
         if not items:
             return
-        while len(self._slot_of) + len(items) > self.capacity:
+        n_new = sum(1 for key, _v in items if key not in self._slot_of)
+        while len(self._slot_of) + n_new > self.capacity:
             self._grow()
         slots = np.empty(len(items), np.int32)
         vals = np.empty((len(items), self.dim), np.dtype(self.dtype))

@@ -20,15 +20,34 @@ from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndexFactory
 __all__ = ["DocumentStore", "SlidesDocumentStore"]
 
 
-def _merge_filters(metadata_filter: str | None, globpattern: str | None) -> str | None:
+def _merge_filters(metadata_filter: str | None, globpattern: str | None):
     """Combine a metadata filter with a path glob (reference
-    ``merge_filters``, ``document_store.py:356``)."""
-    clauses = []
+    ``merge_filters``, ``document_store.py:356``).  Returns a CALLABLE
+    (metadata -> bool) so glob patterns never pass through string
+    interpolation (no quoting/injection issues); a malformed filter
+    fails CLOSED (rejects everything) rather than disabling filtering."""
+    import fnmatch
+
+    if not metadata_filter and not globpattern:
+        return None
+    meta_fn = None
     if metadata_filter:
-        clauses.append(f"({metadata_filter})")
-    if globpattern:
-        clauses.append(f"globmatch('{globpattern}', path)")
-    return " && ".join(clauses) if clauses else None
+        from pathway_tpu.stdlib.indexing.filters import compile_filter
+
+        try:
+            meta_fn = compile_filter(metadata_filter)
+        except Exception:
+            return lambda m: False  # fail closed on malformed filters
+
+    def run(meta: dict | None) -> bool:
+        m = meta or {}
+        if meta_fn is not None and not meta_fn(m):
+            return False
+        if globpattern and not fnmatch.fnmatch(str(m.get("path", "")), globpattern):
+            return False
+        return True
+
+    return run
 
 
 class DocumentStore:
@@ -205,13 +224,10 @@ class DocumentStore:
         )
 
         def filter_files(result, metadata_filter, globpattern):
-            from pathway_tpu.stdlib.indexing.filters import compile_filter
-
             items = [dict(m) for m in (result or ())]
             merged = _merge_filters(metadata_filter, globpattern)
-            if merged:
-                f = compile_filter(merged)
-                items = [m for m in items if f(m)]
+            if merged is not None:
+                items = [m for m in items if merged(m)]
             return items
 
         return queries.join_left(files, id=queries.id).select(

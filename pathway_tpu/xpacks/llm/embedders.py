@@ -141,9 +141,10 @@ class _ApiEmbedder(BaseEmbedder):
     def _embed_batch(self, texts: list[str]) -> list:
         import asyncio
 
-        return asyncio.run(
-            asyncio.gather(*[self.__wrapped__(t) for t in texts])
-        )
+        async def run_all() -> list:
+            return await asyncio.gather(*[self.__wrapped__(t) for t in texts])
+
+        return asyncio.run(run_all())
 
 
 class OpenAIEmbedder(_ApiEmbedder):

@@ -27,19 +27,24 @@ def _docs_text(docs: list) -> str:
 NO_INFO = "No information found."
 
 
-@udf
-def prompt_qa(
+def prompt_qa_geometric_rag(
     query: str,
     docs: list,
     information_not_found_response: str = NO_INFO,
     additional_rules: str = "",
 ) -> str:
+    """Plain-function QA template (used directly inside the adaptive RAG
+    loop, reference ``answer_with_geometric_rag_strategy``)."""
     return (
         "Use the below documents to answer the question. If the documents "
         f"do not contain the answer, reply exactly: {information_not_found_response}"
         f"{additional_rules}\n\nDocuments:\n{_docs_text(docs)}\n\n"
         f"Question: {query}\nAnswer:"
     )
+
+
+#: the same template as a column UDF
+prompt_qa = udf(prompt_qa_geometric_rag)
 
 
 @udf
@@ -78,17 +83,3 @@ def prompt_query_rewrite(query: str) -> str:
     )
 
 
-def prompt_qa_geometric_rag(
-    query: str,
-    docs: list,
-    information_not_found_response: str = NO_INFO,
-    additional_rules: str = "",
-) -> str:
-    """Plain-function variant used inside the adaptive RAG loop
-    (reference ``answer_with_geometric_rag_strategy``)."""
-    return (
-        "Use the below documents to answer the question. If the documents "
-        f"do not contain the answer, reply exactly: {information_not_found_response}"
-        f"{additional_rules}\n\nDocuments:\n{_docs_text(docs)}\n\n"
-        f"Question: {query}\nAnswer:"
-    )

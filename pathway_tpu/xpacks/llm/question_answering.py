@@ -227,16 +227,24 @@ def answer_with_geometric_rag_strategy_from_index(
     strict_prompt: bool = False,
 ) -> Table:
     """reference ``:162`` — retrieval + geometric answering as a Table op.
-    Retrieves max-needed docs once as-of-now, escalates over prefixes."""
+    ``documents_column`` names the column of the INDEXED table holding the
+    document text (reference semantics); the questions table must have a
+    ``query`` column.  Retrieves max-needed docs once as-of-now, escalates
+    over prefixes."""
     k_max = n_starting_documents * (factor ** (max_iterations - 1))
-    query_col = questions[documents_column._name] if hasattr(documents_column, "_name") else questions.query
+    doc_col = (
+        documents_column._name
+        if hasattr(documents_column, "_name")
+        else str(documents_column)
+    )
+    query_col = questions.query
     replies = index.query_as_of_now(
         query_col, number_of_matches=k_max, metadata_filter=metadata_filter
     )
 
     def run_strategy(question: str, datas: tuple) -> str:
         docs = [
-            (d or {}).get("text", "") if isinstance(d, dict) else str(d)
+            str((d or {}).get(doc_col, "")) if isinstance(d, dict) else str(d)
             for d in (datas or ())
         ]
         return answer_with_geometric_rag_strategy(

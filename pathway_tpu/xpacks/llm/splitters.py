@@ -64,14 +64,22 @@ class TokenCountSplitter(UDF):
                 for s in range(0, len(words), step):
                     chunks.append(" ".join(words[s : s + step]))
                 continue
-            if cur_tokens + pt > self.max_tokens and cur_tokens >= self.min_tokens:
+            # max_tokens is a hard ceiling: close the chunk whenever adding
+            # the next sentence would overflow it
+            if cur and cur_tokens + pt > self.max_tokens:
                 chunks.append(cur)
                 cur, cur_tokens = piece, pt
             else:
                 cur = f"{cur} {piece}".strip() if cur else piece
                 cur_tokens += pt
         if cur:
-            if chunks and self._count(cur) < self.min_tokens:
+            # a trailing fragment below min_tokens merges back only when the
+            # combined chunk still respects max_tokens
+            if (
+                chunks
+                and cur_tokens < self.min_tokens
+                and self._count(chunks[-1]) + cur_tokens <= self.max_tokens
+            ):
                 chunks[-1] = f"{chunks[-1]} {cur}"
             else:
                 chunks.append(cur)

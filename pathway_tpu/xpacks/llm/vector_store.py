@@ -112,6 +112,8 @@ class VectorStoreClient:
         timeout: float = 60,
     ):
         if url is None:
+            if port is None:
+                raise ValueError("VectorStoreClient needs a port (or a full url)")
             url = f"http://{host or '127.0.0.1'}:{port}"
         self.url = url.rstrip("/")
         self.timeout = timeout

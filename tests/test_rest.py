@@ -37,15 +37,21 @@ def test_rest_connector_roundtrip():
     sched = Scheduler(G.engine_graph, autocommit_ms=10)
     run_t = threading.Thread(target=sched.run, daemon=True)
     run_t.start()
-    time.sleep(0.5)  # let the server come up
 
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/",
         data=json.dumps({"query": "hello"}).encode(),
         headers={"Content-Type": "application/json"},
     )
-    with urllib.request.urlopen(req, timeout=10) as resp:
-        body = json.loads(resp.read())
+    body = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                body = json.loads(resp.read())
+            break
+        except (ConnectionError, urllib.error.URLError):
+            time.sleep(0.2)  # server still coming up
     assert body == "HELLO"
 
     # second request exercises the steady-state path

@@ -1,0 +1,251 @@
+"""Temporal stdlib: windows, behaviors, interval/asof/asof_now joins."""
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import temporal
+from tests.utils import T, assert_table_equality_wo_index, run_to_rows
+
+
+def test_tumbling_window_reduce():
+    t = T(
+        """
+    t  | v
+    1  | 10
+    2  | 20
+    11 | 1
+    12 | 2
+    25 | 5
+    """
+    )
+    res = t.windowby(pw.this.t, window=temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+        n=pw.reducers.count(),
+    )
+    rows = sorted((r[1], r[2], r[3]) for r in run_to_rows(res))
+    assert rows == [(0, 30, 2), (10, 3, 2), (20, 5, 1)]
+
+
+def test_sliding_window_assigns_multiple():
+    t = T(
+        """
+    t | v
+    5 | 1
+    """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.sliding(hop=2, duration=6)
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    starts = sorted(r[1] for r in run_to_rows(res))
+    # windows [0,6) [2,8) [4,10) contain t=5
+    assert starts == [0, 2, 4]
+
+
+def test_session_window():
+    t = T(
+        """
+    t  | v
+    1  | 1
+    2  | 1
+    3  | 1
+    20 | 1
+    21 | 1
+    """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.session(max_gap=5)
+    ).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        n=pw.reducers.count(),
+    )
+    rows = sorted((r[1], r[2], r[3]) for r in run_to_rows(res))
+    assert rows == [(1, 3, 3), (20, 21, 2)]
+
+
+def test_window_behavior_forget():
+    """keep_results=False drops windows once the watermark passes
+    window_end + cutoff (reference forget semantics)."""
+    t = T(
+        """
+    t   | v   | __time__
+    1   | 1   | 2
+    2   | 1   | 2
+    30  | 1   | 4
+    """
+    )
+    res = t.windowby(
+        pw.this.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.common_behavior(cutoff=5, keep_results=False),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    rows = run_to_rows(res)
+    # window [0,10) expired when t=30 arrived (30 >= 10+5); only [30,40) left
+    assert [(r[1], r[2]) for r in rows] == [(30, 1)]
+
+
+def test_exactly_once_behavior_buffers():
+    t = T(
+        """
+    t   | v   | __time__
+    1   | 1   | 2
+    2   | 1   | 2
+    11  | 1   | 4
+    30  | 1   | 6
+    """
+    )
+    res = t.windowby(
+        pw.this.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.exactly_once_behavior(),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    from tests.utils import stream_rows
+
+    stream = stream_rows(res)
+    # window [0,10) must be emitted exactly once (no incremental revision)
+    w0 = [s for s in stream if s[1][1] == 0]
+    assert len(w0) == 1 and w0[0][3] == 1 and w0[0][1][2] == 2
+
+
+def test_interval_join_inner():
+    a = T(
+        """
+    t | k | va
+    1 | x | a1
+    5 | x | a5
+    """
+    )
+    b = T(
+        """
+    t | k | vb
+    2 | x | b2
+    9 | x | b9
+    """
+    )
+    res = temporal.interval_join(
+        a, b, a.t, b.t, temporal.interval(-1, 2), pw.left.k == pw.right.k
+    ).select(va=pw.left.va, vb=pw.right.vb)
+    rows = sorted(run_to_rows(res))
+    # pairs with b.t - a.t in [-1, 2]: (a1,b2); (a5, b..): 9-5=4 no; 2-5=-3 no
+    assert rows == [("a1", "b2")]
+
+
+def test_interval_join_outer_unmatched():
+    a = T(
+        """
+    t | va
+    1 | a1
+    9 | a9
+    """
+    )
+    b = T(
+        """
+    t | vb
+    2 | b2
+    """
+    )
+    res = temporal.interval_join_outer(
+        a, b, a.t, b.t, temporal.interval(-1, 1)
+    ).select(va=pw.left.va, vb=pw.right.vb)
+    rows = sorted(run_to_rows(res), key=str)
+    assert (("a1", "b2")) in rows
+    assert ("a9", None) in rows
+
+
+def test_asof_join_backward():
+    trades = T(
+        """
+    t  | k | price
+    3  | x | 100
+    7  | x | 101
+    """
+    )
+    quotes = T(
+        """
+    t | k | quote
+    1 | x | 99
+    5 | x | 100
+    9 | x | 102
+    """
+    )
+    res = temporal.asof_join(
+        trades, quotes, trades.t, quotes.t, pw.left.k == pw.right.k
+    ).select(price=pw.left.price, quote=pw.right.quote)
+    rows = sorted(run_to_rows(res))
+    # t=3 -> quote at 1; t=7 -> quote at 5
+    assert rows == [(100, 99), (101, 100)]
+
+
+def test_asof_now_join_no_revision():
+    """asof_now answers once; later right-side rows don't revise."""
+    import threading
+    import time as _time
+
+    from pathway_tpu.engine.scheduler import Scheduler
+    from pathway_tpu.internals.parse_graph import G
+
+    class RightSubject(pw.io.python.ConnectorSubject):
+        def run(self):
+            self.next(k="x", r="r1")
+            self.commit()
+            _time.sleep(0.5)
+            self.next(k="x", r="r2")
+            self.commit()
+
+    class LeftSubject(pw.io.python.ConnectorSubject):
+        def run(self):
+            _time.sleep(0.25)  # after r1, before r2
+            self.next(k="x", l="l1")
+            self.commit()
+
+    class RightSchema(pw.Schema):
+        k: str
+        r: str
+
+    class LeftSchema(pw.Schema):
+        k: str
+        l: str
+
+    left = pw.io.python.read(LeftSubject(), schema=LeftSchema)
+    right = pw.io.python.read(RightSubject(), schema=RightSchema)
+    res = temporal.asof_now_join(left, right, pw.left.k == pw.right.k).select(
+        l=pw.left.l, r=pw.right.r
+    )
+    updates = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: updates.append(
+            (is_addition, row["r"])
+        ),
+    )
+    sched = Scheduler(G.engine_graph, autocommit_ms=20)
+    th = threading.Thread(target=sched.run)
+    th.start()
+    th.join(timeout=10)
+    assert not th.is_alive()
+    adds = [u for u in updates if u[0]]
+    # the left row is answered against the right state at its arrival
+    # (r1 only) and never revised when r2 arrives later
+    assert adds == [(True, "r1")]
+    assert not [u for u in updates if not u[0]]  # no retractions
+
+
+def test_window_join():
+    a = T(
+        """
+    t | va
+    1 | a1
+    11| a11
+    """
+    )
+    b = T(
+        """
+    t | vb
+    2 | b2
+    12| b12
+    """
+    )
+    res = temporal.window_join(
+        a, b, a.t, b.t, temporal.tumbling(duration=10)
+    ).select(va=pw.left.va, vb=pw.right.vb)
+    rows = sorted(run_to_rows(res))
+    assert rows == [("a1", "b2"), ("a11", "b12")]
