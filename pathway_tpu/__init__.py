@@ -129,6 +129,22 @@ def __getattr__(name: str) -> Any:
         from pathway_tpu.internals.iterate import iterate
 
         return iterate
+    if name == "iterate_universe":
+        from pathway_tpu.internals.iterate import iterate_universe
+
+        return iterate_universe
+    if name == "enable_interactive_mode":
+        from pathway_tpu.internals.interactive import enable_interactive_mode
+
+        return enable_interactive_mode
+    if name == "LiveTable":
+        from pathway_tpu.internals.interactive import LiveTable
+
+        return LiveTable
+    if name == "viz":
+        import pathway_tpu.stdlib.viz as viz
+
+        return viz
     if name == "sql":
         from pathway_tpu.internals.sql import sql
 

@@ -1,0 +1,83 @@
+"""``pathway_tpu`` CLI (reference ``python/pathway/cli.py:53-319``):
+``spawn`` runs a program under N processes x M threads;
+``spawn-from-env`` reads the command from PATHWAY_SPAWN_ARGS.
+
+Process topology env contract matches the reference
+(``src/engine/dataflow/config.rs:86-120``): PATHWAY_THREADS,
+PATHWAY_PROCESSES, PATHWAY_PROCESS_ID, PATHWAY_FIRST_PORT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+__all__ = ["main", "spawn"]
+
+
+def spawn(
+    threads: int,
+    processes: int,
+    first_port: int,
+    program: str,
+    arguments: list[str],
+    record: bool = False,
+    record_path: str | None = None,
+) -> int:
+    env_base = dict(os.environ)
+    env_base["PATHWAY_THREADS"] = str(threads)
+    env_base["PATHWAY_PROCESSES"] = str(processes)
+    env_base["PATHWAY_FIRST_PORT"] = str(first_port)
+    if record:
+        env_base["PATHWAY_PERSISTENT_STORAGE"] = record_path or "./record"
+        env_base["PATHWAY_PERSISTENCE_MODE"] = "persisting"
+    if processes <= 1:
+        env_base["PATHWAY_PROCESS_ID"] = "0"
+        return subprocess.call([program, *arguments], env=env_base)
+    procs = []
+    for pid in range(processes):
+        env = dict(env_base)
+        env["PATHWAY_PROCESS_ID"] = str(pid)
+        procs.append(subprocess.Popen([program, *arguments], env=env))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="pathway_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("spawn", help="run a pipeline with worker topology")
+    sp.add_argument("--threads", "-t", type=int, default=1)
+    sp.add_argument("--processes", "-n", type=int, default=1)
+    sp.add_argument("--first-port", type=int, default=10000)
+    sp.add_argument("--record", action="store_true")
+    sp.add_argument("--record-path", default=None)
+    sp.add_argument("program")
+    sp.add_argument("arguments", nargs=argparse.REMAINDER)
+
+    se = sub.add_parser("spawn-from-env", help="spawn using $PATHWAY_SPAWN_ARGS")
+
+    args = parser.parse_args(argv)
+    if args.command == "spawn":
+        return spawn(
+            args.threads,
+            args.processes,
+            args.first_port,
+            args.program,
+            args.arguments,
+            record=args.record,
+            record_path=args.record_path,
+        )
+    if args.command == "spawn-from-env":
+        spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "").split()
+        return main(["spawn", *spawn_args])
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,10 @@ from pathway_tpu.internals.keys import Pointer
 class ConnectorEvents:
     """Callback bundle handed to a connector subject's reader thread."""
 
+    #: with persistence, the number of already-replayed events this reader
+    #: should skip (cooperative resume; see pathway_tpu.persistence)
+    resume_offset: int = 0
+
     def __init__(
         self,
         q: "queue.Queue",
@@ -71,6 +75,8 @@ class Scheduler:
                 self.consumers[inp.id].append((node, port))
         self.ctx = RunContext(n_workers=n_workers, worker_id=worker_id)
         self._stop = threading.Event()
+        #: persistence hooks (set by pathway_tpu.persistence.attach_persistence)
+        self.persistence: Any = None
 
     # ------------------------------------------------------------------
     def run_epoch(self, time: int, inject: dict[int, Batch]) -> None:
@@ -120,22 +126,55 @@ class Scheduler:
             return self.ctx
 
         # --- streaming mode -------------------------------------------
-        q: "queue.Queue" = queue.Queue()
-        threads: list[threading.Thread] = []
-        for node in live_inputs:
-            events = ConnectorEvents(q, node.id, self._stop)
-            t = threading.Thread(
-                target=self._run_subject, args=(node, events), daemon=True
-            )
-            t.start()
-            threads.append(t)
-
-        open_subjects = {n.id for n in live_inputs}
-        buffers: dict[int, list[Update]] = defaultdict(list)
         t = 0
         if static_inject:
             self.run_epoch(t, static_inject)
             t += TIME_STEP
+
+        # persistence: replay committed input snapshots as leading epochs
+        replayed_counts: dict[int, int] = {}
+        if self.persistence is not None:
+            for node in live_inputs:
+                events = self.persistence.replay_events(node)
+                replayed_counts[node.id] = sum(
+                    1 for kind, _k, _v in events if kind != "commit"
+                )
+                epoch: list[Update] = []
+                for kind, key, values in events:
+                    if kind == "add":
+                        epoch.append(Update(key, values, 1))
+                    elif kind == "remove":
+                        epoch.append(Update(key, values, -1))
+                    elif kind == "commit" and epoch:
+                        self.run_epoch(t, {node.id: epoch})
+                        t += TIME_STEP
+                        epoch = []
+            if self.persistence.replay_only:
+                self.ctx.time = t
+                self._finish()
+                return self.ctx
+
+        q: "queue.Queue" = queue.Queue()
+        threads: list[threading.Thread] = []
+        for node in live_inputs:
+            events: Any = ConnectorEvents(q, node.id, self._stop)
+            if self.persistence is not None:
+                events = self.persistence.wrap_events(
+                    node, events, replayed_counts.get(node.id, 0)
+                )
+            t_ = threading.Thread(
+                target=self._run_subject, args=(node, events), daemon=True
+            )
+            t_.start()
+            threads.append(t_)
+
+        # auxiliary inputs (loopbacks) never keep the run alive by
+        # themselves: the run ends when all primaries closed AND every
+        # auxiliary reports no pending work
+        primaries = [n for n in live_inputs if not getattr(n, "auxiliary", False)]
+        auxiliaries = [n for n in live_inputs if getattr(n, "auxiliary", False)]
+        open_subjects = {n.id for n in primaries}
+        buffers: dict[int, list[Update]] = defaultdict(list)
         last_cut = _time.monotonic()
         commit_requested = False
         while True:
@@ -164,8 +203,16 @@ class Scheduler:
                 self.run_epoch(t, inject)
                 t += TIME_STEP
                 last_cut = now
-            if not open_subjects and q.empty() and not any(buffers.values()):
-                break
+            if not open_subjects and not any(buffers.values()):
+                # order matters: loopback workers enqueue their result BEFORE
+                # decrementing pending, so pending==0 guarantees every result
+                # is already visible to the q.empty() check after it
+                pending = sum(
+                    getattr(n.subject, "pending_count", lambda: 0)()
+                    for n in auxiliaries
+                )
+                if pending == 0 and q.empty():
+                    break
             if self._stop.is_set():
                 break
         self.ctx.time = t

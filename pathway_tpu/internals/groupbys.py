@@ -116,9 +116,17 @@ class GroupedTable:
             impl = re_expr._reducer.make_impl(**re_expr._reducer_kwargs)
             arg_fns = [a._compile(layout.resolver) for a in re_expr._args]
             if impl.name in ("argmin", "argmax"):
-                def arg_fn(key, values, arg_fns=arg_fns):
-                    kv = (key, values)
-                    return (arg_fns[0](kv), key)
+                # one arg: returns the extreme row's KEY (reference
+                # semantics); two args: (sort_value, returned_value)
+                if len(arg_fns) == 2:
+                    def arg_fn(key, values, arg_fns=arg_fns):
+                        kv = (key, values)
+                        return (arg_fns[0](kv), arg_fns[1](kv))
+
+                else:
+                    def arg_fn(key, values, arg_fns=arg_fns):
+                        kv = (key, values)
+                        return (arg_fns[0](kv), key)
 
             else:
                 def arg_fn(key, values, arg_fns=arg_fns):
@@ -127,11 +135,19 @@ class GroupedTable:
 
             reducer_args.append((impl, arg_fn))
 
+        # groupby(..., id=col): the group key VALUE (a pointer) becomes the
+        # output row id (reference groupby id= semantics)
+        output_key_fn = None
+        if self._set_id:
+            if len(self._grouping) != 1:
+                raise ValueError("groupby(id=...) needs exactly one grouping column")
+            output_key_fn = lambda gvals: gvals[0]  # noqa: E731
         node = eg.GroupByNode(
             G.engine_graph,
             source._node,
             group_fn,
             reducer_args,
+            output_key_fn=output_key_fn,
             include_group_values=True,
             name="groupby",
         )

@@ -1,0 +1,71 @@
+"""Interactive mode / LiveTable (reference ``internals/interactive.py:37-222``:
+``enable_interactive_mode`` runs the graph in a background thread and
+exposes tables as live snapshots)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Table
+
+__all__ = ["enable_interactive_mode", "LiveTable", "live"]
+
+_interactive = {"enabled": False, "thread": None}
+
+
+def enable_interactive_mode() -> None:
+    """Mark the session interactive: ``live(table)`` snapshots run the
+    graph in the background (reference ``enable_interactive_mode``)."""
+    _interactive["enabled"] = True
+
+
+class LiveTable:
+    """A continuously updated snapshot of a table (reference
+    ``LiveTable``: export/import through the engine; here a subscription
+    feeding a dict)."""
+
+    def __init__(self, table: Table):
+        import pathway_tpu as pw
+
+        self._columns = table._column_names
+        self.rows: dict[Any, tuple] = {}
+        self._lock = threading.Lock()
+
+        def on_change(key, row, time, is_addition):
+            with self._lock:
+                if is_addition:
+                    self.rows[key] = tuple(row.values())
+                else:
+                    self.rows.pop(key, None)
+
+        pw.io.subscribe(table, on_change=on_change, name="live_table")
+
+    def snapshot(self) -> dict[Any, tuple]:
+        with self._lock:
+            return dict(self.rows)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        with self._lock:
+            return pd.DataFrame.from_dict(
+                self.rows, orient="index", columns=self._columns
+            )
+
+    def __repr__(self) -> str:
+        return f"<LiveTable {len(self.rows)} rows: {self._columns}>"
+
+
+def live(table: Table, *, start: bool = True) -> LiveTable:
+    """Create a LiveTable and (by default) start the run in the
+    background if not already running."""
+    lt = LiveTable(table)
+    if start and _interactive["thread"] is None:
+        import pathway_tpu as pw
+
+        th = threading.Thread(target=pw.run, daemon=True, name="pw_interactive")
+        th.start()
+        _interactive["thread"] = th
+    return lt

@@ -1,0 +1,67 @@
+"""Monitoring dashboard (reference ``internals/monitoring.py:56-232``:
+rich-based live TUI driven by ProberStats)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+__all__ = ["MonitoringLevel", "ProberStats", "start_dashboard"]
+
+
+class MonitoringLevel:
+    NONE = "none"
+    IN_OUT = "in_out"
+    ALL = "all"
+    AUTO = "auto"
+
+
+@dataclass
+class ProberStats:
+    """Per-run stats snapshot (reference ``ProberStats``,
+    ``src/engine/graph.rs:554-566``)."""
+
+    epoch: int = 0
+    operators: int = 0
+    errors: int = 0
+    input_rows: int = 0
+    output_rows: int = 0
+    latency_ms: float | None = None
+    connectors: dict[str, dict] = field(default_factory=dict)
+
+
+def collect_stats(sched: Any) -> ProberStats:
+    ctx = sched.ctx
+    return ProberStats(
+        epoch=ctx.time,
+        operators=len(sched.graph.nodes),
+        errors=len(ctx.error_log),
+    )
+
+
+def start_dashboard(sched: Any, refresh_per_second: float = 4.0) -> threading.Thread:
+    """Live rich dashboard on the terminal (call before ``sched.run``)."""
+    from rich.live import Live
+    from rich.table import Table as RichTable
+
+    def render() -> RichTable:
+        stats = collect_stats(sched)
+        t = RichTable(title="pathway_tpu")
+        t.add_column("metric")
+        t.add_column("value")
+        t.add_row("epoch", str(stats.epoch))
+        t.add_row("operators", str(stats.operators))
+        t.add_row("errors", str(stats.errors))
+        return t
+
+    def loop() -> None:
+        with Live(render(), refresh_per_second=refresh_per_second) as live:
+            while not sched._stop.is_set():
+                time.sleep(1.0 / refresh_per_second)
+                live.update(render())
+
+    t = threading.Thread(target=loop, daemon=True, name="pw_dashboard")
+    t.start()
+    return t

@@ -1,0 +1,66 @@
+"""Per-process monitoring HTTP endpoint (reference
+``src/engine/http_server.rs:21-130``): ``/status`` and OpenMetrics
+``/metrics`` on port ``PATHWAY_MONITORING_HTTP_PORT`` (default 20000) +
+process id."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+__all__ = ["start_http_server"]
+
+
+def _metrics_text(sched: Any) -> str:
+    ctx = sched.ctx
+    lines = [
+        "# TYPE pathway_tpu_epoch gauge",
+        f"pathway_tpu_epoch {ctx.time}",
+        "# TYPE pathway_tpu_error_count gauge",
+        f"pathway_tpu_error_count {len(ctx.error_log)}",
+        "# TYPE pathway_tpu_operator_count gauge",
+        f"pathway_tpu_operator_count {len(sched.graph.nodes)}",
+    ]
+    return "\n".join(lines) + "\n# EOF\n"
+
+
+def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
+    if port is None:
+        base = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000"))
+        port = base + int(os.environ.get("PATHWAY_PROCESS_ID", "0"))
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802
+            if self.path.startswith("/status"):
+                body = json.dumps(
+                    {
+                        "epoch": sched.ctx.time,
+                        "operators": len(sched.graph.nodes),
+                        "errors": len(sched.ctx.error_log),
+                    }
+                ).encode()
+                ctype = "application/json"
+            elif self.path.startswith("/metrics"):
+                body = _metrics_text(sched).encode()
+                ctype = "application/openmetrics-text"
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args: Any) -> None:
+            pass
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True, name="pw_monitoring")
+    t.start()
+    sched._monitoring_server = server
+    return t

@@ -1,0 +1,379 @@
+"""``pw.sql(query, **tables)`` — SQL over Tables (reference
+``internals/sql.py``, 726 LoC, built on SQLGlot).
+
+SQLGlot isn't available in this environment, so this is a hand-rolled
+translator for the practical subset: SELECT (expressions, aliases, *),
+FROM, INNER/LEFT JOIN ... ON equalities, WHERE, GROUP BY, HAVING, and
+the SUM/COUNT/AVG/MIN/MAX aggregates.  Produces the same incremental
+Table operations a hand-written pipeline would.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from pathway_tpu.internals.expression import ColumnExpression, _wrap
+from pathway_tpu.internals.table import Table
+
+__all__ = ["sql"]
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<num>\d+\.\d+|\d+)"
+    r"|(?P<str>'[^']*')"
+    r"|(?P<name>[A-Za-z_][A-Za-z_0-9]*)"
+    r"|(?P<op><=|>=|<>|!=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.))"
+)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "as", "join",
+    "inner", "left", "right", "outer", "on", "and", "or", "not", "union",
+    "all", "distinct",
+}
+
+_AGGS = {"sum", "count", "avg", "min", "max"}
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN.match(src, pos)
+        if m is None:
+            if src[pos:].strip() == "":
+                break
+            raise ValueError(f"SQL syntax error near: {src[pos:pos+30]!r}")
+        pos = m.end()
+        for kind in ("num", "str", "name", "op"):
+            v = m.group(kind)
+            if v is not None:
+                if kind == "name" and v.lower() in _KEYWORDS:
+                    out.append(("kw", v.lower()))
+                else:
+                    out.append((kind, v))
+                break
+    out.append(("end", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def at_kw(self, *kws: str) -> bool:
+        k, v = self.peek()
+        return k == "kw" and v in kws
+
+    def eat(self, kind=None, value=None):
+        k, v = self.toks[self.i]
+        if (kind and k != kind) or (value and v != value):
+            raise ValueError(f"unexpected {v!r} (wanted {value or kind})")
+        self.i += 1
+        return v
+
+    # ---- expressions (AST: tuples) ----
+    def expr(self):
+        return self._or()
+
+    def _or(self):
+        left = self._and()
+        while self.at_kw("or"):
+            self.eat()
+            left = ("or", left, self._and())
+        return left
+
+    def _and(self):
+        left = self._not()
+        while self.at_kw("and"):
+            self.eat()
+            left = ("and", left, self._not())
+        return left
+
+    def _not(self):
+        if self.at_kw("not"):
+            self.eat()
+            return ("not", self._not())
+        return self._cmp()
+
+    def _cmp(self):
+        left = self._add()
+        k, v = self.peek()
+        if k == "op" and v in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            self.eat()
+            return ("cmp", v, left, self._add())
+        return left
+
+    def _add(self):
+        left = self._mul()
+        while self.peek() == ("op", "+") or self.peek() == ("op", "-"):
+            op = self.eat()
+            left = ("bin", op, left, self._mul())
+        return left
+
+    def _mul(self):
+        left = self._atom()
+        while self.peek()[0] == "op" and self.peek()[1] in ("*", "/", "%"):
+            op = self.eat()
+            left = ("bin", op, left, self._atom())
+        return left
+
+    def _atom(self):
+        k, v = self.peek()
+        if k == "op" and v == "(":
+            self.eat()
+            e = self.expr()
+            self.eat("op", ")")
+            return e
+        if k == "num":
+            self.eat()
+            return ("lit", float(v) if "." in v else int(v))
+        if k == "str":
+            self.eat()
+            return ("lit", v[1:-1])
+        if k == "op" and v == "*":
+            self.eat()
+            return ("star",)
+        if k == "name":
+            self.eat()
+            name = v
+            if self.peek() == ("op", "("):
+                self.eat()
+                if self.peek() == ("op", "*"):
+                    self.eat()
+                    args: list = [("star",)]
+                elif self.peek() == ("op", ")"):
+                    args = []
+                else:
+                    args = [self.expr()]
+                    while self.peek() == ("op", ","):
+                        self.eat()
+                        args.append(self.expr())
+                self.eat("op", ")")
+                return ("call", name.lower(), args)
+            if self.peek() == ("op", "."):
+                self.eat()
+                col = self.eat("name")
+                return ("col", name, col)
+            return ("col", None, name)
+        raise ValueError(f"unexpected token {v!r} in expression")
+
+    # ---- statement ----
+    def select(self) -> dict:
+        self.eat("kw", "select")
+        if self.at_kw("distinct"):
+            self.eat()
+        items = []
+        while True:
+            e = self.expr()
+            alias = None
+            if self.at_kw("as"):
+                self.eat()
+                alias = self.eat("name")
+            elif self.peek()[0] == "name":
+                alias = self.eat("name")
+            items.append((e, alias))
+            if self.peek() == ("op", ","):
+                self.eat()
+                continue
+            break
+        self.eat("kw", "from")
+        table = self.eat("name")
+        joins = []
+        while self.at_kw("join", "inner", "left", "right", "outer"):
+            how = "inner"
+            while self.at_kw("inner", "left", "right", "outer"):
+                how = self.eat()
+            self.eat("kw", "join")
+            jt = self.eat("name")
+            self.eat("kw", "on")
+            cond = self.expr()
+            joins.append((how, jt, cond))
+        where = None
+        if self.at_kw("where"):
+            self.eat()
+            where = self.expr()
+        group_by = []
+        if self.at_kw("group"):
+            self.eat()
+            self.eat("kw", "by")
+            group_by.append(self.expr())
+            while self.peek() == ("op", ","):
+                self.eat()
+                group_by.append(self.expr())
+        having = None
+        if self.at_kw("having"):
+            self.eat()
+            having = self.expr()
+        self.eat("end")
+        return {
+            "items": items,
+            "table": table,
+            "joins": joins,
+            "where": where,
+            "group_by": group_by,
+            "having": having,
+        }
+
+
+def _has_agg(ast) -> bool:
+    if not isinstance(ast, tuple):
+        return False
+    if ast[0] == "call" and ast[1] in _AGGS:
+        return True
+    return any(_has_agg(c) for c in ast[1:] if isinstance(c, (tuple, list)))
+
+
+class _Translator:
+    def __init__(self, tables: dict[str, Table]):
+        self.tables = tables
+
+    def column(self, table_hint: str | None, name: str, scope: Table) -> ColumnExpression:
+        if table_hint is not None:
+            t = self.tables.get(table_hint)
+            if t is None:
+                raise KeyError(f"unknown table {table_hint!r}")
+            return t[name]
+        return scope[name]
+
+    def to_expr(self, ast, scope: Table) -> Any:
+        import pathway_tpu as pw
+
+        kind = ast[0]
+        if kind == "lit":
+            return ast[1]
+        if kind == "col":
+            return self.column(ast[1], ast[2], scope)
+        if kind == "cmp":
+            op, a, b = ast[1], self.to_expr(ast[2], scope), self.to_expr(ast[3], scope)
+            a, b = _wrap(a), _wrap(b)
+            return {
+                "=": a == b, "!=": a != b, "<>": a != b,
+                "<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b,
+            }[op]
+        if kind == "bin":
+            op, a, b = ast[1], _wrap(self.to_expr(ast[2], scope)), _wrap(self.to_expr(ast[3], scope))
+            return {"+": a + b, "-": a - b, "*": a * b, "/": a / b, "%": a % b}[op]
+        if kind == "and":
+            return _wrap(self.to_expr(ast[1], scope)) & _wrap(self.to_expr(ast[2], scope))
+        if kind == "or":
+            return _wrap(self.to_expr(ast[1], scope)) | _wrap(self.to_expr(ast[2], scope))
+        if kind == "not":
+            return ~_wrap(self.to_expr(ast[1], scope))
+        if kind == "call":
+            name, args = ast[1], ast[2]
+            if name in _AGGS:
+                if name == "count":
+                    return pw.reducers.count()
+                red = {
+                    "sum": pw.reducers.sum, "avg": pw.reducers.avg,
+                    "min": pw.reducers.min, "max": pw.reducers.max,
+                }[name]
+                return red(self.to_expr(args[0], scope))
+            raise ValueError(f"unsupported SQL function {name!r}")
+        raise ValueError(f"cannot translate {ast!r}")
+
+    def default_name(self, ast) -> str:
+        if ast[0] == "col":
+            return ast[2]
+        if ast[0] == "call":
+            return ast[1]
+        return "expr"
+
+
+def sql(query: str, **tables: Table) -> Table:
+    """Run a SQL query against keyword-named tables::
+
+        pw.sql("SELECT owner, SUM(pets) AS total FROM t GROUP BY owner", t=t)
+    """
+    ast = _Parser(_tokenize(query)).select()
+    tr = _Translator(tables)
+    base = tables.get(ast["table"])
+    if base is None:
+        raise KeyError(f"unknown table {ast['table']!r} (pass it as a kwarg)")
+
+    scope = base
+    for how, jt_name, cond in ast["joins"]:
+        jt = tables.get(jt_name)
+        if jt is None:
+            raise KeyError(f"unknown table {jt_name!r}")
+        if cond[0] != "cmp" or cond[1] != "=":
+            raise ValueError("JOIN ON must be an equality")
+        left_e = tr.to_expr(cond[2], scope)
+        right_e = tr.to_expr(cond[3], scope)
+        jr = {
+            "inner": scope.join,
+            "left": scope.join_left,
+            "right": scope.join_right,
+            "outer": scope.join_outer,
+        }[how](jt, _wrap(left_e) == _wrap(right_e))
+        import pathway_tpu as pw
+
+        seen: dict[str, Any] = {}
+        for c in scope._column_names:
+            seen[c] = pw.left[c]
+        for c in jt._column_names:
+            if c not in seen:
+                seen[c] = pw.right[c]
+        scope = jr.select(**seen)
+
+    if ast["where"] is not None:
+        scope = scope.filter(_wrap(tr.to_expr(ast["where"], scope)))
+
+    items = ast["items"]
+    if ast["group_by"]:
+        group_exprs = [tr.to_expr(g, scope) for g in ast["group_by"]]
+        grouped = scope.groupby(*group_exprs)
+        outs: dict[str, Any] = {}
+        for e_ast, alias in items:
+            if e_ast == ("star",):
+                raise ValueError("SELECT * with GROUP BY is not supported")
+            name = alias or tr.default_name(e_ast)
+            outs[name] = tr.to_expr(e_ast, scope)
+        having_ast = ast["having"]
+        hidden: list[str] = []
+        if having_ast is not None:
+            # HAVING may re-state aggregates (HAVING SUM(x) > 2): hoist
+            # them into hidden reduce columns and reference those
+            def hoist(node):
+                if isinstance(node, tuple) and node[0] == "call" and node[1] in _AGGS:
+                    name = f"_pw_having_{len(hidden)}"
+                    hidden.append(name)
+                    outs[name] = tr.to_expr(node, scope)
+                    return ("col", None, name)
+                if isinstance(node, tuple):
+                    return tuple(
+                        hoist(c) if isinstance(c, tuple) else c for c in node
+                    )
+                return node
+
+            having_ast = hoist(having_ast)
+        result = grouped.reduce(**outs)
+        if having_ast is not None:
+            result = result.filter(_wrap(tr.to_expr(having_ast, result)))
+            if hidden:
+                keep = [c for c in result._column_names if c not in hidden]
+                result = result.select(**{c: result[c] for c in keep})
+        return result
+
+    if any(_has_agg(e) for e, _ in items):
+        outs = {}
+        for e_ast, alias in items:
+            name = alias or tr.default_name(e_ast)
+            outs[name] = tr.to_expr(e_ast, scope)
+        return scope.reduce(**outs)
+
+    if len(items) == 1 and items[0][0] == ("star",):
+        return scope
+    outs = {}
+    for e_ast, alias in items:
+        if e_ast == ("star",):
+            for c in scope._column_names:
+                outs[c] = scope[c]
+            continue
+        name = alias or tr.default_name(e_ast)
+        outs[name] = tr.to_expr(e_ast, scope)
+    return scope.select(**outs)

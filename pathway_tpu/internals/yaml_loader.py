@@ -1,0 +1,108 @@
+"""``pw.load_yaml`` — deployable app templates (reference
+``internals/yaml_loader.py:74-218``): ``$var`` references and
+``!pw.some.Class`` instantiation tags."""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, IO
+
+import yaml
+
+__all__ = ["load_yaml"]
+
+
+class _Tagged:
+    def __init__(self, path: str, value: Any):
+        self.path = path
+        self.value = value
+
+
+def _construct_tagged(loader: yaml.Loader, tag_suffix: str, node: yaml.Node) -> Any:
+    if isinstance(node, yaml.MappingNode):
+        value = loader.construct_mapping(node, deep=True)
+    elif isinstance(node, yaml.SequenceNode):
+        value = loader.construct_sequence(node, deep=True)
+    else:
+        value = loader.construct_scalar(node)
+    return _Tagged(tag_suffix, value)
+
+
+class _Loader(yaml.SafeLoader):
+    pass
+
+
+_Loader.add_multi_constructor("!", _construct_tagged)
+
+
+def _resolve_path(path: str) -> Any:
+    """'pw.xpacks.llm.embedders.TPUEncoderEmbedder' -> the object."""
+    parts = path.split(".")
+    if parts[0] in ("pw", "pathway", "pathway_tpu"):
+        module: Any = importlib.import_module("pathway_tpu")
+        parts = parts[1:]
+    else:
+        module = importlib.import_module(parts[0])
+        parts = parts[1:]
+    obj = module
+    for i, p in enumerate(parts):
+        try:
+            obj = getattr(obj, p)
+        except AttributeError:
+            # maybe a submodule not yet imported
+            obj = importlib.import_module(
+                obj.__name__ + "." + p if hasattr(obj, "__name__") else p
+            )
+    return obj
+
+
+def _instantiate(node: Any, variables: dict[str, Any]) -> Any:
+    if isinstance(node, _Tagged):
+        target = _resolve_path(node.path)
+        value = _instantiate(node.value, variables)
+        if isinstance(value, dict):
+            return target(**value) if callable(target) else target
+        if value in (None, ""):
+            return target() if callable(target) else target
+        if isinstance(value, list):
+            return target(*value)
+        return target(value)
+    if isinstance(node, dict):
+        return {k: _instantiate(v, variables) for k, v in node.items()}
+    if isinstance(node, list):
+        return [_instantiate(v, variables) for v in node]
+    if isinstance(node, str) and node.startswith("$"):
+        name = node[1:]
+        if name in variables:
+            return variables[name]
+        raise KeyError(f"undefined yaml variable ${name}")
+    return node
+
+
+def load_yaml(stream: str | bytes | IO) -> Any:
+    """Parse a config with ``$var`` references and ``!pw.x.y.Class`` object
+    tags (reference ``pw.load_yaml``)."""
+    raw = yaml.load(stream, Loader=_Loader)  # noqa: S506 — custom safe loader
+    if not isinstance(raw, dict):
+        return _instantiate(raw, {})
+    # top-level keys are $variables for each other, regardless of document
+    # order: resolve iteratively, deferring keys whose $refs aren't ready yet
+    variables: dict[str, Any] = {}
+    todo = dict(raw)
+    while todo:
+        progressed = False
+        deferred: dict[str, Any] = {}
+        last_error: Exception | None = None
+        for key, value in todo.items():
+            try:
+                variables[key] = _instantiate(value, variables)
+                progressed = True
+            except KeyError as e:
+                deferred[key] = value
+                last_error = e
+        if not progressed:
+            raise KeyError(
+                f"unresolvable yaml variable reference(s): {last_error}"
+            )
+        todo = deferred
+    return variables

@@ -30,6 +30,10 @@ class RowSource:
     """Engine-facing subject: ``run(events)`` called on a reader thread with
     an event sink (add/remove/commit/close)."""
 
+    #: True for readers that re-emit their full history deterministically
+    #: (enables count-based persistence resume; see pathway_tpu.persistence)
+    deterministic_replay = False
+
     def run(self, events: Any) -> None:  # pragma: no cover
         raise NotImplementedError
 
@@ -64,6 +68,7 @@ def input_table(
     static_rows: Iterable[tuple[K.Pointer, tuple]] = (),
     name: str = "connector",
     upsert: bool = False,
+    auxiliary: bool = False,
 ) -> Table:
     cols = schema.column_names()
     node = eg.InputNode(
@@ -74,6 +79,10 @@ def input_table(
         name=name,
         upsert=upsert,
     )
+    # auxiliary inputs (e.g. AsyncTransformer loopbacks) don't keep the
+    # run alive on their own; the scheduler exits when primaries close
+    # and auxiliaries report no pending work
+    node.auxiliary = auxiliary
     dtypes = {c: schema.__columns__[c].dtype for c in cols}
     return Table(node, cols, dtypes, name=name)
 
@@ -81,6 +90,8 @@ def input_table(
 class DictSource(RowSource):
     """Reader emitting parsed dict rows via a user-supplied generator; commits
     an epoch per ``commit_every`` rows or ``commit_interval`` seconds."""
+
+    deterministic_replay = True
 
     def __init__(
         self,

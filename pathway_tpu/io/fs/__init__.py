@@ -38,6 +38,7 @@ def _list_files(path: str) -> list[str]:
 
 
 class _FilesSource(RowSource):
+    deterministic_replay = True
     """Reads lines of files under a path; in streaming mode polls for new
     files and appended lines (reference filesystem scanner + dir watching)."""
 

@@ -1,0 +1,271 @@
+"""``pw.persistence`` — checkpoint / resume / record / replay.
+
+Capability parity with the reference persistence layer
+(``src/persistence/``: input snapshots ``input_snapshot.rs:32-218``,
+tracker ``tracker.rs:26-63``, backends ``backends/``; Python API
+``python/pathway/persistence/__init__.py:13-165``).  Mechanism is
+re-designed for the epoch-synchronous engine:
+
+- **input snapshots**: every connector event (add/remove/commit) is
+  appended to the backend per input node; on restart the log is replayed
+  as the first epochs (same consistency: rewind to the last committed
+  frontier, reference ``Connector::rewind_from_disk_snapshot``),
+  and cooperative readers skip the already-delivered prefix via
+  ``events.resume_offset``.
+- **UDF caching**: ``DiskCache`` keys results under the same backend
+  (reference ``PersistenceMode::UdfCaching``).
+- **record/replay modes**: ``RealtimeReplay``/``SpeedrunReplay`` replay
+  the log INSTEAD of reading live sources (reference
+  ``src/connectors/mod.rs:108-116``).
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import pickle
+import threading
+from typing import Any
+
+__all__ = ["Backend", "Config", "PersistenceMode", "attach_persistence"]
+
+
+class PersistenceMode(enum.Enum):
+    BATCH = "batch"
+    PERSISTING = "persisting"
+    SELECTIVE_PERSISTING = "selective_persisting"
+    OPERATOR_PERSISTING = "operator_persisting"
+    UDF_CACHING = "udf_caching"
+    REALTIME_REPLAY = "realtime_replay"
+    SPEEDRUN_REPLAY = "speedrun_replay"
+
+
+class _BackendImpl:
+    def append(self, stream: str, record: bytes) -> None:
+        raise NotImplementedError
+
+    def read_all(self, stream: str) -> list[bytes]:
+        raise NotImplementedError
+
+    def put_meta(self, data: dict) -> None:
+        raise NotImplementedError
+
+    def get_meta(self) -> dict:
+        raise NotImplementedError
+
+
+class _MemoryBackend(_BackendImpl):
+    _stores: dict[str, dict] = {}
+
+    def __init__(self, namespace: str = "default"):
+        store = self._stores.setdefault(namespace, {"streams": {}, "meta": {}})
+        self._streams = store["streams"]
+        self._meta = store["meta"]
+        self._lock = threading.Lock()
+
+    def append(self, stream, record):
+        with self._lock:
+            self._streams.setdefault(stream, []).append(record)
+
+    def read_all(self, stream):
+        return list(self._streams.get(stream, []))
+
+    def put_meta(self, data):
+        self._meta.clear()
+        self._meta.update(data)
+
+    def get_meta(self):
+        return dict(self._meta)
+
+
+class _FsBackend(_BackendImpl):
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _stream_path(self, stream: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in stream)
+        return os.path.join(self.path, f"{safe}.log")
+
+    def append(self, stream, record):
+        with self._lock:
+            with open(self._stream_path(stream), "ab") as f:
+                f.write(len(record).to_bytes(8, "little"))
+                f.write(record)
+                f.flush()
+                os.fsync(f.fileno())
+
+    def read_all(self, stream):
+        path = self._stream_path(stream)
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(8)
+                if len(header) < 8:
+                    break
+                n = int.from_bytes(header, "little")
+                payload = f.read(n)
+                if len(payload) < n:
+                    break  # torn tail write: rewind to last complete record
+                out.append(payload)
+        return out
+
+    def put_meta(self, data):
+        tmp = os.path.join(self.path, "metadata.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, os.path.join(self.path, "metadata.json"))
+
+    def get_meta(self):
+        path = os.path.join(self.path, "metadata.json")
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return json.load(f)
+
+
+class Backend:
+    """reference ``pw.persistence.Backend`` factory methods."""
+
+    def __init__(self, impl: _BackendImpl, kind: str):
+        self._impl = impl
+        self.kind = kind
+
+    @classmethod
+    def filesystem(cls, path: str | os.PathLike) -> "Backend":
+        return cls(_FsBackend(os.fspath(path)), "filesystem")
+
+    @classmethod
+    def memory(cls, namespace: str = "default") -> "Backend":
+        return cls(_MemoryBackend(namespace), "memory")
+
+    mock = memory
+
+    @classmethod
+    def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
+        raise NotImplementedError(
+            "S3 persistence needs the boto3 package (unavailable in this "
+            "environment); use Backend.filesystem"
+        )
+
+    azure = s3
+
+
+class Config:
+    """reference ``pw.persistence.Config``."""
+
+    def __init__(
+        self,
+        backend: Backend,
+        *,
+        snapshot_interval_ms: int = 0,
+        persistence_mode: PersistenceMode = PersistenceMode.PERSISTING,
+        continue_after_replay: bool = True,
+    ):
+        self.backend = backend
+        self.snapshot_interval_ms = snapshot_interval_ms
+        self.persistence_mode = persistence_mode
+        self.continue_after_replay = continue_after_replay
+
+    @classmethod
+    def simple_config(cls, backend: Backend, **kwargs: Any) -> "Config":
+        return cls(backend, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# engine attachment
+
+
+class _RecordingEvents:
+    """Wraps ConnectorEvents: drops the first ``resume_offset`` data
+    events (the reader re-produces what the snapshot already replayed —
+    deterministic readers re-emit in the same order) and records every
+    NEW event to the snapshot log."""
+
+    def __init__(self, inner: Any, impl: _BackendImpl, stream: str, resume_offset: int):
+        self._inner = inner
+        self._impl = impl
+        self._stream = stream
+        self.resume_offset = resume_offset
+
+    @property
+    def stopped(self) -> bool:
+        return self._inner.stopped
+
+    def _record_and_forward(self, kind: str, key, values, forward) -> None:
+        if self.resume_offset > 0:
+            self.resume_offset -= 1
+            return
+        self._impl.append(self._stream, pickle.dumps((kind, key, values)))
+        forward(key, values)
+
+    def add(self, key, values):
+        self._record_and_forward("add", key, values, self._inner.add)
+
+    def remove(self, key, values):
+        self._record_and_forward("remove", key, values, self._inner.remove)
+
+    def commit(self):
+        if self.resume_offset > 0:
+            return  # still skipping the replayed prefix: don't re-log commits
+        self._impl.append(self._stream, pickle.dumps(("commit", None, None)))
+        self._inner.commit()
+
+    def close(self):
+        self._inner.close()
+
+
+class PersistenceHooks:
+    """Installed on the Scheduler by :func:`attach_persistence`."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.impl = config.backend._impl
+        self.replay_only = config.persistence_mode in (
+            PersistenceMode.REALTIME_REPLAY,
+            PersistenceMode.SPEEDRUN_REPLAY,
+        )
+
+    def stream_name(self, node: Any) -> str:
+        return f"input_{node.name}_{node.id}"
+
+    @staticmethod
+    def _replayable(node: Any) -> bool:
+        """Count-based resume is only sound for readers that re-emit their
+        history deterministically in the same order (file-style sources).
+        Live-only subjects (e.g. custom python connectors, Kafka from the
+        live position) opt in by setting ``deterministic_replay = True``."""
+        return bool(getattr(node.subject, "deterministic_replay", False))
+
+    def replay_events(self, node: Any) -> list[tuple[str, Any, Any]]:
+        """Committed events for this input (uncommitted tail dropped —
+        rewind to the last committed frontier)."""
+        if not self.replay_only and not self._replayable(node):
+            return []
+        records = [pickle.loads(r) for r in self.impl.read_all(self.stream_name(node))]
+        last_commit = -1
+        for i, (kind, _k, _v) in enumerate(records):
+            if kind == "commit":
+                last_commit = i
+        return records[: last_commit + 1]
+
+    def wrap_events(self, node: Any, events: Any, replayed: int) -> Any:
+        if self.replay_only:
+            return events
+        return _RecordingEvents(events, self.impl, self.stream_name(node), replayed)
+
+
+def attach_persistence(sched: Any, config: Config) -> None:
+    """Install persistence hooks on a Scheduler (called by ``pw.run``)."""
+    if config.persistence_mode == PersistenceMode.UDF_CACHING:
+        # UDF DiskCache reads PATHWAY_PERSISTENT_STORAGE
+        if isinstance(config.backend._impl, _FsBackend):
+            os.environ.setdefault(
+                "PATHWAY_PERSISTENT_STORAGE", config.backend._impl.path
+            )
+        return
+    sched.persistence = PersistenceHooks(config)

@@ -1,0 +1,183 @@
+"""``pw.graphs`` — graph algorithms over streaming edge tables
+(reference ``python/pathway/stdlib/graphs/``: ``graph.py:77,121``,
+``bellman_ford/impl.py``, ``pagerank/impl.py``,
+``louvain_communities/impl.py``).  All incremental via ``pw.iterate``."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.internals.table import Table
+
+__all__ = [
+    "Graph",
+    "WeightedGraph",
+    "bellman_ford",
+    "pagerank",
+    "louvain_level",
+    "louvain_communities",
+]
+
+
+@dataclasses.dataclass
+class Graph:
+    """Edges table with columns u, v (reference ``graphs/graph.py:77``)."""
+
+    edges: Table
+
+    def without_self_loops(self) -> "Graph":
+        return Graph(self.edges.filter(pw.this.u != pw.this.v))
+
+
+@dataclasses.dataclass
+class WeightedGraph(Graph):
+    """Edges carry a ``weight`` column (reference ``graph.py:121``)."""
+
+    @classmethod
+    def from_edges(cls, edges: Table, weight: Any = None) -> "WeightedGraph":
+        if weight is not None and getattr(weight, "_name", "weight") != "weight":
+            edges = edges.select(u=pw.this.u, v=pw.this.v, weight=weight)
+        return cls(edges)
+
+
+def bellman_ford(vertices: Table, edges: Table) -> Table:
+    """Single-source shortest paths (reference
+    ``graphs/bellman_ford/impl.py``): ``vertices`` has a ``dist`` column
+    (0 for sources, None/inf otherwise); ``edges`` has u, v, dist."""
+    import math
+
+    INF = math.inf
+
+    start = vertices.select(
+        dist=pw.apply(lambda d: INF if d is None else float(d), pw.this.dist)
+    )
+
+    def body(state: Table, edges: Table) -> Table:
+        # candidate distances: via each incoming edge
+        relaxed = edges.join(state, pw.left.u == pw.right.id).select(
+            v=pw.left.v,
+            cand=pw.apply(
+                lambda du, w: du + float(w), pw.right.dist, pw.left.dist
+            ),
+        )
+        best = relaxed.groupby(relaxed.v, id=relaxed.v).reduce(
+            cand=pw.reducers.min(relaxed.cand)
+        )
+        improved = state.join_left(
+            best, pw.left.id == pw.right.id, id=pw.left.id
+        ).select(
+            dist=pw.apply(
+                lambda d, c: d if c is None else min(d, c),
+                pw.left.dist,
+                pw.right.cand,
+            ),
+        )
+        return improved
+
+    # join on vertex ids: state is keyed by vertex key; edges are
+    # read-only context inside the fixpoint
+    return pw.iterate(body, state=start, edges=edges)
+
+
+def pagerank(edges: Table, steps: int = 5, damping: float = 0.85) -> Table:
+    """PageRank over an edge table u->v (reference
+    ``graphs/pagerank/impl.py``; integer arithmetic there, floats here)."""
+    vertices = (
+        edges.select(w=pw.this.u)
+        .concat_reindex(edges.select(w=pw.this.v))
+        .groupby(pw.this.w)
+        .reduce(w=pw.this.w)
+    )
+    degrees = edges.groupby(edges.u).reduce(u=edges.u, deg=pw.reducers.count())
+    ranks = vertices.select(w=pw.this.w, rank=pw.apply(lambda _w: 1.0, pw.this.w))
+
+    for _ in range(steps):
+        contrib = (
+            edges.join(ranks, pw.left.u == pw.right.w)
+            .select(v=pw.left.v, part=pw.right.rank, u=pw.left.u)
+            .join(degrees, pw.left.u == pw.right.u)
+            .select(
+                v=pw.left.v,
+                part=pw.apply(lambda r, d: r / d, pw.left.part, pw.right.deg),
+            )
+        )
+        summed = contrib.groupby(contrib.v).reduce(
+            v=contrib.v, total=pw.reducers.sum(contrib.part)
+        )
+        ranks = vertices.join_left(
+            summed, pw.left.w == pw.right.v, id=pw.left.id
+        ).select(
+            w=pw.left.w,
+            rank=pw.apply(
+                lambda t, d=damping: (1 - d) + d * (t or 0.0), pw.right.total
+            ),
+        )
+    return ranks
+
+
+def louvain_level(G: WeightedGraph, iterations: int = 10) -> Table:
+    """One level of Louvain community detection (reference
+    ``louvain_communities/impl.py``, simplified single-level greedy pass):
+    returns a table keyed by vertex with a ``community`` column."""
+    edges = G.edges
+    vertices = (
+        edges.select(w=pw.this.u)
+        .concat_reindex(edges.select(w=pw.this.v))
+        .groupby(pw.this.w, id=pw.this.w)
+        .reduce(w=pw.this.w)
+    )
+    comm0 = vertices.select(node=pw.this.w, community=pw.this.w)
+
+    # host-side greedy modularity pass over the (small) aggregated edge set
+    packed_edges = edges.reduce(
+        all_edges=pw.reducers.tuple(
+            pw.apply(lambda u, v, w: (u, v, float(w)), pw.this.u, pw.this.v, pw.this.weight)
+        )
+    )
+
+    def assign(node, all_edges):
+        import collections
+
+        adj: dict = collections.defaultdict(dict)
+        total_w = 0.0
+        for u, v, w in all_edges or ():
+            adj[u][v] = adj[u].get(v, 0.0) + w
+            adj[v][u] = adj[v].get(u, 0.0) + w
+            total_w += w
+        if total_w == 0:
+            return node
+        comm = {n: n for n in adj}
+        deg = {n: sum(adj[n].values()) for n in adj}
+        for _ in range(iterations):
+            moved = False
+            for n in sorted(adj, key=str):
+                best, best_gain = comm[n], 0.0
+                neigh_comms: dict = collections.defaultdict(float)
+                for m, w in adj[n].items():
+                    if m != n:
+                        neigh_comms[comm[m]] += w
+                sigma = collections.defaultdict(float)
+                for m in adj:
+                    if m != n:
+                        sigma[comm[m]] += deg[m]
+                for c, w_in in sorted(neigh_comms.items(), key=lambda kv: str(kv[0])):
+                    gain = w_in / total_w - deg[n] * sigma[c] / (2 * total_w**2)
+                    if gain > best_gain:
+                        best, best_gain = c, gain
+                if best != comm[n]:
+                    comm[n] = best
+                    moved = True
+            if not moved:
+                break
+        return comm.get(node, node)
+
+    joined = comm0.join_left(packed_edges, id=pw.left.id).select(
+        node=pw.left.node,
+        community=pw.apply(assign, pw.left.node, pw.right.all_edges),
+    )
+    return joined
+
+
+louvain_communities = louvain_level

@@ -1,0 +1,7 @@
+"""``pw.ml`` (reference ``python/pathway/stdlib/ml/``): legacy KNNIndex,
+classifiers, HMM, smart-table fuzzy join."""
+
+from pathway_tpu.stdlib.ml.index import KNNIndex
+from pathway_tpu.stdlib.ml import classifiers, hmm, smart_table_ops
+
+__all__ = ["KNNIndex", "classifiers", "hmm", "smart_table_ops"]

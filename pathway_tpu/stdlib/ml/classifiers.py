@@ -1,0 +1,52 @@
+"""kNN classifiers (reference ``stdlib/ml/classifiers/`` — LSH-bucketed
+kNN with majority vote, ``_knn_lsh.py:64-306``).  Here the candidate
+search is the exact TPU index; voting logic matches the reference."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.ml.index import KNNIndex
+
+__all__ = ["knn_lsh_classifier_train", "knn_lsh_train", "knn_lsh_classify"]
+
+
+def knn_lsh_train(
+    data: Table,
+    L: int = 20,
+    d: int | None = None,
+    M: int = 10,
+    A: float = 10.0,
+    type: str = "euclidean",  # noqa: A002 — reference parameter name
+    embedding_column: str = "data",
+    label_column: str = "label",
+) -> KNNIndex:
+    """Build the classifier index (reference ``knn_lsh_classifier_train``)."""
+    assert d is not None, "pass d (embedding dimensions)"
+    return KNNIndex(
+        data[embedding_column], data, n_dimensions=d, n_or=L, n_and=M,
+        bucket_length=A, distance_type=type,
+    )
+
+
+knn_lsh_classifier_train = knn_lsh_train
+
+
+def knn_lsh_classify(
+    index: KNNIndex, data_queries: Any, queries: Table | None = None, k: int = 3
+) -> Table:
+    """Classify queries by majority vote over the k nearest neighbours
+    (reference ``knn_lsh_classify``)."""
+    replies = index.get_nearest_items(data_queries, k=k, collapse_rows=True)
+
+    def vote(labels) -> Any:
+        from collections import Counter
+
+        labels = [l for l in (labels or ()) if l is not None]
+        if not labels:
+            return None
+        return Counter(labels).most_common(1)[0][0]
+
+    return replies.select(predicted_label=pw.apply(vote, replies.label))

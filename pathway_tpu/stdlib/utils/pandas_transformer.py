@@ -1,0 +1,72 @@
+"""``pandas_transformer`` (reference ``stdlib/utils/pandas_transformer.py``):
+run a pandas function over whole tables per epoch."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import pathway_tpu as pw
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+
+__all__ = ["pandas_transformer"]
+
+
+def pandas_transformer(
+    output_schema: sch.SchemaMetaclass, output_universe: Any = None
+) -> Callable:
+    """Decorator: the wrapped function receives pandas DataFrames (one per
+    input table) and returns a DataFrame matching ``output_schema``."""
+
+    def wrapper(fun: Callable) -> Callable:
+        def transformer(*tables: Table) -> Table:
+            import pandas as pd
+
+            first = tables[0]
+            cols_list = [t._column_names for t in tables]
+
+            def run_batch(*col_lists) -> list:
+                # rebuild one DataFrame per input table
+                dfs = []
+                start = 0
+                for t_cols in cols_list:
+                    data = {
+                        c: col_lists[start + i] for i, c in enumerate(t_cols)
+                    }
+                    dfs.append(pd.DataFrame(data))
+                    start += len(t_cols)
+                out_df = fun(*dfs)
+                out_cols = output_schema.column_names()
+                return [
+                    tuple(row[c] for c in out_cols)
+                    for _, row in out_df.reset_index(drop=True).iterrows()
+                ]
+
+            if len(tables) != 1:
+                raise NotImplementedError(
+                    "pandas_transformer currently supports one input table"
+                )
+            t = first
+            res = t.reduce(
+                _pw_rows=pw.reducers.tuple(
+                    pw.apply(lambda *vs: tuple(vs), *[t[c] for c in t._column_names])
+                )
+            )
+
+            def expand(rows_tuple):
+                col_lists = list(zip(*rows_tuple)) if rows_tuple else [[] for _ in t._column_names]
+                return run_batch(*col_lists)
+
+            flat_src = res.select(_pw_out=pw.apply(expand, res["_pw_rows"]))
+            flat = flat_src.flatten(flat_src["_pw_out"])
+            out_cols = output_schema.column_names()
+            return flat.select(
+                **{
+                    c: pw.apply(lambda r, i=i: r[i], flat["_pw_out"])
+                    for i, c in enumerate(out_cols)
+                }
+            )
+
+        return transformer
+
+    return wrapper

