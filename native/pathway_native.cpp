@@ -1,0 +1,246 @@
+// pathway_native — C++ host-runtime hot paths for pathway_tpu.
+//
+// The reference implements its engine hot loops in Rust
+// (src/engine/value.rs Key hashing, src/connectors tokenization); the
+// TPU build keeps the numeric plane in XLA and implements the host-side
+// hot paths here as a CPython extension:
+//
+//   - ref_scalar(args_tuple) / hash_rows(list[tuple]): 128-bit row-key
+//     hashing, byte-for-byte identical to the Python implementation in
+//     pathway_tpu/internals/keys.py (type-tagged serialization into
+//     BLAKE2b-128) — keys are stable across the two paths, which
+//     persistence snapshots rely on.
+//   - scan_lines(bytes): newline scanning for the file data loader.
+//
+// Unsupported value types (big ints, ndarrays, datetimes, arbitrary
+// objects) raise _Unsupported so the caller transparently falls back to
+// the Python path for that call.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "blake2b.h"
+
+namespace {
+
+PyObject* g_unsupported = nullptr;  // exception type for fallback
+PyObject* g_pointer_type = nullptr;  // pathway_tpu Pointer class
+
+const char kSalt[] = "pathway_tpu.key.v1";
+
+struct Hasher {
+    pwnative::Blake2bState S;
+    Hasher() {
+        pwnative::blake2b_init(&S, 16);
+        pwnative::blake2b_update(
+            &S, reinterpret_cast<const uint8_t*>(kSalt), sizeof(kSalt) - 1);
+    }
+    void bytes(const void* p, size_t n) {
+        pwnative::blake2b_update(&S, static_cast<const uint8_t*>(p), n);
+    }
+    void tag(uint8_t t) { bytes(&t, 1); }
+    void u64le(uint64_t v) { bytes(&v, 8); }
+};
+
+// mirror of keys._feed — must stay byte-identical
+bool feed(Hasher& h, PyObject* v) {
+    if (v == Py_None) {
+        h.tag(0x00);
+        return true;
+    }
+    if (PyBool_Check(v)) {
+        h.tag(0x01);
+        h.tag(v == Py_True ? 0x01 : 0x00);
+        return true;
+    }
+    if (g_pointer_type != nullptr &&
+        PyObject_TypeCheck(v, reinterpret_cast<PyTypeObject*>(g_pointer_type))) {
+        uint8_t out[16];
+        if (_PyLong_AsByteArray(reinterpret_cast<PyLongObject*>(v), out, 16,
+                                /*little_endian=*/1, /*is_signed=*/0) < 0) {
+            PyErr_Clear();
+            return false;  // >128-bit pointer: fall back
+        }
+        h.tag(0x07);
+        h.bytes(out, 16);
+        return true;
+    }
+    if (PyLong_Check(v)) {
+        int overflow = 0;
+        long long val = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (overflow != 0) return false;  // big int: fall back
+        // python: n = (bit_length + 8) // 8 + 1 bytes, signed little
+        unsigned long long mag =
+            val < 0 ? (unsigned long long)(-(val + 1)) + 1ULL
+                    : (unsigned long long)val;
+        int bl = 0;
+        while (mag >> bl) bl++;  // bit_length (0 for val==0)
+        int n = (bl + 8) / 8 + 1;
+        uint8_t buf[16];
+        long long x = val;
+        for (int i = 0; i < n; i++) {
+            buf[i] = (uint8_t)(x & 0xff);
+            x >>= 8;  // arithmetic shift: sign-extends
+        }
+        h.tag(0x02);
+        h.bytes(buf, n);
+        return true;
+    }
+    if (PyFloat_Check(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        h.tag(0x03);
+        h.bytes(&d, 8);
+        return true;
+    }
+    if (PyUnicode_Check(v)) {
+        Py_ssize_t n;
+        const char* s = PyUnicode_AsUTF8AndSize(v, &n);
+        if (s == nullptr) return false;
+        h.tag(0x04);
+        h.u64le((uint64_t)n);
+        h.bytes(s, (size_t)n);
+        return true;
+    }
+    if (PyBytes_Check(v)) {
+        h.tag(0x05);
+        h.u64le((uint64_t)PyBytes_GET_SIZE(v));
+        h.bytes(PyBytes_AS_STRING(v), (size_t)PyBytes_GET_SIZE(v));
+        return true;
+    }
+    if (PyTuple_Check(v)) {
+        Py_ssize_t n = PyTuple_GET_SIZE(v);
+        h.tag(0x06);
+        h.u64le((uint64_t)n);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            if (!feed(h, PyTuple_GET_ITEM(v, i))) return false;
+        }
+        return true;
+    }
+    return false;  // datetime / ndarray / other: fall back
+}
+
+PyObject* digest_to_long(Hasher& h) {
+    uint8_t out[16];
+    pwnative::blake2b_final(&h.S, out);
+    return _PyLong_FromByteArray(out, 16, /*little_endian=*/1, /*signed=*/0);
+}
+
+PyObject* py_ref_scalar(PyObject*, PyObject* args_tuple) {
+    Hasher h;
+    Py_ssize_t n = PyTuple_GET_SIZE(args_tuple);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (!feed(h, PyTuple_GET_ITEM(args_tuple, i))) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(g_unsupported, "unsupported value type");
+            return nullptr;
+        }
+    }
+    return digest_to_long(h);
+}
+
+PyObject* py_hash_rows(PyObject*, PyObject* rows) {
+    // rows: sequence of tuples -> list of 128-bit ints
+    PyObject* seq = PySequence_Fast(rows, "hash_rows expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(n);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* row = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(row)) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            PyErr_SetString(PyExc_TypeError, "rows must be tuples");
+            return nullptr;
+        }
+        Hasher h;
+        Py_ssize_t m = PyTuple_GET_SIZE(row);
+        bool ok = true;
+        for (Py_ssize_t j = 0; j < m && ok; j++)
+            ok = feed(h, PyTuple_GET_ITEM(row, j));
+        if (!ok) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            if (!PyErr_Occurred())
+                PyErr_SetString(g_unsupported, "unsupported value type");
+            return nullptr;
+        }
+        PyObject* key = digest_to_long(h);
+        if (key == nullptr) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, i, key);
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
+PyObject* py_scan_lines(PyObject*, PyObject* arg) {
+    // bytes -> list of (start, end) offsets of non-empty lines
+    char* data;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(arg, &data, &len) < 0) return nullptr;
+    std::vector<std::pair<Py_ssize_t, Py_ssize_t>> spans;
+    Py_ssize_t start = 0;
+    for (Py_ssize_t i = 0; i <= len; i++) {
+        if (i == len || data[i] == '\n') {
+            Py_ssize_t end = i;
+            if (end > start && data[end - 1] == '\r') end--;
+            if (end > start) spans.emplace_back(start, end);
+            start = i + 1;
+        }
+    }
+    PyObject* out = PyList_New((Py_ssize_t)spans.size());
+    if (out == nullptr) return nullptr;
+    for (size_t i = 0; i < spans.size(); i++) {
+        PyObject* t = Py_BuildValue("(nn)", spans[i].first, spans[i].second);
+        if (t == nullptr) {
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, (Py_ssize_t)i, t);
+    }
+    return out;
+}
+
+PyObject* py_set_pointer_type(PyObject*, PyObject* cls) {
+    Py_XDECREF(g_pointer_type);
+    Py_INCREF(cls);
+    g_pointer_type = cls;
+    Py_RETURN_NONE;
+}
+
+PyMethodDef kMethods[] = {
+    {"ref_scalar", py_ref_scalar, METH_VARARGS,
+     "128-bit key hash of the argument values"},
+    {"hash_rows", py_hash_rows, METH_O,
+     "batch 128-bit key hashes for a sequence of value tuples"},
+    {"scan_lines", py_scan_lines, METH_O,
+     "offsets of non-empty lines in a bytes buffer"},
+    {"set_pointer_type", py_set_pointer_type, METH_O,
+     "register the Pointer class for type-tagged hashing"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef kModule = {PyModuleDef_HEAD_INIT, "pathway_native",
+                       "pathway_tpu C++ host hot paths", -1, kMethods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_pathway_native(void) {
+    PyObject* m = PyModule_Create(&kModule);
+    if (m == nullptr) return nullptr;
+    g_unsupported =
+        PyErr_NewException("pathway_native.Unsupported", nullptr, nullptr);
+    Py_INCREF(g_unsupported);
+    PyModule_AddObject(m, "Unsupported", g_unsupported);
+    return m;
+}

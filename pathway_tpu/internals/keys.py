@@ -68,13 +68,41 @@ def _feed(h: "hashlib._Hash", value: Any) -> None:
         h.update(b"\x0b" + len(b).to_bytes(8, "little") + b)
 
 
-def ref_scalar(*args: Any) -> Pointer:
-    """Hash a tuple of values into a 128-bit Pointer (reference
-    ``Key::for_values``)."""
+def _py_ref_scalar(*args: Any) -> Pointer:
     h = hashlib.blake2b(_SALT, digest_size=16)
     for a in args:
         _feed(h, a)
     return Pointer(int.from_bytes(h.digest(), "little"))
+
+
+def _load_native():
+    """C++ fast path (native/pathway_native.cpp): byte-identical
+    serialization+hash, so keys are stable across both paths."""
+    from pathway_tpu.internals import native as _native_loader
+
+    mod = _native_loader.load()
+    if mod is not None:
+        mod.set_pointer_type(Pointer)
+    return mod
+
+
+_native = None
+_native_checked = False
+
+
+def ref_scalar(*args: Any) -> Pointer:
+    """Hash a tuple of values into a 128-bit Pointer (reference
+    ``Key::for_values``)."""
+    global _native, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        _native = _load_native()
+    if _native is not None:
+        try:
+            return Pointer(_native.ref_scalar(*args))
+        except _native.Unsupported:
+            pass  # value type outside the C fast path
+    return _py_ref_scalar(*args)
 
 
 def sequential_key(seq: int) -> Pointer:
@@ -103,4 +131,10 @@ def unsafe_pointer(x: int) -> Pointer:
 
 
 def keys_for_values(rows: Iterable[tuple[Any, ...]]) -> list[Pointer]:
+    rows = list(rows)
+    if _native is not None:
+        try:
+            return [Pointer(k) for k in _native.hash_rows(rows)]
+        except _native.Unsupported:
+            pass
     return [ref_scalar(*r) for r in rows]

@@ -1,0 +1,71 @@
+"""Loader for the C++ host-runtime extension (``native/``).
+
+Compiles ``native/pathway_native.cpp`` with g++ on first use (cached
+under ``native/build/``) and exposes it; every caller has a Python
+fallback, and ``PATHWAY_DISABLE_NATIVE=1`` forces it.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Any
+
+_logger = logging.getLogger("pathway_tpu.native")
+_lock = threading.Lock()
+_module: Any = None
+_tried = False
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "pathway_native.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+
+
+def _compile() -> str | None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(_BUILD_DIR, "pathway_native.so")
+    if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(_SRC):
+        return so_path
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++", "-O3", "-march=native", "-funroll-loops", "-shared", "-fPIC",
+        "-std=c++17", f"-I{include}", _SRC, "-o", so_path,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception as e:  # noqa: BLE001
+        _logger.info("native build skipped: %r", e)
+        return None
+    return so_path
+
+
+def load() -> Any:
+    """The compiled module, or None (fallback to Python paths)."""
+    global _module, _tried
+    if _module is not None or _tried:
+        return _module
+    with _lock:
+        if _module is not None or _tried:
+            return _module
+        _tried = True
+        if os.environ.get("PATHWAY_DISABLE_NATIVE") == "1":
+            return None
+        if not os.path.exists(_SRC):
+            return None
+        so_path = _compile()
+        if so_path is None:
+            return None
+        try:
+            spec = importlib.util.spec_from_file_location("pathway_native", so_path)
+            assert spec is not None and spec.loader is not None
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as e:  # noqa: BLE001
+            _logger.info("native load failed: %r", e)
+            return None
+        _module = mod
+        return mod

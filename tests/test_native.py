@@ -1,0 +1,72 @@
+"""C++ host-runtime extension: key-hash parity with the Python path."""
+
+import datetime
+
+import pytest
+
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import native
+
+
+@pytest.fixture(scope="module")
+def mod():
+    m = native.load()
+    if m is None:
+        pytest.skip("native extension unavailable (no g++?)")
+    m.set_pointer_type(K.Pointer)
+    return m
+
+
+CASES = [
+    (),
+    (None,),
+    (True,),
+    (False,),
+    (0,),
+    (1,),
+    (-1,),
+    (255,),
+    (-256,),
+    (2**40,),
+    (-(2**40),),
+    (2**63 - 1,),
+    (-(2**63),),
+    (3.14,),
+    (-0.0,),
+    ("hello",),
+    ("üñïçødé",),
+    (b"bytes",),
+    (("a", 1, (2.5, None)),),
+    ("mix", 42, 3.3, None, True, ("t", (1,))),
+]
+
+
+def test_hash_parity(mod):
+    for case in CASES:
+        assert K.Pointer(mod.ref_scalar(*case)) == K._py_ref_scalar(*case), case
+    assert K.Pointer(mod.ref_scalar(K.Pointer(12345))) == K._py_ref_scalar(
+        K.Pointer(12345)
+    )
+
+
+def test_unsupported_falls_back(mod):
+    with pytest.raises(mod.Unsupported):
+        mod.ref_scalar(2**200)
+    # the public entry point transparently falls back
+    assert K.ref_scalar(2**200) == K._py_ref_scalar(2**200)
+    dt = datetime.datetime(2021, 5, 1)
+    assert K.ref_scalar(dt) == K._py_ref_scalar(dt)
+
+
+def test_hash_rows_batch(mod):
+    rows = [("a", i, float(i)) for i in range(500)]
+    assert [K.Pointer(k) for k in mod.hash_rows(rows)] == [
+        K._py_ref_scalar(*r) for r in rows
+    ]
+
+
+def test_scan_lines(mod):
+    assert mod.scan_lines(b"abc\ndef\r\n\nxy") == [(0, 3), (4, 7), (10, 12)]
+    assert mod.scan_lines(b"") == []
+    assert mod.scan_lines(b"\n\n") == []
+    assert mod.scan_lines(b"no-newline") == [(0, 10)]

@@ -198,6 +198,31 @@ def test_knn_state_roundtrip():
 
 
 # ---------------------------------------------------------------------------
+# ring attention (sequence parallelism over the mesh)
+
+
+def test_ring_attention_matches_local(mesh8):
+    from pathway_tpu.ops.ring_attention import local_attention, ring_attention
+
+    rng = np.random.default_rng(3)
+    b, l, h, d = 2, 32, 4, 16  # L sharded 8-ways -> 4 per device
+    q = jnp.asarray(rng.normal(size=(b, l, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, l, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, l, h, d)).astype(np.float32))
+    mask = np.ones((b, l), np.int32)
+    mask[1, 20:] = 0  # padded tail on one sequence
+    mask = jnp.asarray(mask)
+
+    expected = local_attention(q, k, v, mask)
+    got = jax.jit(
+        lambda q, k, v, m: ring_attention(q, k, v, m, mesh=mesh8)
+    )(q, k, v, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=2e-4, atol=2e-4
+    )
+
+
+# ---------------------------------------------------------------------------
 # executors
 
 
