@@ -77,8 +77,9 @@ bool feed(Hasher& h, PyObject* v) {
         unsigned long long mag =
             val < 0 ? (unsigned long long)(-(val + 1)) + 1ULL
                     : (unsigned long long)val;
-        int bl = 0;
-        while (mag >> bl) bl++;  // bit_length (0 for val==0)
+        // bit_length (0 for val==0); `mag >> bl` would be UB at bl==64
+        // (mag == 2^63 when val == INT64_MIN), so use clz instead.
+        int bl = mag ? 64 - __builtin_clzll(mag) : 0;
         int n = (bl + 8) / 8 + 1;
         uint8_t buf[16];
         long long x = val;
