@@ -10,7 +10,6 @@ stream and formats rows out.  The engine side is
 
 from __future__ import annotations
 
-import itertools
 import json
 import threading
 import time as _time
@@ -23,7 +22,31 @@ from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.internals.table import Table
 
-_autogen_counter = itertools.count()
+class _AutogenCounter:
+    """Process-global sequence for auto-generated row keys.  Unlike
+    ``itertools.count`` it can be observed and fast-forwarded, which
+    persistence uses to guarantee resumed runs never re-issue a sequence
+    number that a replayed key already embeds."""
+
+    def __init__(self) -> None:
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def __next__(self) -> int:
+        with self._lock:
+            v = self._n
+            self._n += 1
+            return v
+
+    def peek(self) -> int:
+        return self._n
+
+    def advance_to(self, n: int) -> None:
+        with self._lock:
+            self._n = max(self._n, n)
+
+
+_autogen_counter = _AutogenCounter()
 
 
 class RowSource:

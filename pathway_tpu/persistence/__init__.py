@@ -23,12 +23,15 @@ from __future__ import annotations
 
 import enum
 import json
+import logging
 import os
 import pickle
 import threading
 from typing import Any
 
 __all__ = ["Backend", "Config", "PersistenceMode", "attach_persistence"]
+
+_logger = logging.getLogger("pathway_tpu.persistence")
 
 
 class PersistenceMode(enum.Enum):
@@ -46,6 +49,12 @@ class _BackendImpl:
         raise NotImplementedError
 
     def read_all(self, stream: str) -> list[bytes]:
+        raise NotImplementedError
+
+    def truncate(self, stream: str, n_records: int) -> None:
+        """Drop every record after the first ``n_records`` (rewind the log
+        to the committed frontier, reference
+        ``Connector::rewind_from_disk_snapshot``)."""
         raise NotImplementedError
 
     def put_meta(self, data: dict) -> None:
@@ -71,6 +80,12 @@ class _MemoryBackend(_BackendImpl):
     def read_all(self, stream):
         return list(self._streams.get(stream, []))
 
+    def truncate(self, stream, n_records):
+        with self._lock:
+            records = self._streams.get(stream)
+            if records is not None and len(records) > n_records:
+                del records[n_records:]
+
     def put_meta(self, data):
         self._meta.clear()
         self._meta.update(data)
@@ -84,6 +99,9 @@ class _FsBackend(_BackendImpl):
         self.path = path
         os.makedirs(path, exist_ok=True)
         self._lock = threading.Lock()
+        #: per-stream end offset of each complete record, filled by the
+        #: read_all scan so truncate() need not rescan multi-GB logs
+        self._offsets: dict[str, list[int]] = {}
 
     def _stream_path(self, stream: str) -> str:
         safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in stream)
@@ -91,6 +109,7 @@ class _FsBackend(_BackendImpl):
 
     def append(self, stream, record):
         with self._lock:
+            self._offsets.pop(stream, None)  # offset cache is now stale
             with open(self._stream_path(stream), "ab") as f:
                 f.write(len(record).to_bytes(8, "little"))
                 f.write(record)
@@ -102,17 +121,49 @@ class _FsBackend(_BackendImpl):
         if not os.path.exists(path):
             return []
         out = []
-        with open(path, "rb") as f:
-            while True:
-                header = f.read(8)
-                if len(header) < 8:
-                    break
-                n = int.from_bytes(header, "little")
-                payload = f.read(n)
-                if len(payload) < n:
-                    break  # torn tail write: rewind to last complete record
-                out.append(payload)
+        offsets = []
+        with self._lock:  # keeps the offset cache consistent vs append
+            with open(path, "rb") as f:
+                while True:
+                    header = f.read(8)
+                    if len(header) < 8:
+                        break
+                    n = int.from_bytes(header, "little")
+                    payload = f.read(n)
+                    if len(payload) < n:
+                        break  # torn tail write: rewind to last complete record
+                    out.append(payload)
+                    offsets.append(f.tell())
+            self._offsets[stream] = offsets
         return out
+
+    def truncate(self, stream, n_records):
+        path = self._stream_path(stream)
+        if not os.path.exists(path):
+            return
+        with self._lock:
+            offsets = self._offsets.get(stream)
+            if offsets is None:  # no prior scan: find record boundaries now
+                keep = 0
+                count = 0
+                with open(path, "rb") as f:
+                    while count < n_records:
+                        header = f.read(8)
+                        if len(header) < 8:
+                            break
+                        n = int.from_bytes(header, "little")
+                        payload = f.read(n)
+                        if len(payload) < n:
+                            break
+                        keep = f.tell()
+                        count += 1
+            else:
+                keep = offsets[n_records - 1] if n_records > 0 else 0
+                del offsets[n_records:]
+            with open(path, "r+b") as f:
+                f.truncate(keep)
+                f.flush()
+                os.fsync(f.fileno())
 
     def put_meta(self, data):
         tmp = os.path.join(self.path, "metadata.json.tmp")
@@ -191,6 +242,7 @@ class _RecordingEvents:
         self._impl = impl
         self._stream = stream
         self.resume_offset = resume_offset
+        self._dirty = False  # data recorded since the last logged commit
 
     @property
     def stopped(self) -> bool:
@@ -201,6 +253,7 @@ class _RecordingEvents:
             self.resume_offset -= 1
             return
         self._impl.append(self._stream, pickle.dumps((kind, key, values)))
+        self._dirty = True
         forward(key, values)
 
     def add(self, key, values):
@@ -212,7 +265,17 @@ class _RecordingEvents:
     def commit(self):
         if self.resume_offset > 0:
             return  # still skipping the replayed prefix: don't re-log commits
-        self._impl.append(self._stream, pickle.dumps(("commit", None, None)))
+        if self._dirty:  # data-free commits would only grow the log
+            # the commit record carries the autogen-counter high-water mark:
+            # every key recorded before it embeds a smaller sequence number,
+            # so resume can fast-forward the counter past all replayed keys
+            from pathway_tpu.io import _connector as _conn
+
+            self._impl.append(
+                self._stream,
+                pickle.dumps(("commit", _conn._autogen_counter.peek(), None)),
+            )
+            self._dirty = False
         self._inner.commit()
 
     def close(self):
@@ -242,20 +305,63 @@ class PersistenceHooks:
         return bool(getattr(node.subject, "deterministic_replay", False))
 
     def replay_events(self, node: Any) -> list[tuple[str, Any, Any]]:
-        """Committed events for this input (uncommitted tail dropped —
-        rewind to the last committed frontier)."""
-        if not self.replay_only and not self._replayable(node):
+        """Committed events for this input, for ALL source kinds (the
+        reference persists and rewinds every input snapshot regardless of
+        reader type).  The uncommitted tail is dropped AND truncated from
+        the on-disk log — otherwise the resumed reader re-records the tail
+        events and the next commit makes both copies committed
+        (double-counting on the second restart).
+
+        Auxiliary loopback inputs (e.g. AsyncTransformer results) are
+        excluded: their rows are recomputed from the replayed upstream, so
+        replaying a recorded copy as well would double-count them."""
+        if getattr(node, "auxiliary", False):
             return []
-        records = [pickle.loads(r) for r in self.impl.read_all(self.stream_name(node))]
+        stream = self.stream_name(node)
+        records = [pickle.loads(r) for r in self.impl.read_all(stream)]
         last_commit = -1
-        for i, (kind, _k, _v) in enumerate(records):
+        counter_mark = 0
+        for i, (kind, k, _v) in enumerate(records):
             if kind == "commit":
                 last_commit = i
+                if isinstance(k, int):  # autogen high-water mark (see commit())
+                    counter_mark = max(counter_mark, k)
+        if not self.replay_only:
+            # unconditionally: also chops torn trailing bytes that read_all
+            # skipped (a crash mid-append), which would otherwise corrupt
+            # records appended after them
+            self.impl.truncate(stream, last_commit + 1)
+        # fast-forward the autogen key counter past every sequence number a
+        # replayed key can embed, so new rows can never collide
+        from pathway_tpu.io import _connector as _conn
+
+        _conn._autogen_counter.advance_to(counter_mark)
         return records[: last_commit + 1]
 
     def wrap_events(self, node: Any, events: Any, replayed: int) -> Any:
         if self.replay_only:
             return events
+        if getattr(node, "auxiliary", False):
+            return events  # loopbacks are never recorded (see replay_events)
+        if replayed and not self._replayable(node):
+            # Non-deterministic reader: it will NOT re-emit its history, so
+            # nothing is skipped.  Readers that track their own positions
+            # (e.g. Kafka offsets) are told how many committed events were
+            # restored so they can seek past them; others get a loud
+            # warning that re-delivered rows would double-count.
+            hook = getattr(node.subject, "on_persistence_resume", None)
+            if hook is not None:
+                hook(replayed)
+            else:
+                _logger.warning(
+                    "input %r resumed from %d persisted events but its reader "
+                    "is not deterministically replayable and defines no "
+                    "on_persistence_resume(n) hook; if it re-delivers old rows "
+                    "they will be double-counted",
+                    getattr(node, "name", node),
+                    replayed,
+                )
+            replayed = 0
         return _RecordingEvents(events, self.impl, self.stream_name(node), replayed)
 
 

@@ -150,3 +150,136 @@ def test_udf_disk_cache(tmp_path, monkeypatch):
     out2 = run_to_rows(t2.select(y=slow(pw.this.x)))
     assert out1 == out2 == [(2,), (4,)]
     assert sorted(calls) == [1, 2]  # second run fully served from cache
+
+
+def test_uncommitted_tail_truncated_on_resume(tmp_path):
+    """ADVICE r1 (high): a crash between commits leaves uncommitted tail
+    records in the snapshot log; resume must truncate them, or the resumed
+    reader re-records them and the second restart double-counts
+    (a:2,b:1 became a:4,b:2)."""
+    import pickle
+
+    input_file = tmp_path / "words.jsonl"
+    input_file.write_text('{"word": "a"}\n{"word": "a"}\n{"word": "b"}')
+
+    results1: dict = {}
+    _build_wordcount(input_file, results1)
+    _run_with_persistence(tmp_path, input_file, results1)
+    assert results1 == {"a": 2, "b": 1}
+
+    # simulate a crash that happened mid-epoch: tail events recorded
+    # without a trailing commit
+    backend = Backend.filesystem(tmp_path / "snapshots")
+    streams = [
+        p.stem for p in (tmp_path / "snapshots").glob("*.log")
+    ]
+    assert len(streams) == 1
+    stream = streams[0]
+    committed = len(backend._impl.read_all(stream))
+    fake_key = __import__("pathway_tpu.internals.keys", fromlist=["ref_scalar"]).ref_scalar("__crash_tail__")
+    backend._impl.append(stream, pickle.dumps(("add", fake_key, ("a",))))
+
+    # restart twice; counts must stay exact both times
+    for _ in range(2):
+        G.clear()
+        results: dict = {}
+        _build_wordcount(input_file, results)
+        _run_with_persistence(tmp_path, input_file, results)
+        assert results == {"a": 2, "b": 1}
+    # and the stale tail is gone from the log
+    assert len(backend._impl.read_all(stream)) == committed
+
+
+def test_nondeterministic_source_replays_committed_history(tmp_path):
+    """ADVICE r1 (medium): sources without deterministic_replay used to
+    have their recorded history silently discarded on restart.  Now the
+    committed log is replayed for them too; the live reader only delivers
+    new events."""
+    from pathway_tpu.io._connector import RowSource, input_table, key_for_row
+
+    class OneShotSource(RowSource):
+        # NOT deterministically replayable: emits the given rows once
+        deterministic_replay = False
+
+        def __init__(self, rows):
+            self.rows = rows
+            self.resumed_from = None
+
+        def on_persistence_resume(self, n):
+            self.resumed_from = n
+
+        def run(self, events):
+            for w in self.rows:
+                events.add(key_for_row({"word": w}, None), (w,))
+            events.commit()
+
+    def build(rows, results):
+        src = OneShotSource(rows)
+        table = input_table(src, WordSchema, name="oneshot")
+        counts = table.groupby(table.word).reduce(table.word, n=pw.reducers.count())
+
+        def on_change(key, row, time, is_addition):
+            if is_addition:
+                results[row["word"]] = row["n"]
+
+        pw.io.subscribe(counts, on_change=on_change)
+        return src
+
+    results1: dict = {}
+    build(["a", "b", "a"], results1)
+    _run_with_persistence(tmp_path, None, results1)
+    assert results1 == {"a": 2, "b": 1}
+
+    # restart: the source only has NEW rows (a live feed can't rewind);
+    # history must come back from the snapshot log
+    G.clear()
+    results2: dict = {}
+    src2 = build(["c", "a"], results2)
+    _run_with_persistence(tmp_path, None, results2)
+    assert src2.resumed_from == 3
+    assert results2 == {"a": 3, "b": 1, "c": 1}
+
+
+def test_async_transformer_results_not_doubled_on_resume(tmp_path):
+    """Auxiliary loopback inputs (AsyncTransformer results) are recomputed
+    from the replayed upstream — persistence must not ALSO replay a
+    recorded copy of them (review r2 finding)."""
+
+    class OutSchema(pw.Schema):
+        ret: int
+
+    class Doubler(pw.AsyncTransformer):
+        output_schema = OutSchema
+
+        async def invoke(self, value: int) -> dict:
+            return {"ret": value * 2}
+
+    class InSchema(pw.Schema):
+        value: int
+
+    def build(results):
+        import pathway_tpu.io._connector as conn
+
+        src = conn.DictSource(
+            lambda: [{"value": v} for v in (1, 4)], InSchema, tag="axt"
+        )
+        inputs = conn.input_table(src, InSchema, name="axt_in")
+        transformer = Doubler(inputs)
+        totals = transformer.successful.reduce(s=pw.reducers.sum(pw.this.ret))
+        pw.io.subscribe(
+            totals,
+            on_change=lambda key, row, time, add: results.__setitem__("s", row["s"])
+            if add
+            else None,
+        )
+
+    r1: dict = {}
+    build(r1)
+    _run_with_persistence(tmp_path, None, r1)
+    assert r1 == {"s": 10}
+
+    G.clear()
+    r2: dict = {}
+    build(r2)
+    _run_with_persistence(tmp_path, None, r2)
+    assert r2 == {"s": 10}  # not 20: loopback history must not double
