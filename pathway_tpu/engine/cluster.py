@@ -1,0 +1,334 @@
+"""Multi-worker execution: worker topology + collective exchange.
+
+The reference scales out by running the identical dataflow on every worker
+and exchanging records so that each stateful operator only keeps the rows
+whose shard hash it owns (timely exchange channels: shared memory between
+threads, TCP between processes — ``src/engine/dataflow.rs:1068-1072``,
+``src/engine/dataflow/config.rs:67-120``).  This module provides the same
+capability for the epoch-synchronous engine:
+
+- :class:`Cluster` — ``threads × processes`` workers.  Worker ``w`` lives in
+  process ``w // threads``.  Intra-process exchange is shared memory behind
+  a barrier; inter-process exchange is a TCP full mesh on
+  ``127.0.0.1:first_port+pid`` (reference ``CommunicationConfig::Cluster``).
+- ``exchange(slot, outboxes)`` — all-to-all for one (node, port, epoch):
+  every worker deposits one outbox per destination worker and receives the
+  concatenation of what all workers sent it, merged in global worker order
+  (deterministic, so N-worker runs produce the same output as 1-worker).
+- ``allgather(slot, obj)`` — small-object gather used for the epoch-cut
+  consensus: every worker receives the list of all workers' statuses and
+  applies the same decision function, so no asymmetric coordinator
+  broadcast is needed.
+
+A worker failure surfaces as a broken socket on every peer, failing the
+whole run — the reference behaves the same (a worker panic aborts the
+cluster, ``dataflow.rs:5533-5536``); recovery is restart-from-persistence.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time as _time
+from typing import Any, Callable
+
+from pathway_tpu.internals import keys as K
+
+__all__ = ["Cluster", "stable_shard"]
+
+
+def stable_shard(*values: Any) -> int:
+    """Process-stable shard hash of a tuple of cell values (Python's
+    builtin ``hash`` is salted per process, so it cannot route rows
+    consistently across a TCP cluster; the 128-bit key hash can)."""
+    try:
+        return int(K.ref_scalar(*values))
+    except Exception:
+        return int(K.ref_scalar(repr(values)))
+
+
+class _ProcessLinks:
+    """TCP full mesh between processes.  Process p listens on
+    ``first_port + p``; every pair is connected once (higher pid dials
+    lower pid).  Frames are length-prefixed pickles of ``(slot, payload)``;
+    a reader thread per peer deposits frames into a slot-keyed inbox."""
+
+    _CONNECT_TIMEOUT_S = 30.0
+
+    def __init__(self, process_id: int, n_processes: int, first_port: int):
+        self.process_id = process_id
+        self.n_processes = n_processes
+        self._socks: dict[int, socket.socket] = {}
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._inbox: dict[Any, dict[int, Any]] = {}
+        self._cv = threading.Condition()
+        self._failed: str | None = None
+
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", first_port + process_id))
+        listener.listen(n_processes)
+        self._listener = listener
+
+        accept_thread = threading.Thread(
+            target=self._accept_peers, args=(listener,), daemon=True
+        )
+        accept_thread.start()
+        # dial every lower pid (it is already listening or will be soon)
+        for peer in range(process_id):
+            self._socks[peer] = self._dial(peer, first_port)
+        accept_thread.join(self._CONNECT_TIMEOUT_S)
+        if len(self._socks) != n_processes - 1:
+            raise RuntimeError(
+                f"process {process_id}: cluster mesh incomplete "
+                f"({len(self._socks)}/{n_processes - 1} peers)"
+            )
+        for peer, sock in self._socks.items():
+            self._send_locks[peer] = threading.Lock()
+            threading.Thread(
+                target=self._read_loop, args=(peer, sock), daemon=True
+            ).start()
+
+    def _dial(self, peer: int, first_port: int) -> socket.socket:
+        deadline = _time.monotonic() + self._CONNECT_TIMEOUT_S
+        while True:
+            try:
+                sock = socket.create_connection(
+                    ("127.0.0.1", first_port + peer), timeout=5.0
+                )
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.sendall(struct.pack("<I", self.process_id))
+                return sock
+            except OSError:
+                if _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"process {self.process_id}: cannot reach peer {peer}"
+                    )
+                _time.sleep(0.05)
+
+    def _accept_peers(self, listener: socket.socket) -> None:
+        expected = self.n_processes - 1 - self.process_id  # all higher pids
+        listener.settimeout(self._CONNECT_TIMEOUT_S)
+        for _ in range(expected):
+            try:
+                sock, _addr = listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            peer = struct.unpack("<I", self._recv_exact(sock, 4))[0]
+            self._socks[peer] = sock
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    def _read_loop(self, peer: int, sock: socket.socket) -> None:
+        try:
+            sock.settimeout(None)
+            while True:
+                header = self._recv_exact(sock, 8)
+                (n,) = struct.unpack("<Q", header)
+                frame = pickle.loads(self._recv_exact(sock, n))
+                slot, payload = frame
+                with self._cv:
+                    self._inbox.setdefault(slot, {})[peer] = payload
+                    self._cv.notify_all()
+        except (ConnectionError, OSError) as e:
+            with self._cv:
+                self._failed = f"link to process {peer} lost: {e!r}"
+                self._cv.notify_all()
+
+    def send(self, peer: int, slot: Any, payload: Any) -> None:
+        data = pickle.dumps((slot, payload), protocol=pickle.HIGHEST_PROTOCOL)
+        with self._send_locks[peer]:
+            self._socks[peer].sendall(struct.pack("<Q", len(data)) + data)
+
+    def recv_from_all(self, slot: Any) -> dict[int, Any]:
+        """Block until every peer delivered a payload for ``slot``."""
+        with self._cv:
+            while True:
+                if self._failed is not None:
+                    raise RuntimeError(f"cluster failure: {self._failed}")
+                got = self._inbox.get(slot)
+                if got is not None and len(got) == self.n_processes - 1:
+                    return self._inbox.pop(slot)
+                self._cv.wait(timeout=1.0)
+
+    def close(self) -> None:
+        for sock in self._socks.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class Cluster:
+    """Worker topology + collectives for ``threads × processes`` workers.
+
+    Worker global index = ``process_id * threads + thread_id``.  Exchange
+    within a process is shared memory; across processes one aggregated
+    frame per peer per collective.
+    """
+
+    def __init__(
+        self,
+        *,
+        threads: int = 1,
+        processes: int = 1,
+        process_id: int = 0,
+        first_port: int = 10000,
+    ):
+        self.threads = threads
+        self.processes = processes
+        self.process_id = process_id
+        self.n_workers = threads * processes
+        self._links = (
+            _ProcessLinks(process_id, processes, first_port)
+            if processes > 1
+            else None
+        )
+        self._barrier = threading.Barrier(threads)
+        self._local: dict[Any, Any] = {}  # slot -> per-tid deposits
+        self._merged: dict[Any, Any] = {}  # slot -> per-tid results
+        self._lock = threading.Lock()
+
+    def worker_index(self, thread_id: int) -> int:
+        return self.process_id * self.threads + thread_id
+
+    # ------------------------------------------------------------------
+    def exchange(
+        self, slot: Any, thread_id: int, outboxes: list[list]
+    ) -> list:
+        """All-to-all: ``outboxes[w]`` holds this worker's updates destined
+        to global worker ``w``; returns the merged inbox for this worker,
+        concatenated in global source-worker order."""
+        T, P = self.threads, self.processes
+        with self._lock:
+            self._local.setdefault(slot, {})[thread_id] = outboxes
+        self._barrier.wait()
+        if thread_id == 0:
+            local = self._local.pop(slot)
+            # remote: payload[src_tid][dst_tid] = updates
+            if self._links is not None:
+                for peer in range(P):
+                    if peer == self.process_id:
+                        continue
+                    payload = [
+                        [
+                            local[src_tid][peer * T + dst_tid]
+                            for dst_tid in range(T)
+                        ]
+                        for src_tid in range(T)
+                    ]
+                    self._links.send(peer, slot, payload)
+                remote = self._links.recv_from_all(slot)
+            else:
+                remote = {}
+            merged: list[list] = [[] for _ in range(T)]
+            base = self.process_id * T
+            for src_pid in range(P):
+                for src_tid in range(T):
+                    if src_pid == self.process_id:
+                        boxes = local[src_tid]
+                        for dst_tid in range(T):
+                            merged[dst_tid].extend(boxes[base + dst_tid])
+                    else:
+                        payload = remote[src_pid]
+                        for dst_tid in range(T):
+                            merged[dst_tid].extend(payload[src_tid][dst_tid])
+            with self._lock:
+                self._merged[slot] = merged
+        self._barrier.wait()
+        with self._lock:
+            merged = self._merged[slot]
+            result = merged[thread_id]
+            merged[thread_id] = None  # type: ignore[call-overload]
+            if all(m is None for m in merged):
+                self._merged.pop(slot, None)
+        return result
+
+    def allgather(self, slot: Any, thread_id: int, obj: Any) -> list:
+        """Every worker contributes one object; every worker receives the
+        list of all objects in global worker order.  Epoch-cut consensus
+        applies the same pure decision function to this list everywhere."""
+        T, P = self.threads, self.processes
+        with self._lock:
+            self._local.setdefault(slot, {})[thread_id] = obj
+        self._barrier.wait()
+        if thread_id == 0:
+            local = self._local.pop(slot)
+            if self._links is not None:
+                payload = [local[tid] for tid in range(T)]
+                for peer in range(P):
+                    if peer != self.process_id:
+                        self._links.send(peer, slot, payload)
+                remote = self._links.recv_from_all(slot)
+            else:
+                remote = {}
+            gathered: list = []
+            for src_pid in range(P):
+                if src_pid == self.process_id:
+                    gathered.extend(local[tid] for tid in range(T))
+                else:
+                    gathered.extend(remote[src_pid])
+            with self._lock:
+                self._merged[slot] = gathered
+        self._barrier.wait()
+        with self._lock:
+            gathered = self._merged[slot]
+            # every thread reads the same list; last reader cleans up
+            counter = self._local.setdefault(("__done__", slot), {"n": 0})
+            counter["n"] += 1
+            if counter["n"] == T:
+                self._merged.pop(slot, None)
+                self._local.pop(("__done__", slot), None)
+        return gathered
+
+    def close(self) -> None:
+        self._barrier.abort()  # free local threads blocked in a collective
+        if self._links is not None:
+            self._links.close()
+
+
+def route_by_key(u: Any) -> int:
+    """Default co-location: the row key (already a 128-bit stable hash)."""
+    return int(u.key)
+
+
+def route_to_zero(_u: Any) -> int:
+    """Centralized operators (temporal buffers, external indexes, outputs):
+    the reference shards these to a single worker too
+    (``TimeKey::shard() -> 1``, ``src/engine/dataflow/operators/time_column.rs:44-52``)."""
+    return 0
+
+
+def route_all_to_zero(node: Any) -> list:
+    """``exchange_routes`` implementation for centralized operators: one
+    ``route_to_zero`` per input port.  Assign directly as a method:
+    ``MyNode.exchange_routes = cluster.route_all_to_zero``."""
+    return [route_to_zero] * max(1, len(node.inputs))
+
+
+def route_by(fn: Callable[[Any, tuple], Any]) -> Callable[[Any], int]:
+    """Route by a computed co-location value (group values, join key,
+    instance)."""
+
+    def route(u: Any) -> int:
+        vals = fn(u.key, u.values)
+        if isinstance(vals, tuple):
+            return stable_shard(*vals)
+        return stable_shard(vals)
+
+    return route

@@ -241,3 +241,11 @@ class ExternalIndexNode(Node):
             # keep out-cache only (it backs retraction replay)
             pass
         return consolidate(out)
+
+
+# index + queries live on worker 0: the device-plane slab has one host
+# owner (the reference replicates indexes per worker instead, which a
+# single shared TPU slab replaces)
+from pathway_tpu.engine import cluster as _cl
+
+ExternalIndexNode.exchange_routes = _cl.route_all_to_zero

@@ -55,6 +55,15 @@ class Node:
         self.name = name or type(self).__name__
         self.id = graph.register(self)
 
+    def exchange_routes(self) -> list | None:
+        """Multi-worker co-location: one route function per input port
+        (``Update -> stable shard int``; destination worker = shard % W),
+        or None for operators that process rows wherever they are
+        (reference key-hash exchange, ``src/engine/dataflow.rs:1068-1072``).
+        Stateful operators MUST route so each worker owns a disjoint state
+        shard; stateless ones keep data local."""
+        return None
+
     def make_state(self) -> Any:
         return {}
 
@@ -106,6 +115,11 @@ class InputNode(Node):
         self.static_rows = list(static_rows)
         self.subject = subject
         self.upsert = upsert
+
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by_key] if self.upsert else None
 
     def make_state(self) -> Any:
         return {"rows": {}}  # key -> values, for upsert semantics
@@ -257,6 +271,11 @@ class IntersectNode(Node):
     def __init__(self, graph: EngineGraph, main: Node, others: Sequence[Node], name: str = "intersect"):
         super().__init__(graph, [main, *others], name)
 
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by_key] * len(self.inputs)
+
     def make_state(self):
         return {"main": {}, "others": [dict() for _ in self.inputs[1:]]}
 
@@ -301,6 +320,11 @@ class SubtractNode(Node):
     def __init__(self, graph: EngineGraph, main: Node, other: Node, name: str = "difference"):
         super().__init__(graph, [main, other], name)
 
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by_key, cl.route_by_key]
+
     def make_state(self):
         return {"main": {}, "other": {}}
 
@@ -329,6 +353,11 @@ class UpdateRowsNode(Node):
 
     def __init__(self, graph: EngineGraph, a: Node, b: Node, name: str = "update_rows"):
         super().__init__(graph, [a, b], name)
+
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by_key, cl.route_by_key]
 
     def make_state(self):
         return {"a": {}, "b": {}}
@@ -364,6 +393,11 @@ class UpdateCellsNode(Node):
     def __init__(self, graph: EngineGraph, a: Node, b: Node, col_map: list[tuple[int, int]], name: str = "update_cells"):
         super().__init__(graph, [a, b], name)
         self.col_map = col_map
+
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by_key, cl.route_by_key]
 
     def make_state(self):
         return {"a": {}, "b": {}}
@@ -425,6 +459,11 @@ class GroupByNode(Node):
         self.reducer_args = reducer_args
         self.output_key_fn = output_key_fn or (lambda gvals: K.ref_scalar(*gvals))
         self.include_group_values = include_group_values
+
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by(self.group_fn)]
 
     def make_state(self):
         # group_hash -> {gvals, accs: [...], count, last_out: tuple|None}
@@ -490,6 +529,11 @@ class DeduplicateNode(Node):
         self.instance_fn = instance_fn
         self.acceptor = acceptor
 
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by(self.instance_fn)]
+
     def make_state(self):
         return {"kept": {}}  # instance -> (key, values)
 
@@ -551,6 +595,11 @@ class JoinNode(Node):
         self.right_ncols = right_ncols
         self.kind = kind
         self.left_id_only = left_id_only
+
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by(self.left_jk_fn), cl.route_by(self.right_jk_fn)]
 
     def make_state(self):
         return {"left": {}, "right": {}}  # jk -> {row_key: values}
@@ -654,6 +703,20 @@ class IxNode(Node):
         self.strict = strict
         self.target_ncols = target_ncols
 
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        def route_request(u):
+            try:
+                tkey = self.key_fn(u.key, u.values)
+            except Exception:
+                return 0
+            if tkey is None or tkey is api.ERROR:
+                return 0
+            return int(tkey)
+
+        return [cl.route_by_key, route_request]
+
     def make_state(self):
         # out: req_key -> last emitted values (the cache that keeps
         # retractions consistent when target and requests change together)
@@ -726,6 +789,11 @@ class ZipNode(Node):
         super().__init__(graph, inputs, name)
         self.widths = list(widths)
 
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by_key] * len(self.inputs)
+
     def make_state(self):
         return {"rows": [dict() for _ in self.inputs], "out": {}}
 
@@ -770,6 +838,11 @@ class SortNode(Node):
         super().__init__(graph, [input], name)
         self.key_fn = key_fn
         self.instance_fn = instance_fn
+
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_by(self.instance_fn)]
 
     def make_state(self):
         # instances: inst -> {row_key: sort_val}; out: row_key -> (prev, next)
@@ -835,6 +908,11 @@ class AsyncMapNode(Node):
         super().__init__(graph, [input], name)
         self.batch_fn = batch_fn
 
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_to_zero]
+
     def make_state(self):
         return {"cache": {}}  # key -> result
 
@@ -880,6 +958,11 @@ class OutputNode(Node):
         self._on_time_end = on_time_end
         self._on_end = on_end
 
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_to_zero]
+
     def make_state(self):
         return {"saw_data": False}
 
@@ -892,11 +975,13 @@ class OutputNode(Node):
         return []
 
     def on_time_end(self, ctx, time):
-        if self._on_time_end is not None:
+        # multi-worker: all updates are routed to worker 0, which alone
+        # drives the output lifecycle (single-writer semantics)
+        if ctx.worker_id == 0 and self._on_time_end is not None:
             self._on_time_end(time)
 
     def on_end(self, ctx):
-        if self._on_end is not None:
+        if ctx.worker_id == 0 and self._on_end is not None:
             self._on_end()
 
 
@@ -906,6 +991,11 @@ class CaptureNode(Node):
 
     def __init__(self, graph: EngineGraph, input: Node, name: str = "capture"):
         super().__init__(graph, [input], name)
+
+    def exchange_routes(self):
+        from pathway_tpu.engine import cluster as cl
+
+        return [cl.route_to_zero]
 
     def make_state(self):
         return {"rows": {}, "stream": []}

@@ -1,4 +1,4 @@
-"""Epoch scheduler: the engine main loop.
+"""Epoch scheduler: the engine main loop, single- and multi-worker.
 
 Equivalent of the reference worker main loop (``run_with_new_dataflow_graph``
 + ``step_or_park`` + pollers/flushers, ``src/engine/dataflow.rs:5506-5717``):
@@ -8,6 +8,14 @@ propagates update batches through the node graph in topological order.
 Consistency contract: outputs observe only closed epochs — within an epoch
 every operator sees the complete batch, so downstream tables are always a
 consistent snapshot (same guarantee the reference gets from timely frontiers).
+
+Multi-worker mode (reference ``PATHWAY_THREADS`` × ``PATHWAY_PROCESSES``,
+``src/engine/dataflow/config.rs:86-120``): every worker runs the identical
+node list over its own :class:`RunContext`; at stateful operators the epoch
+batch is exchanged by a stable key hash (``Node.exchange_routes``) so each
+worker owns a disjoint state shard.  Epoch cuts are agreed by an allgather
+of worker statuses + an identical pure decision function — the epoch-
+synchronous analogue of timely progress tracking.
 """
 
 from __future__ import annotations
@@ -18,6 +26,7 @@ import time as _time
 from collections import defaultdict
 from typing import Any
 
+from pathway_tpu.engine.cluster import Cluster
 from pathway_tpu.engine.graph import EngineGraph, InputNode, Node, RunContext
 from pathway_tpu.engine.stream import TIME_STEP, Batch, Update
 from pathway_tpu.internals.keys import Pointer
@@ -79,32 +88,86 @@ class Scheduler:
         self.persistence: Any = None
 
     # ------------------------------------------------------------------
-    def run_epoch(self, time: int, inject: dict[int, Batch]) -> None:
-        ctx = self.ctx
+    def run_epoch(
+        self,
+        time: int,
+        inject: dict[int, Batch],
+        *,
+        ctx: RunContext | None = None,
+        cluster: Cluster | None = None,
+        tid: int = 0,
+    ) -> None:
+        ctx = ctx or self.ctx
         ctx.time = time
+        W = cluster.n_workers if cluster is not None else 1
         pending: dict[int, dict[int, list[Update]]] = defaultdict(lambda: defaultdict(list))
         for nid, batch in inject.items():
             pending[nid][0] = list(batch)
         for node in self.graph.nodes:
             ins = pending.pop(node.id, None)
+            routes = node.exchange_routes() if W > 1 else None
+            if routes is not None:
+                # collective: every worker participates even with no local
+                # data — rows may arrive from peers
+                ins = ins or {}
+                n_ports = max(1, len(node.inputs))
+                for port in range(n_ports):
+                    route = routes[port] if port < len(routes) else None
+                    if route is None:
+                        continue
+                    outboxes: list[list] = [[] for _ in range(W)]
+                    for u in ins.get(port, ()):
+                        try:
+                            dest = route(u) % W
+                        except Exception:
+                            dest = 0
+                        outboxes[dest].append(u)
+                    ins[port] = cluster.exchange(  # type: ignore[union-attr]
+                        ("x", node.id, port, time), tid, outboxes
+                    )
             has_input = ins is not None and any(ins.values())
             if not has_input and not node.always_tick and not getattr(ctx, "finalizing", False):
                 continue
             n_ports = max(1, len(node.inputs))
             inbatches = [ins.get(i, []) if ins else [] for i in range(n_ports)]
-            out = node.process(ctx, time, inbatches)
+            try:
+                out = node.process(ctx, time, inbatches)
+            except Exception as e:
+                # per-node containment: a failing operator must not abort
+                # the run (reference routes errors to the error log,
+                # src/engine/error.rs) — and in cluster mode an uncaught
+                # raise would strand peers at the next collective.  The
+                # epoch's output for this node is lost, so downstream state
+                # may be degraded: log loudly, not just to the error table.
+                import logging
+
+                msg = f"{node.name}#{node.id}: {e!r}"
+                ctx.error_log.append(msg)
+                logging.getLogger("pathway_tpu").error(
+                    "operator failed (epoch %d dropped for this node): %s",
+                    time,
+                    msg,
+                )
+                out = []
             if out:
                 for consumer, port in self.consumers.get(node.id, ()):  # fan-out
                     pending[consumer.id][port].extend(out)
         for node in self.graph.nodes:
             node.on_time_end(ctx, time)
 
-    def _finish(self) -> None:
+    def _finish(
+        self,
+        *,
+        ctx: RunContext | None = None,
+        cluster: Cluster | None = None,
+        tid: int = 0,
+    ) -> None:
         # final flush epoch: frontier advances to +inf; buffering operators release
-        self.ctx.finalizing = True  # type: ignore[attr-defined]
-        self.run_epoch(self.ctx.time + TIME_STEP, {})
+        ctx = ctx or self.ctx
+        ctx.finalizing = True  # type: ignore[attr-defined]
+        self.run_epoch(ctx.time + TIME_STEP, {}, ctx=ctx, cluster=cluster, tid=tid)
         for node in self.graph.nodes:
-            node.on_end(self.ctx)
+            node.on_end(ctx)
 
     # ------------------------------------------------------------------
     def run(self) -> RunContext:
@@ -134,6 +197,7 @@ class Scheduler:
         # persistence: replay committed input snapshots as leading epochs
         replayed_counts: dict[int, int] = {}
         if self.persistence is not None:
+            self.persistence.check_topology(1)
             for node in live_inputs:
                 events = self.persistence.replay_events(node)
                 replayed_counts[node.id] = sum(
@@ -219,10 +283,216 @@ class Scheduler:
         self._finish()
         return self.ctx
 
+    # ------------------------------------------------------------------
+    # multi-worker execution
+
+    def run_cluster(self, cluster: Cluster) -> RunContext:
+        """SPMD run over ``cluster.threads`` local workers (this process) in
+        a ``cluster.processes``-process mesh.  Returns the worker-0 context
+        on process 0 (holds captures/outputs), else this process's first
+        worker context."""
+        T = cluster.threads
+        ctxs = [
+            RunContext(
+                n_workers=cluster.n_workers, worker_id=cluster.worker_index(tid)
+            )
+            for tid in range(T)
+        ]
+        errors: list[BaseException] = []
+
+        def work(tid: int) -> None:
+            try:
+                self._worker_loop(cluster, tid, ctxs[tid])
+            except BaseException as e:  # noqa: BLE001 — surfaced to caller
+                errors.append(e)
+                cluster.close()  # unblock peers; their collectives now fail
+
+        workers = [
+            threading.Thread(target=work, args=(tid,), daemon=True)
+            for tid in range(1, T)
+        ]
+        for w in workers:
+            w.start()
+        work(0)
+        for w in workers:
+            w.join()
+        if errors:
+            raise errors[0]
+        return ctxs[0]
+
+    def _worker_loop(self, cluster: Cluster, tid: int, ctx: RunContext) -> None:
+        W = cluster.n_workers
+        w = cluster.worker_index(tid)
+
+        static_inject: dict[int, Batch] = {}
+        my_inputs: list[tuple[InputNode, Any]] = []  # (node, subject to run)
+        live_node_ids: set[int] = set()
+        for node in self.graph.nodes:
+            if not isinstance(node, InputNode):
+                continue
+            if node.static_rows and w == 0:
+                static_inject[node.id] = [
+                    Update(k, v, 1) for k, v in node.static_rows
+                ]
+            if node.subject is None:
+                continue
+            live_node_ids.add(node.id)
+            part = getattr(node.subject, "partition", None)
+            if part is not None:
+                sub = part(w, W)
+                if sub is not None:
+                    my_inputs.append((node, sub))
+            elif w == 0:
+                my_inputs.append((node, node.subject))
+
+        t = 0
+        if any(
+            isinstance(n, InputNode) and n.static_rows for n in self.graph.nodes
+        ):
+            self.run_epoch(t, static_inject, ctx=ctx, cluster=cluster, tid=tid)
+            t += TIME_STEP
+
+        if not live_node_ids:
+            ctx.time = t - TIME_STEP if t else 0
+            self._finish(ctx=ctx, cluster=cluster, tid=tid)
+            return
+
+        # persistence replay (per-worker streams): all workers replay in
+        # lockstep — the epoch count is agreed first so collectives align
+        t, replayed_counts = self._cluster_replay(cluster, tid, ctx, my_inputs, t)
+
+        q: "queue.Queue" = queue.Queue()
+        for node, subject in my_inputs:
+            events: Any = ConnectorEvents(q, node.id, self._stop)
+            if self.persistence is not None:
+                events = self.persistence.wrap_events(
+                    node, events, replayed_counts.get(node.id, 0), worker=w
+                )
+            threading.Thread(
+                target=self._run_subject_obj,
+                args=(node, subject, events),
+                daemon=True,
+            ).start()
+
+        my_primaries = {
+            n.id for n, _s in my_inputs if not getattr(n, "auxiliary", False)
+        }
+        my_aux = [n for n, _s in my_inputs if getattr(n, "auxiliary", False)]
+        open_subjects = set(my_primaries)
+        buffers: dict[int, list[Update]] = defaultdict(list)
+        round_no = 0
+        commit_requested = False
+        last_cut = _time.monotonic()
+        while True:
+            # drain whatever is buffered right now (non-blocking)
+            while True:
+                try:
+                    nid, kind, key, values = q.get_nowait()
+                except queue.Empty:
+                    break
+                if kind == "add":
+                    buffers[nid].append(Update(key, values, 1))
+                elif kind == "remove":
+                    buffers[nid].append(Update(key, values, -1))
+                elif kind == "commit":
+                    commit_requested = True
+                elif kind == "close":
+                    open_subjects.discard(nid)
+
+            aux_pending = sum(
+                getattr(n.subject, "pending_count", lambda: 0)() for n in my_aux
+            )
+            # has_data includes a post-drain queue peek: a loopback enqueues
+            # its result BEFORE decrementing pending, so (queue empty AND
+            # pending 0) means nothing more can arrive — and since every
+            # worker contributes that into the allgather, all workers reach
+            # the identical CUT/FINISH/WAIT decision and stay in lockstep
+            # the decision below must be a pure function of the gathered
+            # statuses so every worker reaches the same CUT/FINISH/WAIT
+            # verdict — local clocks only enter via the gathered elapsed
+            elapsed_ms = (_time.monotonic() - last_cut) * 1000.0
+            status = (
+                any(buffers.values()) or not q.empty(),
+                len(open_subjects),
+                aux_pending,
+                commit_requested,
+                self._stop.is_set(),
+                elapsed_ms,
+            )
+            statuses = cluster.allgather(("s", round_no), tid, status)
+            round_no += 1
+            any_data = any(s[0] for s in statuses)
+            all_closed = all(s[1] == 0 for s in statuses)
+            no_aux = all(s[2] == 0 for s in statuses)
+            any_commit = any(s[3] for s in statuses)
+            stop = any(s[4] for s in statuses)
+            autocommit_due = max(s[5] for s in statuses) >= self.autocommit_ms
+            source_done = all_closed and no_aux
+            if any_data and (any_commit or autocommit_due or source_done or stop):
+                inject = {nid: b for nid, b in buffers.items() if b}
+                buffers = defaultdict(list)
+                commit_requested = False
+                self.run_epoch(t, inject, ctx=ctx, cluster=cluster, tid=tid)
+                t += TIME_STEP
+                last_cut = _time.monotonic()
+            elif stop or (source_done and not any_data):
+                break
+            else:
+                # pace the next status round: batch up to ~autocommit_ms
+                _time.sleep(self.autocommit_ms / 1000.0 / 5.0)
+        ctx.time = t
+        self._finish(ctx=ctx, cluster=cluster, tid=tid)
+
+    def _cluster_replay(
+        self,
+        cluster: Cluster,
+        tid: int,
+        ctx: RunContext,
+        my_inputs: list[tuple[InputNode, Any]],
+        t: int,
+    ) -> tuple[int, dict[int, int]]:
+        """Replay persisted input snapshots in lockstep across workers.
+        Returns (next epoch time, data-event count replayed per input)."""
+        replayed_counts: dict[int, int] = {}
+        epochs_per_input: dict[int, list[Batch]] = {}
+        if self.persistence is not None:
+            w = cluster.worker_index(tid)
+            if w == 0:
+                self.persistence.check_topology(cluster.n_workers)
+            for node, _subject in my_inputs:
+                events = self.persistence.replay_events(node, worker=w)
+                replayed_counts[node.id] = sum(
+                    1 for kind, _k, _v in events if kind != "commit"
+                )
+                epochs: list[Batch] = []
+                cur: list[Update] = []
+                for kind, key, values in events:
+                    if kind == "add":
+                        cur.append(Update(key, values, 1))
+                    elif kind == "remove":
+                        cur.append(Update(key, values, -1))
+                    elif kind == "commit" and cur:
+                        epochs.append(cur)
+                        cur = []
+                if epochs:
+                    epochs_per_input[node.id] = epochs
+        my_len = max((len(e) for e in epochs_per_input.values()), default=0)
+        lens = cluster.allgather(("replay_len",), tid, my_len)
+        n_epochs = max(lens)
+        for i in range(n_epochs):
+            inject = {
+                nid: epochs[i]
+                for nid, epochs in epochs_per_input.items()
+                if i < len(epochs)
+            }
+            self.run_epoch(t, inject, ctx=ctx, cluster=cluster, tid=tid)
+            t += TIME_STEP
+        return t, replayed_counts
+
     @staticmethod
-    def _run_subject(node: InputNode, events: ConnectorEvents) -> None:
+    def _run_subject_obj(node: InputNode, subject: Any, events: ConnectorEvents) -> None:
         try:
-            node.subject.run(events)
+            subject.run(events)
         except Exception as e:  # reader errors must not hang the run
             import logging
 
@@ -231,6 +501,10 @@ class Scheduler:
             )
         finally:
             events.close()
+
+    @staticmethod
+    def _run_subject(node: InputNode, events: ConnectorEvents) -> None:
+        Scheduler._run_subject_obj(node, node.subject, events)
 
     def stop(self) -> None:
         self._stop.set()

@@ -458,3 +458,12 @@ class AsofJoinNode(Node):
                     else:
                         st["out"].pop(lkey, None)
         return consolidate(out)
+
+
+# multi-worker routing: temporal operators keep watermark/buffer state on a
+# single worker, exactly like the reference (TimeKey::shard() -> 1,
+# src/engine/dataflow/operators/time_column.rs:44-52)
+from pathway_tpu.engine import cluster as _cl
+
+for _cls in (TemporalBehaviorNode, IntervalJoinNode, AsofNowJoinNode, AsofJoinNode):
+    _cls.exchange_routes = _cl.route_all_to_zero

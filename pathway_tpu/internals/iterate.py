@@ -52,6 +52,12 @@ class IterateNode(eg.Node):
             "last": {n: {} for n in self.out_names},
         }
 
+    def exchange_routes(self):
+        # the fixpoint solve is self-contained: centralize on worker 0
+        from pathway_tpu.engine import cluster as cl
+
+        return cl.route_all_to_zero(self)
+
     def _solve(self, st) -> dict[str, dict]:
         from pathway_tpu.engine.scheduler import Scheduler
 

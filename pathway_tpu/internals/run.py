@@ -35,6 +35,9 @@ def run(
 
     if persistence_config is None:
         persistence_config = cfg.pathway_config.persistence_config
+    pc = cfg.pathway_config
+    threads = max(1, pc.threads)
+    processes = max(1, pc.processes)
     sched = Scheduler(
         G.engine_graph,
         autocommit_ms=autocommit_duration_ms or 50,
@@ -48,7 +51,23 @@ def run(
 
         attach_persistence(sched, persistence_config)
     G.active_scheduler = sched  # handle for stopping threaded servers
-    ctx = sched.run()
+    if threads * processes > 1:
+        # multi-worker topology from the spawn env contract
+        # (PATHWAY_THREADS × PATHWAY_PROCESSES, reference config.rs:86-120)
+        from pathway_tpu.engine.cluster import Cluster
+
+        cluster = Cluster(
+            threads=threads,
+            processes=processes,
+            process_id=pc.process_id,
+            first_port=pc.first_port,
+        )
+        try:
+            ctx = sched.run_cluster(cluster)
+        finally:
+            cluster.close()
+    else:
+        ctx = sched.run()
     G.last_run_ctx = ctx
     return ctx
 

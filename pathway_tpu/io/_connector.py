@@ -176,6 +176,35 @@ class Writer:
         pass
 
 
+class LazyFileWriter(Writer):
+    """File-backed writer that opens lazily on first row.
+
+    In a process cluster every process builds the graph, but only worker 0
+    receives output rows — an eager ``open(path, "w")`` in ``__init__``
+    would let a peer process truncate worker 0's file.  ``close()`` (called
+    only on the owning worker) still creates/truncates the file even when
+    the run emitted zero rows, so stale output from a previous run never
+    survives a successful empty run."""
+
+    _open_newline: str | None = None
+
+    def __init__(self, path: str):
+        self._path = path
+        self._f: Any = None
+
+    def _file(self):
+        if self._f is None:
+            self._f = open(self._path, "w", newline=self._open_newline)
+        return self._f
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def close(self) -> None:
+        self._file().close()
+
+
 def attach_writer(table: Table, writer: Writer, *, name: str = "output") -> None:
     cols = table._column_names
 

@@ -10,7 +10,12 @@ from typing import Any
 
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.table import Table
-from pathway_tpu.io._connector import Writer, attach_writer, fmt_value, input_table
+from pathway_tpu.io._connector import (
+    LazyFileWriter,
+    attach_writer,
+    fmt_value,
+    input_table,
+)
 from pathway_tpu.io.fs import _FilesSource
 
 __all__ = ["read", "write", "CsvParserSettings"]
@@ -87,9 +92,11 @@ def read(
     return input_table(src, schema, name=name)
 
 
-class _CsvWriter(Writer):
+class _CsvWriter(LazyFileWriter):
+    _open_newline = ""
+
     def __init__(self, path: str):
-        self._f = open(path, "w", newline="")
+        super().__init__(path)
         self._writer: Any = None
 
     def write(self, row: dict[str, Any], time: int, diff: int) -> None:
@@ -97,15 +104,10 @@ class _CsvWriter(Writer):
         out["time"] = time
         out["diff"] = diff
         if self._writer is None:
-            self._writer = _csv.DictWriter(self._f, fieldnames=list(out.keys()))
+            self._writer = _csv.DictWriter(self._file(), fieldnames=list(out.keys()))
             self._writer.writeheader()
         self._writer.writerow(out)
 
-    def flush(self) -> None:
-        self._f.flush()
-
-    def close(self) -> None:
-        self._f.close()
 
 
 def write(table: Table, filename: str | os.PathLike, **kwargs: Any) -> None:

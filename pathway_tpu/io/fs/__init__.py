@@ -12,8 +12,8 @@ from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.keys import ref_scalar
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io._connector import (
+    LazyFileWriter,
     RowSource,
-    Writer,
     attach_writer,
     coerce_row,
     fmt_value,
@@ -66,6 +66,20 @@ class _FilesSource(RowSource):
         self.poll_interval = poll_interval
         self.with_metadata = with_metadata
         self.tag = tag
+        #: (worker, n_workers) — this reader emits only rows whose key
+        #: hash it owns (parallel partitioned reads, reference
+        #: ``connector_table(parallel_readers=...)`` dataflow.rs:3291)
+        self._part = (0, 1)
+
+    def partition(self, worker: int, n_workers: int) -> "_FilesSource | None":
+        """Every worker scans the files but emits a disjoint key-hash share;
+        row keys are identical to a single-worker run, so persistence
+        resume and N-vs-1-worker outputs stay exact."""
+        import copy
+
+        sub = copy.copy(self)
+        sub._part = (worker, n_workers)
+        return sub
 
     def _emit_file(
         self, events: Any, fp: str, start_offset: int, seq_start: int, parser: Callable
@@ -101,6 +115,9 @@ class _FilesSource(RowSource):
                 else:
                     seq += 1
                     key = ref_scalar("__fs__", self.tag, fp, seq)
+                w, n = self._part
+                if n > 1 and int(key) % n != w:
+                    continue  # another worker's share
                 events.add(key, coerce_row(values, self.schema))
             return offset, seq
 
@@ -174,23 +191,15 @@ def read(
     raise ValueError(f"unsupported fs format {format!r}")
 
 
-class _PlainWriter(Writer):
-    def __init__(self, path: str):
-        self._f = open(path, "w")
-
+class _PlainWriter(LazyFileWriter):
     def write(self, row: dict[str, Any], time: int, diff: int) -> None:
         vals = {k: fmt_value(v) for k, v in row.items() if k != "id"}
         import json
 
         vals["time"] = time
         vals["diff"] = diff
-        self._f.write(json.dumps(vals) + "\n")
+        self._file().write(json.dumps(vals) + "\n")
 
-    def flush(self) -> None:
-        self._f.flush()
-
-    def close(self) -> None:
-        self._f.close()
 
 
 def write(table: Table, filename: str | os.PathLike, format: str = "json", **kwargs: Any) -> None:

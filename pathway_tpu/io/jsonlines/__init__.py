@@ -10,7 +10,12 @@ from typing import Any
 
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.table import Table
-from pathway_tpu.io._connector import Writer, attach_writer, fmt_value, input_table
+from pathway_tpu.io._connector import (
+    LazyFileWriter,
+    attach_writer,
+    fmt_value,
+    input_table,
+)
 from pathway_tpu.io.fs import _FilesSource, _list_files
 
 __all__ = ["read", "write"]
@@ -59,21 +64,13 @@ def read(
     return input_table(source, schema, name=name)
 
 
-class _JsonLinesWriter(Writer):
-    def __init__(self, path: str):
-        self._f = open(path, "w")
-
+class _JsonLinesWriter(LazyFileWriter):
     def write(self, row: dict[str, Any], time: int, diff: int) -> None:
         out = {k: fmt_value(v) for k, v in row.items() if k != "id"}
         out["time"] = time
         out["diff"] = diff
-        self._f.write(json.dumps(out) + "\n")
+        self._file().write(json.dumps(out) + "\n")
 
-    def flush(self) -> None:
-        self._f.flush()
-
-    def close(self) -> None:
-        self._f.close()
 
 
 def write(table: Table, filename: str | os.PathLike, *, name: str = "jsonlines_out", **kwargs: Any) -> None:

@@ -293,7 +293,29 @@ class PersistenceHooks:
             PersistenceMode.SPEEDRUN_REPLAY,
         )
 
-    def stream_name(self, node: Any) -> str:
+    def check_topology(self, n_workers: int) -> None:
+        """Snapshot streams are per-worker; resuming under a different
+        worker count would double-count (a partitioned reader's skip
+        counter no longer lines up) or silently drop the ``_wN`` streams.
+        The reference ties snapshots to worker topology the same way."""
+        meta = self.impl.get_meta()
+        stored = meta.get("n_workers")
+        if stored is not None and stored != n_workers:
+            raise RuntimeError(
+                f"persistence snapshot was recorded with {stored} worker(s); "
+                f"resuming with {n_workers} is not supported — restart with "
+                f"the original topology or clear the snapshot directory"
+            )
+        if stored is None and not self.replay_only:
+            meta["n_workers"] = n_workers
+            self.impl.put_meta(meta)
+
+    def stream_name(self, node: Any, worker: int = 0) -> str:
+        # one snapshot stream per (input, worker): partitioned readers
+        # record and resume independently (reference per-worker snapshot
+        # writers, src/persistence/tracker.rs)
+        if worker:
+            return f"input_{node.name}_{node.id}_w{worker}"
         return f"input_{node.name}_{node.id}"
 
     @staticmethod
@@ -304,7 +326,7 @@ class PersistenceHooks:
         live position) opt in by setting ``deterministic_replay = True``."""
         return bool(getattr(node.subject, "deterministic_replay", False))
 
-    def replay_events(self, node: Any) -> list[tuple[str, Any, Any]]:
+    def replay_events(self, node: Any, worker: int = 0) -> list[tuple[str, Any, Any]]:
         """Committed events for this input, for ALL source kinds (the
         reference persists and rewinds every input snapshot regardless of
         reader type).  The uncommitted tail is dropped AND truncated from
@@ -317,7 +339,7 @@ class PersistenceHooks:
         replaying a recorded copy as well would double-count them."""
         if getattr(node, "auxiliary", False):
             return []
-        stream = self.stream_name(node)
+        stream = self.stream_name(node, worker)
         records = [pickle.loads(r) for r in self.impl.read_all(stream)]
         last_commit = -1
         counter_mark = 0
@@ -338,7 +360,7 @@ class PersistenceHooks:
         _conn._autogen_counter.advance_to(counter_mark)
         return records[: last_commit + 1]
 
-    def wrap_events(self, node: Any, events: Any, replayed: int) -> Any:
+    def wrap_events(self, node: Any, events: Any, replayed: int, worker: int = 0) -> Any:
         if self.replay_only:
             return events
         if getattr(node, "auxiliary", False):
@@ -362,7 +384,9 @@ class PersistenceHooks:
                     replayed,
                 )
             replayed = 0
-        return _RecordingEvents(events, self.impl, self.stream_name(node), replayed)
+        return _RecordingEvents(
+            events, self.impl, self.stream_name(node, worker), replayed
+        )
 
 
 def attach_persistence(sched: Any, config: Config) -> None:

@@ -49,8 +49,12 @@ def test_join_left_id_duplicate_matches_raises():
         200 | x
         """
     )
-    with pytest.raises(Exception):
-        _rows_of(t1.join(t2, t1.k == t2.k, id=pw.left.id).select(c=t2.b))
+    # per-node containment (VERDICT r1): the id-collision error is routed
+    # to the error log and the run survives instead of aborting
+    rows = _rows_of(t1.join(t2, t1.k == t2.k, id=pw.left.id).select(c=t2.b))
+    assert rows == {}
+    ctx = pw.G.last_run_ctx
+    assert any("join" in e and "right matches" in e for e in ctx.error_log)
 
 
 def test_duplicate_column_reference_in_expr():
