@@ -22,6 +22,7 @@ from typing import Any, Callable, Iterable, Sequence
 from pathway_tpu.internals import api
 from pathway_tpu.internals import keys as K
 from pathway_tpu.internals.keys import Pointer
+from pathway_tpu.engine import cluster as cl
 from pathway_tpu.engine.reducers import ReducerImpl
 from pathway_tpu.engine.stream import Batch, Update, consolidate, per_key_changes
 
@@ -117,8 +118,6 @@ class InputNode(Node):
         self.upsert = upsert
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by_key] if self.upsert else None
 
     def make_state(self) -> Any:
@@ -272,8 +271,6 @@ class IntersectNode(Node):
         super().__init__(graph, [main, *others], name)
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by_key] * len(self.inputs)
 
     def make_state(self):
@@ -321,8 +318,6 @@ class SubtractNode(Node):
         super().__init__(graph, [main, other], name)
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by_key, cl.route_by_key]
 
     def make_state(self):
@@ -355,8 +350,6 @@ class UpdateRowsNode(Node):
         super().__init__(graph, [a, b], name)
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by_key, cl.route_by_key]
 
     def make_state(self):
@@ -395,8 +388,6 @@ class UpdateCellsNode(Node):
         self.col_map = col_map
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by_key, cl.route_by_key]
 
     def make_state(self):
@@ -461,8 +452,6 @@ class GroupByNode(Node):
         self.include_group_values = include_group_values
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by(self.group_fn)]
 
     def make_state(self):
@@ -530,8 +519,6 @@ class DeduplicateNode(Node):
         self.acceptor = acceptor
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by(self.instance_fn)]
 
     def make_state(self):
@@ -597,8 +584,6 @@ class JoinNode(Node):
         self.left_id_only = left_id_only
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by(self.left_jk_fn), cl.route_by(self.right_jk_fn)]
 
     def make_state(self):
@@ -790,8 +775,6 @@ class ZipNode(Node):
         self.widths = list(widths)
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by_key] * len(self.inputs)
 
     def make_state(self):
@@ -840,8 +823,6 @@ class SortNode(Node):
         self.instance_fn = instance_fn
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_by(self.instance_fn)]
 
     def make_state(self):
@@ -909,8 +890,6 @@ class AsyncMapNode(Node):
         self.batch_fn = batch_fn
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_to_zero]
 
     def make_state(self):
@@ -959,8 +938,6 @@ class OutputNode(Node):
         self._on_end = on_end
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_to_zero]
 
     def make_state(self):
@@ -993,8 +970,6 @@ class CaptureNode(Node):
         super().__init__(graph, [input], name)
 
     def exchange_routes(self):
-        from pathway_tpu.engine import cluster as cl
-
         return [cl.route_to_zero]
 
     def make_state(self):

@@ -88,6 +88,25 @@ class Scheduler:
         self.persistence: Any = None
 
     # ------------------------------------------------------------------
+    def active_closure(self, root_ids: set[int]) -> set[int]:
+        """Node ids reachable from ``root_ids`` or from always-tick nodes —
+        the only operators that can see data this epoch.  Every worker
+        computes this from the SAME gathered input ids, so collectives for
+        globally-idle nodes are skipped in lockstep."""
+        roots = set(root_ids)
+        for node in self.graph.nodes:
+            if node.always_tick:
+                roots.add(node.id)
+        active = set(roots)
+        frontier = list(roots)
+        while frontier:
+            nid = frontier.pop()
+            for consumer, _port in self.consumers.get(nid, ()):
+                if consumer.id not in active:
+                    active.add(consumer.id)
+                    frontier.append(consumer.id)
+        return active
+
     def run_epoch(
         self,
         time: int,
@@ -96,6 +115,7 @@ class Scheduler:
         ctx: RunContext | None = None,
         cluster: Cluster | None = None,
         tid: int = 0,
+        active: set[int] | None = None,
     ) -> None:
         ctx = ctx or self.ctx
         ctx.time = time
@@ -104,6 +124,8 @@ class Scheduler:
         for nid, batch in inject.items():
             pending[nid][0] = list(batch)
         for node in self.graph.nodes:
+            if active is not None and node.id not in active:
+                continue  # globally idle this epoch: no data can reach it
             ins = pending.pop(node.id, None)
             routes = node.exchange_routes() if W > 1 else None
             if routes is not None:
@@ -360,6 +382,12 @@ class Scheduler:
         # persistence replay (per-worker streams): all workers replay in
         # lockstep — the epoch count is agreed first so collectives align
         t, replayed_counts = self._cluster_replay(cluster, tid, ctx, my_inputs, t)
+        if self.persistence is not None and self.persistence.replay_only:
+            # record/replay mode: the snapshot IS the input; starting live
+            # readers here would double-count every row
+            ctx.time = t
+            self._finish(ctx=ctx, cluster=cluster, tid=tid)
+            return
 
         q: "queue.Queue" = queue.Queue()
         for node, subject in my_inputs:
@@ -418,6 +446,7 @@ class Scheduler:
                 commit_requested,
                 self._stop.is_set(),
                 elapsed_ms,
+                tuple(sorted(nid for nid, b in buffers.items() if b)),
             )
             statuses = cluster.allgather(("s", round_no), tid, status)
             round_no += 1
@@ -427,12 +456,18 @@ class Scheduler:
             any_commit = any(s[3] for s in statuses)
             stop = any(s[4] for s in statuses)
             autocommit_due = max(s[5] for s in statuses) >= self.autocommit_ms
+            buffered_ids = {nid for s in statuses for nid in s[6]}
             source_done = all_closed and no_aux
-            if any_data and (any_commit or autocommit_due or source_done or stop):
+            if buffered_ids and (any_commit or autocommit_due or source_done or stop):
                 inject = {nid: b for nid, b in buffers.items() if b}
                 buffers = defaultdict(list)
                 commit_requested = False
-                self.run_epoch(t, inject, ctx=ctx, cluster=cluster, tid=tid)
+                # only exchange at operators data can actually reach — the
+                # closure is identical on every worker (same gathered ids)
+                self.run_epoch(
+                    t, inject, ctx=ctx, cluster=cluster, tid=tid,
+                    active=self.active_closure(buffered_ids),
+                )
                 t += TIME_STEP
                 last_cut = _time.monotonic()
             elif stop or (source_done and not any_data):

@@ -1,0 +1,188 @@
+"""HuggingFace BERT-family checkpoint → flax parameter converter.
+
+The reference loads real SentenceTransformer / CrossEncoder torch
+checkpoints (``xpacks/llm/embedders.py:270-327``, ``rerankers.py:186-235``).
+This converter maps a locally stored HF checkpoint (``model.safetensors``
+or ``pytorch_model.bin`` + ``config.json`` + ``vocab.txt``) onto the
+TPU-native flax modules in :mod:`pathway_tpu.models.encoder`, so
+MiniLM/BGE/E5 and the BGE reranker run with their published weights on the
+MXU.  No network access is attempted — everything reads local files.
+
+Weight layout translation (torch ``nn.Linear`` stores ``[out, in]``; flax
+``Dense`` kernels are ``[in, out]``; our attention uses ``DenseGeneral``
+with fused ``[in, heads, head_dim]`` kernels):
+
+==================================================  =========================
+HF name                                             flax path
+==================================================  =========================
+embeddings.word_embeddings.weight                   embeddings/word/embedding
+embeddings.position_embeddings.weight               embeddings/position/embedding
+embeddings.token_type_embeddings.weight             embeddings/type/embedding
+embeddings.LayerNorm.{weight,bias}                  embeddings/ln/{scale,bias}
+encoder.layer.N.attention.self.query.{weight,bias}  layer_N/attention/query
+  (weight.T reshaped [hidden, heads, head_dim])
+encoder.layer.N.attention.output.dense              layer_N/attention/out
+  (weight.T reshaped [heads, head_dim, hidden])
+encoder.layer.N.attention.output.LayerNorm          layer_N/attention_ln
+encoder.layer.N.intermediate.dense                  layer_N/mlp_up
+encoder.layer.N.output.dense                        layer_N/mlp_down
+encoder.layer.N.output.LayerNorm                    layer_N/mlp_ln
+pooler.dense                                        pooler   (cross-encoder)
+classifier                                          classifier
+==================================================  =========================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.models.encoder import EncoderConfig
+
+__all__ = [
+    "load_state_dict",
+    "config_from_hf",
+    "convert_bert_checkpoint",
+    "load_encoder",
+]
+
+
+def load_state_dict(model_dir: str) -> dict[str, np.ndarray]:
+    """Read a checkpoint directory's weights as numpy arrays
+    (safetensors preferred, torch pickle fallback)."""
+    st_path = os.path.join(model_dir, "model.safetensors")
+    if os.path.exists(st_path):
+        from safetensors.numpy import load_file
+
+        return dict(load_file(st_path))
+    bin_path = os.path.join(model_dir, "pytorch_model.bin")
+    if os.path.exists(bin_path):
+        import torch
+
+        sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+        return {k: v.numpy() for k, v in sd.items()}
+    raise FileNotFoundError(
+        f"no model.safetensors or pytorch_model.bin under {model_dir}"
+    )
+
+
+def config_from_hf(
+    model_dir: str, *, pool: str | None = None, num_labels: int = 0, **overrides: Any
+) -> EncoderConfig:
+    """EncoderConfig from a checkpoint's ``config.json``."""
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf = json.load(f)
+    if pool is None:
+        # BGE-style retrievers pool CLS; sentence-transformers default mean
+        pool = "cls" if "bge" in str(hf.get("_name_or_path", "")).lower() else "mean"
+    cfg = EncoderConfig(
+        vocab_size=hf["vocab_size"],
+        hidden=hf["hidden_size"],
+        layers=hf["num_hidden_layers"],
+        heads=hf["num_attention_heads"],
+        mlp_dim=hf["intermediate_size"],
+        max_len=hf.get("max_position_embeddings", 512),
+        type_vocab=hf.get("type_vocab_size", 2),
+        ln_eps=hf.get("layer_norm_eps", 1e-12),
+        gelu_approx=hf.get("hidden_act", "gelu") in ("gelu_new", "gelu_pytorch_tanh"),
+        pool=pool,
+        num_labels=num_labels or int(hf.get("num_labels", 0) if hf.get("architectures", [""])[0].endswith("SequenceClassification") else 0),
+    )
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
+def _strip_prefix(sd: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Drop wrapper prefixes (``bert.``, ``model.``, ``roberta.``)."""
+    for prefix in ("bert.", "model.", "roberta.", "distilbert."):
+        if any(k.startswith(prefix + "embeddings") for k in sd):
+            out = {}
+            for k, v in sd.items():
+                out[k[len(prefix):] if k.startswith(prefix) else k] = v
+            return out
+    return sd
+
+
+def convert_bert_checkpoint(
+    sd: dict[str, np.ndarray], cfg: EncoderConfig
+) -> dict[str, Any]:
+    """Torch/HF state dict → flax params tree for TextEncoderModel /
+    CrossEncoderModel (cite: parity test tests/test_models_parity.py)."""
+    sd = _strip_prefix(sd)
+    H, heads, hd = cfg.hidden, cfg.heads, cfg.head_dim
+
+    def t(name: str) -> np.ndarray:
+        return np.asarray(sd[name], dtype=np.float32)
+
+    def linear(name: str) -> dict[str, np.ndarray]:
+        return {"kernel": t(f"{name}.weight").T, "bias": t(f"{name}.bias")}
+
+    def ln(name: str) -> dict[str, np.ndarray]:
+        return {"scale": t(f"{name}.weight"), "bias": t(f"{name}.bias")}
+
+    def qkv(name: str) -> dict[str, np.ndarray]:
+        return {
+            "kernel": t(f"{name}.weight").T.reshape(H, heads, hd),
+            "bias": t(f"{name}.bias").reshape(heads, hd),
+        }
+
+    params: dict[str, Any] = {
+        "embeddings": {
+            "word": {"embedding": t("embeddings.word_embeddings.weight")},
+            "position": {"embedding": t("embeddings.position_embeddings.weight")},
+            "ln": ln("embeddings.LayerNorm"),
+        }
+    }
+    if cfg.type_vocab and "embeddings.token_type_embeddings.weight" in sd:
+        params["embeddings"]["type"] = {
+            "embedding": t("embeddings.token_type_embeddings.weight")
+        }
+    for i in range(cfg.layers):
+        p = f"encoder.layer.{i}"
+        params[f"layer_{i}"] = {
+            "attention": {
+                "query": qkv(f"{p}.attention.self.query"),
+                "key": qkv(f"{p}.attention.self.key"),
+                "value": qkv(f"{p}.attention.self.value"),
+                "out": {
+                    "kernel": t(f"{p}.attention.output.dense.weight").T.reshape(
+                        heads, hd, H
+                    ),
+                    "bias": t(f"{p}.attention.output.dense.bias"),
+                },
+            },
+            "attention_ln": ln(f"{p}.attention.output.LayerNorm"),
+            "mlp_up": linear(f"{p}.intermediate.dense"),
+            "mlp_down": linear(f"{p}.output.dense"),
+            "mlp_ln": ln(f"{p}.output.LayerNorm"),
+        }
+    if cfg.num_labels > 0:
+        params["pooler"] = linear("pooler.dense")
+        params["classifier"] = linear("classifier")
+    return {"params": params}
+
+
+def load_encoder(
+    model_dir: str,
+    *,
+    pool: str | None = None,
+    num_labels: int = 0,
+    dtype: Any = None,
+    **overrides: Any,
+) -> tuple[Any, dict[str, Any], Any]:
+    """One-call loader: ``(model, params, tokenizer)`` from a local HF
+    checkpoint directory (``config.json`` + weights + ``vocab.txt``)."""
+    from pathway_tpu.models.encoder import CrossEncoderModel, TextEncoderModel
+    from pathway_tpu.models.wordpiece import WordPieceTokenizer
+
+    cfg = config_from_hf(model_dir, pool=pool, num_labels=num_labels, **overrides)
+    if dtype is not None:
+        cfg = dataclasses.replace(cfg, dtype=dtype)
+    params = convert_bert_checkpoint(load_state_dict(model_dir), cfg)
+    model = CrossEncoderModel(cfg) if cfg.num_labels > 0 else TextEncoderModel(cfg)
+    vocab = os.path.join(model_dir, "vocab.txt")
+    tok = WordPieceTokenizer(vocab) if os.path.exists(vocab) else None
+    return model, params, tok

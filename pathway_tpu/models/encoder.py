@@ -56,6 +56,9 @@ class EncoderConfig:
     dtype: Any = jnp.bfloat16  # activation dtype
     param_dtype: Any = jnp.float32
     ln_eps: float = 1e-12
+    #: tanh-approximated gelu (faster on MXU); HF "gelu" is the exact erf
+    #: form — the checkpoint converter sets this from config.json
+    gelu_approx: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -118,7 +121,7 @@ class EncoderBlock(nn.Module):
         h = nn.Dense(
             cfg.mlp_dim, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="mlp_up"
         )(x)
-        h = nn.gelu(h, approximate=True)
+        h = nn.gelu(h, approximate=cfg.gelu_approx)
         h = nn.Dense(
             cfg.hidden, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="mlp_down"
         )(h)

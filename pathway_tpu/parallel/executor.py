@@ -39,7 +39,7 @@ class JittedEncoder:
 
     def __init__(
         self,
-        config: EncoderConfig,
+        config: EncoderConfig | None,
         *,
         cross: bool = False,
         tokenizer: Tokenizer | None = None,
@@ -51,7 +51,41 @@ class JittedEncoder:
         max_len: int | None = None,
         seed: int = 0,
         params: Any = None,
+        checkpoint_dir: str | None = None,
     ):
+        if checkpoint_dir is not None:
+            # real pretrained weights: config/params/vocab all from the
+            # local HF checkpoint directory (models/convert.py).  Pass
+            # config=None to let config.json decide pooling (BGE -> cls);
+            # an explicit config only overrides pool/dtype here.
+            import dataclasses as _dc
+
+            from pathway_tpu.models import convert as _convert
+            from pathway_tpu.models.wordpiece import WordPieceTokenizer
+            import os as _os
+
+            if params is not None:
+                raise ValueError(
+                    "pass either params= or checkpoint_dir=, not both — "
+                    "explicit params would be silently replaced"
+                )
+            user_cfg = config
+            config = _convert.config_from_hf(
+                checkpoint_dir,
+                pool=user_cfg.pool if user_cfg is not None else None,
+                num_labels=1 if cross else 0,
+            )
+            config = _dc.replace(config, normalize=not cross)
+            if user_cfg is not None:
+                config = _dc.replace(config, dtype=user_cfg.dtype)
+            params = _convert.convert_bert_checkpoint(
+                _convert.load_state_dict(checkpoint_dir), config
+            )
+            vocab = _os.path.join(checkpoint_dir, "vocab.txt")
+            if tokenizer is None and _os.path.exists(vocab):
+                tokenizer = WordPieceTokenizer(vocab)
+        elif config is None:
+            raise ValueError("config is required without checkpoint_dir")
         self.config = config
         self.cross = cross
         self.mesh = mesh

@@ -84,13 +84,23 @@ class TPUEncoderEmbedder(BaseEmbedder):
         **kwargs: Any,
     ):
         super().__init__(max_batch_size=max_batch_size, **kwargs)
+        import os
+
         from pathway_tpu.parallel import JittedEncoder
 
-        cfg = config if config is not None else _resolve_config(model)
+        # a local directory means a real HF checkpoint (weights + vocab);
+        # otherwise an architecture preset with deterministic random init.
+        # With a checkpoint, config.json decides pooling etc. unless the
+        # caller explicitly passed a config.
+        checkpoint_dir = model if os.path.isdir(model) else None
+        if config is None:
+            cfg = None if checkpoint_dir else _resolve_config(model)
+        else:
+            cfg = config
         self.model = model
         self.encoder = JittedEncoder(
             cfg, mesh=mesh, model_name=model, params=params,
-            max_batch=max_batch_size or 1024,
+            max_batch=max_batch_size or 1024, checkpoint_dir=checkpoint_dir,
         )
 
     def _embed_batch(self, texts: list[str]) -> list:

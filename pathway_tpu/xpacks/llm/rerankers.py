@@ -47,13 +47,19 @@ class CrossEncoderReranker(UDF):
         **kwargs: Any,
     ):
         super().__init__(max_batch_size=max_batch_size, **kwargs)
+        import os
+
         from pathway_tpu.models import BGE_RERANKER_BASE
         from pathway_tpu.parallel import JittedEncoder
 
-        cfg = config if config is not None else BGE_RERANKER_BASE
+        checkpoint_dir = model_name if os.path.isdir(model_name) else None
+        if config is None:
+            cfg = None if checkpoint_dir else BGE_RERANKER_BASE
+        else:
+            cfg = config
         self.encoder = JittedEncoder(
             cfg, cross=True, mesh=mesh, model_name=model_name, params=params,
-            max_batch=max_batch_size or 256,
+            max_batch=max_batch_size or 256, checkpoint_dir=checkpoint_dir,
         )
 
     def __batch__(self, docs: list, queries: list) -> list[float]:

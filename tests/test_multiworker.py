@@ -31,9 +31,28 @@ _port_counter = [11000 + (os.getpid() % 500) * 16]
 
 
 def next_port(n: int = 4) -> int:
-    p = _port_counter[0]
-    _port_counter[0] += n
-    return p
+    """A base port with `n` consecutive bindable ports (probed, so stray
+    listeners from an earlier killed run can't collide)."""
+    import socket
+
+    while True:
+        base = _port_counter[0]
+        _port_counter[0] += n
+        if _port_counter[0] > 60000:
+            _port_counter[0] = 11000
+        try:
+            socks = []
+            for i in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+        return base
 
 
 def _run_threads(n_threads: int):
