@@ -86,8 +86,55 @@ class Scheduler:
         self._stop = threading.Event()
         #: persistence hooks (set by pathway_tpu.persistence.attach_persistence)
         self.persistence: Any = None
+        #: per-worker wall time of the last operator snapshot (rate limit)
+        self._last_snapshot_at: dict[int, float] = {}
 
     # ------------------------------------------------------------------
+    def _maybe_snapshot(
+        self,
+        worker: int,
+        epoch: int,
+        consumed: dict[int, int],
+        wrappers: dict[int, Any],
+        ctx: RunContext | None = None,
+    ) -> None:
+        """Operator snapshot, rate-limited by snapshot_interval_ms.  The
+        input logs are force-committed FIRST so the snapshot's consumed
+        counts always lie within each log's committed prefix."""
+        interval = max(
+            getattr(self.persistence.config, "snapshot_interval_ms", 0),
+            self.autocommit_ms,
+        )
+        now = _time.monotonic()
+        if (now - self._last_snapshot_at.get(worker, 0.0)) * 1000.0 < interval:
+            return
+        self._last_snapshot_at[worker] = now
+        self._final_snapshot(worker, epoch, consumed, wrappers, ctx=ctx)
+
+    def _final_snapshot(
+        self,
+        worker: int,
+        epoch: int,
+        consumed: dict[int, int],
+        wrappers: dict[int, Any],
+        ctx: RunContext | None = None,
+    ) -> None:
+        """Unconditional snapshot: force-commit the input logs (so consumed
+        counts lie within each log's committed prefix), then persist the
+        worker's node states.  Called after the finalizing epoch on clean
+        shutdown, so buffered windows flushed by finalize never re-flush
+        on resume."""
+        if self.persistence is None or not self.persistence.operator_mode:
+            return
+        for w in wrappers.values():
+            fc = getattr(w, "force_log_commit", None)
+            if fc is not None:
+                fc()
+        ctx = ctx or self.ctx
+        self.persistence.save_operator_snapshot(
+            worker, epoch, consumed, ctx.states
+        )
+
     def active_closure(self, root_ids: set[int]) -> set[int]:
         """Node ids reachable from ``root_ids`` or from always-tick nodes —
         the only operators that can see data this epoch.  Every worker
@@ -183,11 +230,16 @@ class Scheduler:
         ctx: RunContext | None = None,
         cluster: Cluster | None = None,
         tid: int = 0,
+        post_epoch: Any = None,
     ) -> None:
         # final flush epoch: frontier advances to +inf; buffering operators release
         ctx = ctx or self.ctx
         ctx.finalizing = True  # type: ignore[attr-defined]
         self.run_epoch(ctx.time + TIME_STEP, {}, ctx=ctx, cluster=cluster, tid=tid)
+        if post_epoch is not None:
+            # operator snapshot AFTER the finalizing flush, so restored
+            # state never re-flushes buffered windows
+            post_epoch()
         for node in self.graph.nodes:
             node.on_end(ctx)
 
@@ -212,19 +264,44 @@ class Scheduler:
 
         # --- streaming mode -------------------------------------------
         t = 0
-        if static_inject:
+        # operator snapshot (OPERATOR_PERSISTING): restore compacted node
+        # states, skip recomputation; only the committed tail past the
+        # snapshot's consumed counts is replayed (bounded replay —
+        # reference src/persistence/operator_snapshot.rs)
+        snap: dict | None = None
+        if self.persistence is not None and self.persistence.operator_mode:
+            snap = self.persistence.load_operator_snapshot(0)
+        if snap is not None:
+            self.ctx.states = snap["states"]
+            t = snap["epoch"] + TIME_STEP
+        elif static_inject:
+            # static rows re-inject only when no snapshot holds them already
             self.run_epoch(t, static_inject)
             t += TIME_STEP
 
         # persistence: replay committed input snapshots as leading epochs
         replayed_counts: dict[int, int] = {}
+        consumed: dict[int, int] = dict(snap["consumed"]) if snap else {}
+        self.ctx.consumed = consumed  # type: ignore[attr-defined]
         if self.persistence is not None:
             self.persistence.check_topology(1)
             for node in live_inputs:
                 events = self.persistence.replay_events(node)
-                replayed_counts[node.id] = sum(
-                    1 for kind, _k, _v in events if kind != "commit"
-                )
+                data = [e for e in events if e[0] != "commit"]
+                replayed_counts[node.id] = len(data)
+                if snap is not None:
+                    skip = consumed.get(node.id, 0)
+                    tail = data[skip:]
+                    if tail:
+                        batch = [
+                            Update(key, values, 1 if kind == "add" else -1)
+                            for kind, key, values in tail
+                        ]
+                        self.run_epoch(t, {node.id: batch})
+                        t += TIME_STEP
+                    consumed[node.id] = max(skip, len(data))
+                    continue
+                consumed[node.id] = len(data)
                 epoch: list[Update] = []
                 for kind, key, values in events:
                     if kind == "add":
@@ -242,12 +319,14 @@ class Scheduler:
 
         q: "queue.Queue" = queue.Queue()
         threads: list[threading.Thread] = []
+        wrappers: dict[int, Any] = {}
         for node in live_inputs:
             events: Any = ConnectorEvents(q, node.id, self._stop)
             if self.persistence is not None:
                 events = self.persistence.wrap_events(
                     node, events, replayed_counts.get(node.id, 0)
                 )
+                wrappers[node.id] = events
             t_ = threading.Thread(
                 target=self._run_subject, args=(node, events), daemon=True
             )
@@ -286,9 +365,16 @@ class Scheduler:
                 inject = {nid: b for nid, b in buffers.items() if b}
                 buffers = defaultdict(list)
                 commit_requested = False
+                for nid, b in inject.items():
+                    consumed[nid] = consumed.get(nid, 0) + len(b)
                 self.run_epoch(t, inject)
                 t += TIME_STEP
                 last_cut = now
+                if (
+                    self.persistence is not None
+                    and self.persistence.operator_mode
+                ):
+                    self._maybe_snapshot(0, t - TIME_STEP, consumed, wrappers)
             if not open_subjects and not any(buffers.values()):
                 # order matters: loopback workers enqueue their result BEFORE
                 # decrementing pending, so pending==0 guarantees every result
@@ -302,7 +388,11 @@ class Scheduler:
             if self._stop.is_set():
                 break
         self.ctx.time = t
-        self._finish()
+        self._finish(
+            post_epoch=lambda: self._final_snapshot(
+                0, self.ctx.time, consumed, wrappers
+            )
+        )
         return self.ctx
 
     # ------------------------------------------------------------------
@@ -367,21 +457,24 @@ class Scheduler:
             elif w == 0:
                 my_inputs.append((node, node.subject))
 
-        t = 0
-        if any(
+        have_static = any(
             isinstance(n, InputNode) and n.static_rows for n in self.graph.nodes
-        ):
-            self.run_epoch(t, static_inject, ctx=ctx, cluster=cluster, tid=tid)
-            t += TIME_STEP
-
+        )
+        t = 0
         if not live_node_ids:
-            ctx.time = t - TIME_STEP if t else 0
+            if have_static:
+                self.run_epoch(t, static_inject, ctx=ctx, cluster=cluster, tid=tid)
+            ctx.time = 0
             self._finish(ctx=ctx, cluster=cluster, tid=tid)
             return
 
         # persistence replay (per-worker streams): all workers replay in
-        # lockstep — the epoch count is agreed first so collectives align
-        t, replayed_counts = self._cluster_replay(cluster, tid, ctx, my_inputs, t)
+        # lockstep — the epoch count is agreed first so collectives align.
+        # Static rows inject inside (skipped when a snapshot holds them).
+        t, replayed_counts = self._cluster_replay(
+            cluster, tid, ctx, my_inputs, t,
+            static_inject=static_inject if have_static else None,
+        )
         if self.persistence is not None and self.persistence.replay_only:
             # record/replay mode: the snapshot IS the input; starting live
             # readers here would double-count every row
@@ -390,12 +483,14 @@ class Scheduler:
             return
 
         q: "queue.Queue" = queue.Queue()
+        wrappers: dict[int, Any] = {}
         for node, subject in my_inputs:
             events: Any = ConnectorEvents(q, node.id, self._stop)
             if self.persistence is not None:
                 events = self.persistence.wrap_events(
                     node, events, replayed_counts.get(node.id, 0), worker=w
                 )
+                wrappers[node.id] = events
             threading.Thread(
                 target=self._run_subject_obj,
                 args=(node, subject, events),
@@ -462,6 +557,9 @@ class Scheduler:
                 inject = {nid: b for nid, b in buffers.items() if b}
                 buffers = defaultdict(list)
                 commit_requested = False
+                consumed = getattr(ctx, "consumed", {})
+                for nid, b in inject.items():
+                    consumed[nid] = consumed.get(nid, 0) + len(b)
                 # only exchange at operators data can actually reach — the
                 # closure is identical on every worker (same gathered ids)
                 self.run_epoch(
@@ -470,13 +568,25 @@ class Scheduler:
                 )
                 t += TIME_STEP
                 last_cut = _time.monotonic()
+                if (
+                    self.persistence is not None
+                    and self.persistence.operator_mode
+                ):
+                    self._maybe_snapshot(
+                        w, t - TIME_STEP, consumed, wrappers, ctx=ctx
+                    )
             elif stop or (source_done and not any_data):
                 break
             else:
                 # pace the next status round: batch up to ~autocommit_ms
                 _time.sleep(self.autocommit_ms / 1000.0 / 5.0)
         ctx.time = t
-        self._finish(ctx=ctx, cluster=cluster, tid=tid)
+        self._finish(
+            ctx=ctx, cluster=cluster, tid=tid,
+            post_epoch=lambda: self._final_snapshot(
+                w, ctx.time, getattr(ctx, "consumed", {}), wrappers, ctx=ctx
+            ),
+        )
 
     def _cluster_replay(
         self,
@@ -485,20 +595,51 @@ class Scheduler:
         ctx: RunContext,
         my_inputs: list[tuple[InputNode, Any]],
         t: int,
+        static_inject: dict[int, Batch] | None = None,
     ) -> tuple[int, dict[int, int]]:
         """Replay persisted input snapshots in lockstep across workers.
-        Returns (next epoch time, data-event count replayed per input)."""
+        Returns (next epoch time, data-event count replayed per input).
+
+        With an operator snapshot (OPERATOR_PERSISTING), each worker
+        restores its own state shard and replays only its committed tail;
+        the starting epoch and replay epoch count are agreed by allgather
+        so collectives stay aligned."""
         replayed_counts: dict[int, int] = {}
         epochs_per_input: dict[int, list[Batch]] = {}
+        snap: dict | None = None
         if self.persistence is not None:
             w = cluster.worker_index(tid)
             if w == 0:
                 self.persistence.check_topology(cluster.n_workers)
+            if self.persistence.operator_mode:
+                snap = self.persistence.load_operator_snapshot(w)
+                # all-or-none: a worker whose blob is missing (crash between
+                # per-worker saves) must force full replay everywhere, or
+                # its state shard would silently lose history
+                have = cluster.allgather(("snap_presence",), tid, snap is not None)
+                if not all(have):
+                    snap = None
+            consumed: dict[int, int] = dict(snap["consumed"]) if snap else {}
+            ctx.consumed = consumed  # type: ignore[attr-defined]
+            if snap is not None:
+                ctx.states = snap["states"]
             for node, _subject in my_inputs:
                 events = self.persistence.replay_events(node, worker=w)
-                replayed_counts[node.id] = sum(
-                    1 for kind, _k, _v in events if kind != "commit"
-                )
+                data = [e for e in events if e[0] != "commit"]
+                replayed_counts[node.id] = len(data)
+                if snap is not None:
+                    skip = consumed.get(node.id, 0)
+                    tail = data[skip:]
+                    consumed[node.id] = max(skip, len(data))
+                    if tail:
+                        epochs_per_input[node.id] = [
+                            [
+                                Update(key, values, 1 if kind == "add" else -1)
+                                for kind, key, values in tail
+                            ]
+                        ]
+                    continue
+                consumed[node.id] = len(data)
                 epochs: list[Batch] = []
                 cur: list[Update] = []
                 for kind, key, values in events:
@@ -511,9 +652,22 @@ class Scheduler:
                         cur = []
                 if epochs:
                     epochs_per_input[node.id] = epochs
+        # agree on the starting epoch (snapshot epochs may differ per
+        # worker) and on the replay epoch count — exchange slots are keyed
+        # by time, so every worker must walk the same sequence
         my_len = max((len(e) for e in epochs_per_input.values()), default=0)
-        lens = cluster.allgather(("replay_len",), tid, my_len)
-        n_epochs = max(lens)
+        my_t0 = (snap["epoch"] + TIME_STEP) if snap is not None else t
+        agreed = cluster.allgather(
+            ("replay_len",), tid, (my_len, my_t0, snap is not None)
+        )
+        n_epochs = max(a[0] for a in agreed)
+        t = max(max(a[1] for a in agreed), t)
+        any_snap = any(a[2] for a in agreed)
+        if static_inject is not None and not any_snap:
+            # static rows: one collective epoch, injected on worker 0 only
+            # (snapshots already contain them, hence the any_snap guard)
+            self.run_epoch(t, static_inject, ctx=ctx, cluster=cluster, tid=tid)
+            t += TIME_STEP
         for i in range(n_epochs):
             inject = {
                 nid: epochs[i]

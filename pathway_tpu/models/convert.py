@@ -79,6 +79,15 @@ def config_from_hf(
     if pool is None:
         # BGE-style retrievers pool CLS; sentence-transformers default mean
         pool = "cls" if "bge" in str(hf.get("_name_or_path", "")).lower() else "mean"
+    # HF does not serialize num_labels itself — classification heads are
+    # detected via the architectures list, width via id2label
+    archs = hf.get("architectures") or []
+    is_classifier = any(str(a).endswith("SequenceClassification") for a in archs)
+    detected_labels = 0
+    if is_classifier:
+        detected_labels = int(
+            hf.get("num_labels") or len(hf.get("id2label") or {}) or 1
+        )
     cfg = EncoderConfig(
         vocab_size=hf["vocab_size"],
         hidden=hf["hidden_size"],
@@ -90,7 +99,7 @@ def config_from_hf(
         ln_eps=hf.get("layer_norm_eps", 1e-12),
         gelu_approx=hf.get("hidden_act", "gelu") in ("gelu_new", "gelu_pytorch_tanh"),
         pool=pool,
-        num_labels=num_labels or int(hf.get("num_labels", 0) if hf.get("architectures", [""])[0].endswith("SequenceClassification") else 0),
+        num_labels=num_labels or detected_labels,
     )
     return dataclasses.replace(cfg, **overrides) if overrides else cfg
 

@@ -192,8 +192,8 @@ class WordPieceTokenizer(Tokenizer):
             if pair is not None:
                 second = self.tokenize_ids(pair[i])
                 # HF "longest_first" pair truncation: trim the longer side
-                budget = max_len - 3
-                while len(first) + len(second) > budget:
+                budget = max(0, max_len - 3)
+                while len(first) + len(second) > budget and (first or second):
                     if len(first) >= len(second):
                         first = first[:-1]
                     else:
@@ -201,7 +201,7 @@ class WordPieceTokenizer(Tokenizer):
                 ids = [self.cls_id] + first + [self.sep_id] + second + [self.sep_id]
                 tps = [0] * (len(first) + 2) + [1] * (len(second) + 1)
             else:
-                ids = [self.cls_id] + first[: max_len - 2] + [self.sep_id]
+                ids = [self.cls_id] + first[: max(0, max_len - 2)] + [self.sep_id]
                 tps = [0] * len(ids)
             rows.append(ids)
             types.append(tps)

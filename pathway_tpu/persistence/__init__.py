@@ -57,6 +57,13 @@ class _BackendImpl:
         ``Connector::rewind_from_disk_snapshot``)."""
         raise NotImplementedError
 
+    def put_blob(self, name: str, data: bytes) -> None:
+        """Atomically store a named blob (operator snapshots)."""
+        raise NotImplementedError
+
+    def get_blob(self, name: str) -> bytes | None:
+        raise NotImplementedError
+
     def put_meta(self, data: dict) -> None:
         raise NotImplementedError
 
@@ -68,9 +75,12 @@ class _MemoryBackend(_BackendImpl):
     _stores: dict[str, dict] = {}
 
     def __init__(self, namespace: str = "default"):
-        store = self._stores.setdefault(namespace, {"streams": {}, "meta": {}})
+        store = self._stores.setdefault(
+            namespace, {"streams": {}, "meta": {}, "blobs": {}}
+        )
         self._streams = store["streams"]
         self._meta = store["meta"]
+        self._blobs = store.setdefault("blobs", {})
         self._lock = threading.Lock()
 
     def append(self, stream, record):
@@ -85,6 +95,13 @@ class _MemoryBackend(_BackendImpl):
             records = self._streams.get(stream)
             if records is not None and len(records) > n_records:
                 del records[n_records:]
+
+    def put_blob(self, name, data):
+        with self._lock:
+            self._blobs[name] = data
+
+    def get_blob(self, name):
+        return self._blobs.get(name)
 
     def put_meta(self, data):
         self._meta.clear()
@@ -164,6 +181,23 @@ class _FsBackend(_BackendImpl):
                 f.truncate(keep)
                 f.flush()
                 os.fsync(f.fileno())
+
+    def put_blob(self, name, data):
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+        tmp = os.path.join(self.path, f"{safe}.blob.tmp")
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.path, f"{safe}.blob"))
+
+    def get_blob(self, name):
+        safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in name)
+        path = os.path.join(self.path, f"{safe}.blob")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
 
     def put_meta(self, data):
         tmp = os.path.join(self.path, "metadata.json.tmp")
@@ -262,6 +296,20 @@ class _RecordingEvents:
     def remove(self, key, values):
         self._record_and_forward("remove", key, values, self._inner.remove)
 
+    def force_log_commit(self):
+        """Commit the log WITHOUT cutting an engine epoch — called when an
+        operator snapshot is taken, so every recorded event is committed
+        and the snapshot's consumed counts always lie within the committed
+        prefix (never past it)."""
+        if self._dirty:
+            from pathway_tpu.io import _connector as _conn
+
+            self._impl.append(
+                self._stream,
+                pickle.dumps(("commit", _conn._autogen_counter.peek(), None)),
+            )
+            self._dirty = False
+
     def commit(self):
         if self.resume_offset > 0:
             return  # still skipping the replayed prefix: don't re-log commits
@@ -292,6 +340,46 @@ class PersistenceHooks:
             PersistenceMode.REALTIME_REPLAY,
             PersistenceMode.SPEEDRUN_REPLAY,
         )
+        #: persist compacted operator state so restart skips recomputation
+        #: (reference src/persistence/operator_snapshot.rs:21-337)
+        self.operator_mode = (
+            config.persistence_mode == PersistenceMode.OPERATOR_PERSISTING
+        )
+
+    # -- operator snapshots -------------------------------------------
+    def save_operator_snapshot(
+        self,
+        worker: int,
+        epoch: int,
+        consumed: dict[int, int],
+        states: dict[int, Any],
+    ) -> bool:
+        """Persist ``{epoch, per-input consumed data-event counts, node
+        states}`` for one worker.  Returns False (and disables nothing)
+        when a state is unpicklable — recovery then falls back to full
+        input replay for correctness."""
+        try:
+            blob = pickle.dumps(
+                {"epoch": epoch, "consumed": dict(consumed), "states": states},
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+        except Exception as e:  # unpicklable state (e.g. device buffers)
+            _logger.warning(
+                "operator snapshot skipped (unpicklable state): %r", e
+            )
+            return False
+        self.impl.put_blob(f"opsnap_w{worker}", blob)
+        return True
+
+    def load_operator_snapshot(self, worker: int) -> dict | None:
+        blob = self.impl.get_blob(f"opsnap_w{worker}")
+        if blob is None:
+            return None
+        try:
+            return pickle.loads(blob)
+        except Exception as e:
+            _logger.warning("operator snapshot unreadable, replaying: %r", e)
+            return None
 
     def check_topology(self, n_workers: int) -> None:
         """Snapshot streams are per-worker; resuming under a different

@@ -221,10 +221,12 @@ _WORDCOUNT_PROGRAM = textwrap.dedent(
 
 
 def _spawn_program(tmp_path, input_file, output_file, *, processes, threads,
-                   mode="static", persist_dir=None, first_port=None):
+                   mode="static", persist_dir=None, first_port=None,
+                   persist_mode="persisting"):
     persistence = (
-        f"from pathway_tpu.persistence import Backend, Config\n"
-        f"pconf = Config.simple_config(Backend.filesystem({str(persist_dir)!r}))"
+        f"from pathway_tpu.persistence import Backend, Config, PersistenceMode\n"
+        f"pconf = Config.simple_config(Backend.filesystem({str(persist_dir)!r}), "
+        f"persistence_mode=PersistenceMode({persist_mode!r}))"
         if persist_dir
         else "pconf = None"
     )
@@ -328,3 +330,47 @@ def test_process_kill_restart_recovers(tmp_path):
     for w in words:
         expected[w] = expected.get(w, 0) + 1
     assert _final_counts(output_file) == expected
+
+
+def test_cluster_operator_snapshot_kill_restart(tmp_path):
+    """OPERATOR_PERSISTING in a 2-process cluster: kill one process
+    mid-stream, restart, final counts exact with bounded replay."""
+    words = [f"w{i % 5}" for i in range(300)]
+    input_file = tmp_path / "w.jsonl"
+    input_file.write_text("\n".join(json.dumps({"word": w}) for w in words))
+    output_file = tmp_path / "out.jsonl"
+    persist_dir = tmp_path / "snap"
+
+    port = next_port(4)
+    procs = _spawn_program(
+        tmp_path, input_file, output_file, processes=2, threads=1,
+        mode="streaming", persist_dir=persist_dir, first_port=port,
+        persist_mode="operator_persisting",
+    )
+    time.sleep(2.5)
+    procs[0].send_signal(signal.SIGKILL)
+    for p in procs:
+        try:
+            p.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+
+    # restart in static mode; cumulative final state must be exact.
+    # Operator snapshots give CONTINUATION semantics: only groups touched
+    # after the restore re-fire, so merge both runs' outputs.
+    state = _final_counts(output_file)
+    output_file.unlink(missing_ok=True)
+    procs = _spawn_program(
+        tmp_path, input_file, output_file, processes=2, threads=1,
+        mode="static", persist_dir=persist_dir, first_port=next_port(4),
+        persist_mode="operator_persisting",
+    )
+    for p in procs:
+        out, err = p.communicate(timeout=90)
+        assert p.returncode == 0, err.decode()[-2000:]
+    state.update(_final_counts(output_file))
+    expected: dict = {}
+    for w in words:
+        expected[w] = expected.get(w, 0) + 1
+    assert state == expected
