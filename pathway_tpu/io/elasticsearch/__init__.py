@@ -1,16 +1,95 @@
-"""``pw.io.elasticsearch`` — ElasticSearch sink (reference python/pathway/io/elasticsearch; writer src/connectors/data_storage.rs:1336).
+"""``pw.io.elasticsearch`` — Elasticsearch sink (reference
+``python/pathway/io/elasticsearch``; writer ``ElasticSearchWriter``
+``src/connectors/data_storage.rs:1336``).
 
-API-surface parity module: the row/format plumbing routes through the shared
-connector framework; the transport activates when the client library is
-available (external services are unreachable in this build environment).
+Each epoch's updates are flushed as one bulk request: additions index a
+JSON document (the engine row key as ``_id``), retractions delete it.
+The client is injectable (anything with ``bulk(operations=[...])``, e.g.
+``elasticsearch.Elasticsearch``/test doubles); otherwise the official
+client is imported lazily.
 """
 
 from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import Writer, attach_writer, fmt_value
+from pathway_tpu.io._gated import MissingDependency
 
-write = gated_writer("elasticsearch", "elasticsearch")
+__all__ = ["write", "ElasticSearchAuth"]
 
-__all__ = ["write"]
+
+class ElasticSearchAuth:
+    """reference ``pw.io.elasticsearch.ElasticSearchAuth`` (basic/apikey)."""
+
+    def __init__(self, kind: str, **params: Any):
+        self.kind = kind
+        self.params = params
+
+    @classmethod
+    def basic(cls, username: str, password: str) -> "ElasticSearchAuth":
+        return cls("basic", basic_auth=(username, password))
+
+    @classmethod
+    def apikey(cls, api_key: str, api_key_id: str | None = None) -> "ElasticSearchAuth":
+        key = (api_key_id, api_key) if api_key_id else api_key
+        return cls("apikey", api_key=key)
+
+
+class _ElasticWriter(Writer):
+    def __init__(self, host: str, auth: ElasticSearchAuth | None, index_name: str, client: Any):
+        self.host = host
+        self.auth = auth
+        self.index_name = index_name
+        self._client = client
+        self._ops: list[dict] = []
+
+    def _get_client(self) -> Any:
+        if self._client is None:
+            try:
+                from elasticsearch import Elasticsearch  # type: ignore[import-not-found]
+            except ImportError as e:
+                raise MissingDependency(
+                    "elasticsearch client is not installed; pass client= "
+                    "with a bulk()-capable client"
+                ) from e
+            kwargs = dict(self.auth.params) if self.auth else {}
+            self._client = Elasticsearch(self.host, **kwargs)
+        return self._client
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        doc_id = str(row.get("id"))
+        if diff > 0:
+            doc = {k: fmt_value(v) for k, v in row.items() if k != "id"}
+            doc["time"] = time
+            self._ops.append(
+                {"index": {"_index": self.index_name, "_id": doc_id}}
+            )
+            self._ops.append(doc)
+        else:
+            self._ops.append(
+                {"delete": {"_index": self.index_name, "_id": doc_id}}
+            )
+
+    def flush(self) -> None:
+        if not self._ops:
+            return
+        self._get_client().bulk(operations=self._ops)
+        self._ops = []
+
+    def close(self) -> None:
+        self.flush()
+
+
+def write(
+    table: Table,
+    host: str,
+    auth: ElasticSearchAuth | None,
+    index_name: str,
+    *,
+    client: Any = None,
+    name: str = "elasticsearch_out",
+    **kwargs: Any,
+) -> None:
+    attach_writer(table, _ElasticWriter(host, auth, index_name, client), name=name)

@@ -1,21 +1,33 @@
-"""``pw.io.s3_csv`` — S3 CSV reader (reference python/pathway/io/s3_csv).
-
-Delegates settings/transport to ``pw.io.s3``.
-"""
+"""``pw.io.s3_csv`` — S3 CSV reader (reference ``python/pathway/io/s3_csv``):
+``pw.io.s3.read`` preset to the CSV format."""
 
 from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.io._gated import require
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import s3 as _s3
 from pathway_tpu.io.s3 import AwsS3Settings
 
-
-def read(path: str, *args: Any, format: str = "csv", **kwargs: Any) -> Any:
-    require("s3fs")
-    raise NotImplementedError(
-        "pw.io.s3_csv.read: s3fs present but transport not wired in this build"
-    )
-
-
 __all__ = ["read", "AwsS3Settings"]
+
+
+def read(
+    path: str,
+    *,
+    aws_s3_settings: AwsS3Settings | None = None,
+    schema: Any = None,
+    csv_settings: Any = None,
+    mode: str = "streaming",
+    **kwargs: Any,
+) -> Table:
+    return _s3.read(
+        path,
+        aws_s3_settings=aws_s3_settings,
+        format="csv",
+        schema=schema,
+        csv_settings=csv_settings,
+        mode=mode,
+        name=kwargs.pop("name", "s3_csv"),
+        **kwargs,
+    )
