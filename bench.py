@@ -1,22 +1,39 @@
-"""Headline benchmark: p50 retrieval latency over a 1M-doc KNN corpus.
+"""Headline benchmarks for the TPU-native build.
 
-BASELINE.md north star: <50 ms p50 brute-force KNN retrieval over 1M
-docs on TPU (the reference's equivalent component is the Rust
-BruteForceKNN, ``src/external_integration/brute_force_knn_integration.rs``,
-which scans the corpus with host scalar loops).  Here the corpus lives
-in TPU HBM as a bf16 slab; one query = one MXU matmul + top-k.
+Four sections, one JSON line (driver contract: the LAST stdout line):
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
-``vs_baseline`` = baseline_ms / measured_ms (>1 means faster than the
-50 ms target).  Extra context goes to stderr.
+1. **KNN retrieval** (BASELINE.md north star #2: <50 ms p50 over 1M docs).
+   Corpus in TPU HBM as a bf16 slab (reference counterpart: host
+   ``Array2<f64>`` scalar loops,
+   ``src/external_integration/brute_force_knn_integration.rs``); one query
+   batch = one MXU matmul + top-k.  Reported three ways: batched serving
+   (epoch batch of 50 — what ``ExternalIndexNode`` actually dispatches),
+   pipelined batch=1 (4 dispatches in flight hide the host link RTT), and
+   strict sync batch=1 (pays full RTT per call, reported for honesty).
+2. **Ingest**: bulk ``add_batch`` docs/sec into the live index (donated
+   scatters, normalization/cast as whole-array numpy ops).
+3. **Embedding throughput + MFU** (BASELINE.md north star #1: >=10k docs/s
+   BGE-large-class on v5e-8, i.e. 1250 docs/s/chip): tokenize -> jitted
+   bf16 encode -> index, end-to-end.  MFU counts the FLOPs the hardware
+   actually executed (padded seq len) vs device peak.  Reference
+   counterpart: per-row torch ``model.encode``
+   (``python/pathway/xpacks/llm/embedders.py:270-327``).
+4. **Streaming engine wordcount** (reference harness
+   ``integration_tests/wordcount/base.py``): JSONL file -> groupby(word)
+   -> count, input-snapshot persistence ON, single worker host plane.
+
+``vs_baseline`` = baseline_ms / measured_ms for the headline (>1 means
+faster than the 50 ms target).  Extra context goes to stderr.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
+import tempfile
 import time
+from collections import deque
 
 import numpy as np
 
@@ -26,12 +43,41 @@ K = 10
 N_QUERIES = 50
 BASELINE_MS = 50.0
 
+EMBED_SEQ = 128
+EMBED_BATCH = 256  # chunk size; encode() pipelines chunk i+1 over i's readback
+EMBED_DOCS = 4096
+EMBED_TARGET_PER_CHIP = 10_000 / 8  # BASELINE target is for v5e-8
+
+WC_LINES = 2_000_000
+WC_WORDS = 1000
+
+#: bf16 peak FLOPs/s per chip by device_kind substring
+_PEAKS = [
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v6", 918e12),
+    ("v4", 275e12),
+]
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
+def device_peak_flops(dev) -> float | None:
+    kind = getattr(dev, "device_kind", "").lower()
+    for sub, peak in _PEAKS:
+        if sub in kind:
+            return peak
+    return None
+
+
+# ---------------------------------------------------------------------------
+
+
+def bench_knn(extra: dict) -> float:
     import jax
     import jax.numpy as jnp
 
@@ -45,42 +91,90 @@ def main() -> None:
         DIM, metric="cos", capacity=N_DOCS, mesh=mesh, dtype=jnp.bfloat16
     )
 
-    # Bulk-load the corpus directly into the slab (benchmarks steady state;
-    # live upserts go through idx.add's donated scatters).
+    # Bulk-load the corpus through the live-upsert path (donated scatters);
+    # host prep is whole-array numpy since the columnar add_batch rework.
     rng = np.random.default_rng(0)
     log(f"building {N_DOCS}x{DIM} corpus...")
     t0 = time.perf_counter()
     chunk = 100_000
     for start in range(0, N_DOCS, chunk):
-        block = rng.normal(size=(min(chunk, N_DOCS - start), DIM)).astype(np.float32)
-        block /= np.linalg.norm(block, axis=1, keepdims=True)
-        idx.add([(start + i, block[i]) for i in range(block.shape[0])])
+        n = min(chunk, N_DOCS - start)
+        block = rng.normal(size=(n, DIM)).astype(np.float32)
+        idx.add_batch(range(start, start + n), block)
+    jax.block_until_ready(idx._vectors)
     build_s = time.perf_counter() - t0
-    log(f"corpus loaded in {build_s:.1f}s ({N_DOCS / build_s:.0f} docs/sec incl. host prep)")
+    ingest = N_DOCS / build_s
+    log(f"corpus loaded in {build_s:.1f}s ({ingest:.0f} docs/sec incl. host prep)")
+    extra["knn_ingest_docs_per_sec"] = round(ingest)
 
     queries = rng.normal(size=(N_QUERIES, DIM)).astype(np.float32)
 
-    # warmup / compile
+    # warmup / compile (batch=1 and batch=N_QUERIES shapes)
     idx.search(queries[:1], K)
     idx.search(queries[:1], K)
+    idx.search(queries, K)
 
-    # Strict sync-per-call latency: dominated by the host<->device link
-    # round-trip on tunneled setups (measured ~87 ms RTT floor here with
-    # ~2 ms device compute); reported to stderr for transparency.
+    # Link RTT floor: one trivial jit + readback round trip.  On tunneled
+    # dev setups this is ~90 ms and bounds ALL single-query latencies
+    # below; on co-located TPU hardware it is sub-millisecond.
+    tiny = jnp.zeros((1, 8))
+    bump = jax.jit(lambda a: a + 1)
+    jax.device_get(bump(tiny))
+    rtts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        jax.device_get(bump(tiny))
+        rtts.append((time.perf_counter() - t0) * 1000.0)
+    rtts.sort()
+    rtt = rtts[len(rtts) // 2]
+    log(f"link RTT floor (trivial jit+readback): {rtt:.2f}ms")
+    extra["link_rtt_floor_ms"] = round(rtt, 3)
+
+    # Strict sync-per-call latency: pays the full link RTT per call.
     sync_lat = []
-    for i in range(min(N_QUERIES, 20)):
+    for i in range(20):
         t0 = time.perf_counter()
         res = idx.search(queries[i : i + 1], K)
         sync_lat.append((time.perf_counter() - t0) * 1000.0)
         assert len(res[0]) == K
     sync_lat.sort()
-    log(f"sync-per-call p50={sync_lat[len(sync_lat)//2]:.2f}ms (incl. link RTT)")
+    sync_p50 = sync_lat[len(sync_lat) // 2]
+    log(f"sync-per-call p50={sync_p50:.2f}ms (incl. link RTT)")
+    extra["knn_p50_sync_single_query_ms"] = round(sync_p50, 3)
+
+    # Pipelined batch=1: keep DEPTH dispatches in flight; dispatch also
+    # starts the result's device->host copy (copy_to_host_async), so
+    # compute and readback overlap later dispatches.  Latency per query =
+    # its own dispatch -> collected result (includes pipeline queue wait).
+    DEPTH = 16
+    NPIPE = 96
+    inflight: deque = deque()
+    pipe_lat = []
+    t_all = time.perf_counter()
+    for i in range(NPIPE):
+        q = queries[i % N_QUERIES : i % N_QUERIES + 1]
+        inflight.append((time.perf_counter(), idx.dispatch(q, K)))
+        if len(inflight) >= DEPTH:
+            t0, h = inflight.popleft()
+            idx.collect(h)
+            pipe_lat.append((time.perf_counter() - t0) * 1000.0)
+    while inflight:
+        t0, h = inflight.popleft()
+        idx.collect(h)
+        pipe_lat.append((time.perf_counter() - t0) * 1000.0)
+    pipe_wall = time.perf_counter() - t_all
+    pipe_lat.sort()
+    pipe_p50 = pipe_lat[len(pipe_lat) // 2]
+    log(
+        f"pipelined batch=1 (depth {DEPTH}): p50={pipe_p50:.2f}ms/query, "
+        f"{NPIPE / pipe_wall:.0f} queries/s sustained"
+    )
+    extra["knn_p50_single_query_pipelined_ms"] = round(pipe_p50, 3)
+    extra["knn_pipelined_queries_per_sec"] = round(NPIPE / pipe_wall, 1)
 
     # Headline: per-query latency in the engine's serving mode — all of an
     # epoch's queries answered in ONE batched dispatch + ONE readback
-    # (exactly what ExternalIndexNode does), so the link round-trip is paid
-    # once per epoch, not once per query.
-    idx.search(queries, K)  # warm the batched shape
+    # (exactly what ExternalIndexNode does).
     groups = []
     for _ in range(9):
         t0 = time.perf_counter()
@@ -93,6 +187,129 @@ def main() -> None:
         f"per-query p50={p50:.3f}ms in batch-{N_QUERIES} serving mode "
         f"(batch latencies: {['%.1f' % (g * N_QUERIES) for g in groups]} ms)"
     )
+    return p50
+
+
+# ---------------------------------------------------------------------------
+
+
+def bench_embed(extra: dict) -> None:
+    import jax
+
+    from pathway_tpu.models.encoder import BGE_LARGE
+    from pathway_tpu.parallel import ShardedKnnIndex, make_mesh
+    from pathway_tpu.parallel.executor import JittedEncoder
+
+    devs = jax.devices()
+    mesh = make_mesh() if len(devs) > 1 else None
+    n_dev = len(devs)
+
+    cfg = BGE_LARGE
+    enc = JittedEncoder(cfg, mesh=mesh, max_batch=EMBED_BATCH, max_len=EMBED_SEQ)
+    idx = ShardedKnnIndex(cfg.hidden, metric="cos", capacity=EMBED_DOCS, mesh=mesh)
+
+    rng = np.random.default_rng(1)
+    vocab = [f"tok{i}" for i in range(5000)]
+    docs = [
+        " ".join(rng.choice(vocab, size=100)) for _ in range(EMBED_DOCS)
+    ]  # ~100 words -> padded to the 128-token bucket
+
+    log(
+        f"embed bench: BGE-large-class ({cfg.layers}L/{cfg.hidden}h bf16), "
+        f"seq {EMBED_SEQ}, batch {EMBED_BATCH}, {EMBED_DOCS} docs"
+    )
+    # warmup/compile on the same bucket shape
+    enc.encode(docs[:EMBED_BATCH])
+
+    t0 = time.perf_counter()
+    embs = enc.encode(docs)  # chunks of EMBED_BATCH, pipelined readback
+    idx.add_batch(range(EMBED_DOCS), embs)
+    jax.block_until_ready(idx._vectors)
+    dt = time.perf_counter() - t0
+    done = EMBED_DOCS
+    dps = done / dt
+
+    # FLOPs the hardware executed (padded seq): per token per layer,
+    # matmul MACs = 4h^2 (QKVO) + 2hL (scores+context) + 2*h*mlp (up+down);
+    # FLOPs = 2*MACs.  Pool/head negligible.
+    h, L = cfg.hidden, EMBED_SEQ
+    per_tok_layer = 2 * (4 * h * h + 2 * h * L + 2 * h * cfg.mlp_dim)
+    flops = done * L * cfg.layers * per_tok_layer
+    peak = device_peak_flops(devs[0])
+    mfu = (flops / dt) / (peak * n_dev) if peak else None
+
+    target = EMBED_TARGET_PER_CHIP * n_dev
+    log(
+        f"embed+index: {dps:.0f} docs/s on {n_dev} chip(s) "
+        f"({flops / dt / 1e12:.1f} TFLOPs/s"
+        + (f", MFU {mfu * 100:.1f}%" if mfu is not None else ", MFU n/a")
+        + f"); target share {target:.0f} docs/s"
+    )
+    extra["embed_docs_per_sec"] = round(dps, 1)
+    extra["embed_mfu_pct"] = round(mfu * 100, 1) if mfu is not None else None
+    extra["embed_model"] = f"bge-large-class {cfg.layers}L/{cfg.hidden}h bf16"
+    extra["embed_seq_len"] = EMBED_SEQ
+    extra["embed_n_chips"] = n_dev
+    extra["embed_vs_target"] = round(dps / target, 2)
+
+
+# ---------------------------------------------------------------------------
+
+
+def bench_wordcount(extra: dict) -> None:
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    d = tempfile.mkdtemp(prefix="pw_bench_wc_")
+    fp = os.path.join(d, "lines.jsonl")
+    rng = np.random.default_rng(2)
+    words = rng.integers(0, WC_WORDS, size=WC_LINES)
+    with open(fp, "w") as f:
+        f.write("\n".join('{"word": "w%d"}' % w for w in words))
+        f.write("\n")
+
+    class S(pw.Schema):
+        word: str
+
+    pdir = os.path.join(d, "pstorage")
+    log(f"wordcount: {WC_LINES} JSONL lines, persistence PERSISTING -> {pdir}")
+    t0 = time.perf_counter()
+    lines = pw.io.jsonlines.read(fp, schema=S, mode="static")
+    counts = lines.groupby(lines.word).reduce(lines.word, c=pw.reducers.count())
+    cap = counts._capture_node()
+    ctx = pw.run(
+        persistence_config=pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(pdir)
+        )
+    )
+    dt = time.perf_counter() - t0
+    rps = WC_LINES / dt
+    rows = ctx.state(cap)["rows"]
+    total = sum(v[1] for v in rows.values())
+    assert total == WC_LINES, f"lost rows: {total} != {WC_LINES}"
+    log(f"wordcount: {WC_LINES} rows in {dt:.1f}s -> {rps:.0f} rows/s, {len(rows)} groups")
+    extra["wordcount_rows_per_sec"] = round(rps)
+    extra["wordcount_lines"] = WC_LINES
+    extra["wordcount_persistence"] = "PERSISTING"
+
+
+# ---------------------------------------------------------------------------
+
+
+def main() -> None:
+    extra: dict = {}
+    p50 = bench_knn(extra)
+    try:
+        bench_embed(extra)
+    except Exception as e:  # noqa: BLE001 — embed bench must not mask headline
+        log(f"embed bench failed: {e!r}")
+        extra["embed_error"] = repr(e)
+    try:
+        bench_wordcount(extra)
+    except Exception as e:  # noqa: BLE001
+        log(f"wordcount bench failed: {e!r}")
+        extra["wordcount_error"] = repr(e)
 
     print(
         json.dumps(
@@ -101,6 +318,7 @@ def main() -> None:
                 "value": round(p50, 3),
                 "unit": "ms",
                 "vs_baseline": round(BASELINE_MS / p50, 2),
+                "extra": extra,
             }
         )
     )

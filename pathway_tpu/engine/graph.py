@@ -127,6 +127,10 @@ class InputNode(Node):
         # inbatches[0] is the externally injected batch for this epoch
         raw = inbatches[0] if inbatches else []
         if not self.upsert:
+            # append-only batch (no retractions): consolidation is a
+            # semantic no-op on the multiset — skip the hash pass
+            if all(u.diff > 0 for u in raw):
+                return raw if isinstance(raw, list) else list(raw)
             return consolidate(raw)
         # Upsert session semantics (reference SessionType::Upsert,
         # src/connectors/adaptors.rs:23-40): +1 overwrites, -1 deletes by key.
@@ -461,8 +465,15 @@ class GroupByNode(Node):
     def _group(self, st, gvals):
         from pathway_tpu.engine.stream import hashable_row
 
-        gh = hashable_row(gvals)
-        g = st["groups"].get(gh)
+        # plain tuple hash first (scalar group keys — the common case);
+        # unhashable cells fall back to the type-tagged form
+        groups = st["groups"]
+        try:
+            g = groups.get(gvals)
+            gh = gvals
+        except TypeError:
+            gh = hashable_row(gvals)
+            g = groups.get(gh)
         if g is None:
             g = {
                 "gvals": gvals,
@@ -470,17 +481,19 @@ class GroupByNode(Node):
                 "count": 0,
                 "last_out": None,
             }
-            st["groups"][gh] = g
+            groups[gh] = g
         return gh, g
 
     def process(self, ctx, time, inbatches):
         st = ctx.state(self)
         dirty: dict[Any, Any] = {}
+        reducer_args = self.reducer_args
+        group_fn = self.group_fn
         for u in inbatches[0]:
-            gvals = self.group_fn(u.key, u.values)
+            gvals = group_fn(u.key, u.values)
             gh, g = self._group(st, gvals)
             g["count"] += u.diff
-            for (reducer, arg_fn), acc in zip(self.reducer_args, g["accs"]):
+            for (reducer, arg_fn), acc in zip(reducer_args, g["accs"]):
                 reducer.update(acc, arg_fn(u.key, u.values), u.diff)
             dirty[gh] = g
         out = []

@@ -60,6 +60,17 @@ class ConnectorEvents:
     def remove(self, key: Pointer, values: tuple) -> None:
         self._q.put((self._node_id, "remove", key, values))
 
+    def add_many(self, rows: list) -> None:
+        """Chunked ingest: ``rows`` is a list of (key, values) additions
+        delivered as ONE queue item — fast readers (file scan, bulk
+        backfill) pay the queue lock per chunk, not per row.  Update
+        construction happens here, on the READER thread, overlapping the
+        scheduler's epoch work."""
+        if rows:
+            self._q.put(
+                (self._node_id, "batch", [Update(k, v, 1) for k, v in rows], None)
+            )
+
     def commit(self) -> None:
         self._q.put((self._node_id, "commit", None, None))
 
@@ -348,6 +359,8 @@ class Scheduler:
                 nid, kind, key, values = q.get(timeout=timeout)
                 if kind == "add":
                     buffers[nid].append(Update(key, values, 1))
+                elif kind == "batch":
+                    buffers[nid].extend(key)
                 elif kind == "remove":
                     buffers[nid].append(Update(key, values, -1))
                 elif kind == "commit":
@@ -515,6 +528,8 @@ class Scheduler:
                     break
                 if kind == "add":
                     buffers[nid].append(Update(key, values, 1))
+                elif kind == "batch":
+                    buffers[nid].extend(key)
                 elif kind == "remove":
                     buffers[nid].append(Update(key, values, -1))
                 elif kind == "commit":
@@ -533,7 +548,9 @@ class Scheduler:
             # the decision below must be a pure function of the gathered
             # statuses so every worker reaches the same CUT/FINISH/WAIT
             # verdict — local clocks only enter via the gathered elapsed
-            elapsed_ms = (_time.monotonic() - last_cut) * 1000.0
+            now = _time.monotonic()
+            elapsed_ms = (now - last_cut) * 1000.0
+            snap_elapsed_ms = (now - self._last_snapshot_at.get(w, 0.0)) * 1000.0
             status = (
                 any(buffers.values()) or not q.empty(),
                 len(open_subjects),
@@ -542,6 +559,7 @@ class Scheduler:
                 self._stop.is_set(),
                 elapsed_ms,
                 tuple(sorted(nid for nid, b in buffers.items() if b)),
+                snap_elapsed_ms,
             )
             statuses = cluster.allgather(("s", round_no), tid, status)
             round_no += 1
@@ -552,6 +570,12 @@ class Scheduler:
             stop = any(s[4] for s in statuses)
             autocommit_due = max(s[5] for s in statuses) >= self.autocommit_ms
             buffered_ids = {nid for s in statuses for nid in s[6]}
+            # snapshot decision is a pure function of the GATHERED statuses
+            # (max elapsed-since-snapshot), so every worker snapshots at the
+            # same cut epoch — a per-worker clock decision here would let
+            # worker A snapshot at epoch N while B holds N-1, corrupting
+            # recovery (rows exchanged in the gap epoch lost or doubled)
+            snapshot_due = max(s[7] for s in statuses)
             source_done = all_closed and no_aux
             if buffered_ids and (any_commit or autocommit_due or source_done or stop):
                 inject = {nid: b for nid, b in buffers.items() if b}
@@ -572,9 +596,19 @@ class Scheduler:
                     self.persistence is not None
                     and self.persistence.operator_mode
                 ):
-                    self._maybe_snapshot(
-                        w, t - TIME_STEP, consumed, wrappers, ctx=ctx
+                    interval = max(
+                        getattr(
+                            self.persistence.config, "snapshot_interval_ms", 0
+                        ),
+                        self.autocommit_ms,
                     )
+                    if snapshot_due >= interval:
+                        # every worker reaches the same verdict (gathered
+                        # max), so all snapshot this same cut epoch
+                        self._last_snapshot_at[w] = _time.monotonic()
+                        self._final_snapshot(
+                            w, t - TIME_STEP, consumed, wrappers, ctx=ctx
+                        )
             elif stop or (source_done and not any_data):
                 break
             else:
@@ -609,15 +643,23 @@ class Scheduler:
         snap: dict | None = None
         if self.persistence is not None:
             w = cluster.worker_index(tid)
-            if w == 0:
-                self.persistence.check_topology(cluster.n_workers)
+            # every worker checks (reads are cheap; the meta write is
+            # guarded by "stored is None") so a topology mismatch raises
+            # the clear error on ALL processes BEFORE any stream truncation
+            self.persistence.check_topology(cluster.n_workers)
             if self.persistence.operator_mode:
                 snap = self.persistence.load_operator_snapshot(w)
-                # all-or-none: a worker whose blob is missing (crash between
-                # per-worker saves) must force full replay everywhere, or
-                # its state shard would silently lose history
-                have = cluster.allgather(("snap_presence",), tid, snap is not None)
-                if not all(have):
+                # all-or-none AND epoch-consistent: a missing blob (crash
+                # between per-worker saves) or epoch skew between workers'
+                # snapshots forces full replay everywhere — resuming from
+                # mixed cut epochs would lose or double-apply rows
+                # exchanged in the gap epochs
+                metas = cluster.allgather(
+                    ("snap_presence",),
+                    tid,
+                    (snap is not None, snap["epoch"] if snap is not None else -1),
+                )
+                if not all(m[0] for m in metas) or len({m[1] for m in metas}) > 1:
                     snap = None
             consumed: dict[int, int] = dict(snap["consumed"]) if snap else {}
             ctx.consumed = consumed  # type: ignore[attr-defined]

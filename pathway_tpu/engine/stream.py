@@ -53,17 +53,25 @@ def hashable_row(values: tuple) -> tuple:
 
 
 def consolidate(batch: Iterable[Update]) -> Batch:
-    """Merge updates with equal (key, row), dropping zero-diff entries."""
+    """Merge updates with equal (key, row), dropping zero-diff entries.
+
+    Fast path hashes the row tuple directly (scalar cells — the common
+    case); rows holding unhashable cells (ndarray/dict/list) fall back to
+    the type-tagged :func:`hashable_row` per update, so both spellings of
+    an equal row land in the same bucket."""
     acc: dict[tuple, list] = {}
-    order: list[tuple] = []
     for u in batch:
-        k = (u.key, hashable_row(u.values))
-        if k in acc:
-            acc[k][2] += u.diff
-        else:
+        k = (u.key, u.values)
+        try:
+            e = acc.get(k)
+        except TypeError:
+            k = (u.key, hashable_row(u.values))
+            e = acc.get(k)
+        if e is None:
             acc[k] = [u.key, u.values, u.diff]
-            order.append(k)
-    return [Update(e[0], e[1], e[2]) for k in order if (e := acc[k])[2] != 0]
+        else:
+            e[2] += u.diff
+    return [Update(key, vals, d) for key, vals, d in acc.values() if d != 0]
 
 
 def per_key_changes(batch: Iterable[Update]) -> dict[Pointer, tuple[list, list]]:

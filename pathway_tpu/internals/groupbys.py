@@ -107,9 +107,17 @@ class GroupedTable:
             for g in self._grouping
         ]
 
-        def group_fn(key: Any, values: tuple) -> tuple:
-            kv = (key, values)
-            return tuple(f(kv) for f in gfns)
+        if len(gfns) == 1:
+            gfn0 = gfns[0]
+
+            def group_fn(key: Any, values: tuple) -> tuple:
+                return (gfn0((key, values)),)
+
+        else:
+
+            def group_fn(key: Any, values: tuple) -> tuple:
+                kv = (key, values)
+                return tuple(f(kv) for f in gfns)
 
         reducer_args: list[tuple[Any, Callable]] = []
         for re_expr in reducer_slots:
@@ -127,6 +135,14 @@ class GroupedTable:
                     def arg_fn(key, values, arg_fns=arg_fns):
                         kv = (key, values)
                         return (arg_fns[0](kv), key)
+
+            elif not arg_fns:
+                def arg_fn(key, values):
+                    return ()
+
+            elif len(arg_fns) == 1:
+                def arg_fn(key, values, f0=arg_fns[0]):
+                    return (f0((key, values)),)
 
             else:
                 def arg_fn(key, values, arg_fns=arg_fns):

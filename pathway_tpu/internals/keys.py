@@ -131,6 +131,13 @@ def unsafe_pointer(x: int) -> Pointer:
 
 
 def keys_for_values(rows: Iterable[tuple[Any, ...]]) -> list[Pointer]:
+    """Hash many key tuples in ONE native call (bulk ingest fast path),
+    falling back to per-row ref_scalar when the native module is absent
+    or a value type is outside its fast path."""
+    global _native, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        _native = _load_native()
     rows = list(rows)
     if _native is not None:
         try:

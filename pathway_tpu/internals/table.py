@@ -89,9 +89,24 @@ def compile_exprs(
 ) -> Callable[[Any, tuple], tuple]:
     compiled = [e._compile(layout.resolver) for e in exprs]
 
-    def row_fn(key: Any, values: tuple) -> tuple:
-        kv = (key, values)
-        return tuple(c(kv) for c in compiled)
+    if len(compiled) == 1:
+        c0 = compiled[0]
+
+        def row_fn(key: Any, values: tuple) -> tuple:
+            return (c0((key, values)),)
+
+    elif len(compiled) == 2:
+        ca, cb = compiled
+
+        def row_fn(key: Any, values: tuple) -> tuple:
+            kv = (key, values)
+            return (ca(kv), cb(kv))
+
+    else:
+
+        def row_fn(key: Any, values: tuple) -> tuple:
+            kv = (key, values)
+            return tuple(c(kv) for c in compiled)
 
     return row_fn
 

@@ -74,13 +74,83 @@ def key_for_row(
     return K.ref_scalar("__autogen__", source_tag, seq if seq is not None else next(_autogen_counter))
 
 
+_coercer_cache: dict[Any, list] = {}
+
+
+def _column_coercer(dtype: Any):
+    """Per-dtype coercion closure — same semantics as ``dt.coerce`` with the
+    dtype dispatch hoisted out of the per-row loop."""
+    base = dtype.strip_optional()
+    if base == dt.FLOAT:
+
+        def co(v):
+            if isinstance(v, float):
+                return v
+            if isinstance(v, int):
+                return float(v)
+            if isinstance(v, str):
+                try:
+                    return float(v)
+                except ValueError:
+                    return v
+            return v
+
+    elif base == dt.INT:
+
+        def co(v):
+            if isinstance(v, int):
+                return v
+            if isinstance(v, float) and v.is_integer():
+                return int(v)
+            if isinstance(v, str):
+                try:
+                    return int(v)
+                except ValueError:
+                    return v
+            return v
+
+    elif base == dt.STR:
+
+        def co(v):
+            return v if isinstance(v, str) else str(v)
+
+    elif base == dt.BOOL:
+
+        def co(v):
+            if isinstance(v, str):
+                return v.lower() in ("true", "1", "t", "yes")
+            return v
+
+    else:
+
+        def co(v):
+            return v
+
+    return co
+
+
+def _schema_coercers(schema: sch.SchemaMetaclass) -> list:
+    plan = _coercer_cache.get(schema)
+    if plan is None:
+        plan = [
+            (
+                name,
+                col.default_value if col.has_default else None,
+                _column_coercer(col.dtype),
+            )
+            for name, col in schema.__columns__.items()
+        ]
+        _coercer_cache[schema] = plan
+    return plan
+
+
 def coerce_row(values: dict[str, Any], schema: sch.SchemaMetaclass) -> tuple:
     out = []
-    for name, col in schema.__columns__.items():
+    for name, default, co in _schema_coercers(schema):
         v = values.get(name)
-        if v is None and col.has_default:
-            v = col.default_value
-        out.append(dt.coerce(v, col.dtype))
+        if v is None:
+            v = default
+        out.append(co(v) if v is not None else None)
     return tuple(out)
 
 

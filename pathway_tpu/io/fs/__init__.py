@@ -9,7 +9,7 @@ import time as _time
 from typing import Any, Callable
 
 from pathway_tpu.internals import schema as sch
-from pathway_tpu.internals.keys import ref_scalar
+from pathway_tpu.internals.keys import keys_for_values, ref_scalar
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io._connector import (
     LazyFileWriter,
@@ -49,6 +49,7 @@ class _FilesSource(RowSource):
         *,
         parse_line: Callable[[str], dict | None] | None = None,
         parser_factory: Callable[[str], Callable[[str], dict | None]] | None = None,
+        parse_block: Callable[[bytes], "list[dict] | None"] | None = None,
         mode: str = "streaming",
         poll_interval: float = 0.2,
         with_metadata: bool = False,
@@ -56,6 +57,10 @@ class _FilesSource(RowSource):
     ):
         self.path = path
         self.schema = schema
+        #: optional columnar fast path: parse a block of COMPLETE lines at
+        #: once (e.g. pandas' C JSON parser); returning None falls back to
+        #: the per-line parser for that block (e.g. malformed rows)
+        self.parse_block = parse_block
         # parser_factory(fp) -> line parser with per-file state (CSV headers);
         # plain parse_line is wrapped as a stateless factory
         if parser_factory is None:
@@ -86,39 +91,105 @@ class _FilesSource(RowSource):
     ) -> tuple[int, int]:
         pk = self.schema.primary_key_columns()
         seq = seq_start
+        add_many = getattr(events, "add_many", None)
+        chunk: list = []  # (key, row) additions flushed per _CHUNK rows
+        _CHUNK = 4096
+        _BLOCK = 8 << 20
+        schema = self.schema
+        meta = (
+            {"path": fp, "modified_at": int(os.path.getmtime(fp))}
+            if self.with_metadata
+            else None
+        )
+        w, n = self._part
+
+        def emit_rows(rows: list) -> None:
+            nonlocal seq, chunk
+            if not rows:
+                return
+            if meta is not None:
+                for values in rows:
+                    values["_metadata"] = dict(meta)
+            # keys for the whole block in ONE native hash call
+            if pk:
+                key_args = [tuple(v[c] for c in pk) for v in rows]
+            else:
+                base = seq
+                key_args = [
+                    ("__fs__", self.tag, fp, base + i + 1) for i in range(len(rows))
+                ]
+                seq = base + len(rows)
+            keys = keys_for_values(key_args)
+            for values, key in zip(rows, keys):
+                if n > 1 and int(key) % n != w:
+                    continue  # another worker's share
+                row = coerce_row(values, schema)
+                if add_many is None:
+                    events.add(key, row)
+                else:
+                    chunk.append((key, row))
+                    if len(chunk) >= _CHUNK:
+                        add_many(chunk)
+                        chunk = []
+
         # binary mode: byte-accurate offsets (text-mode tell() is unusable
-        # inside line iteration), manual splitting on b"\n"
+        # with block reads), splitting on b"\n"; only COMPLETE lines are
+        # consumed in streaming mode (a writer mid-append retries later)
         with open(fp, "rb") as f:
             f.seek(start_offset)
             offset = start_offset
             while True:
-                raw = f.readline()
-                if not raw:
+                data = f.read(_BLOCK)
+                if not data:
                     break
-                if not raw.endswith(b"\n") and self.mode != "static":
-                    # partial trailing line (writer mid-append): retry later
-                    break
-                offset += len(raw)
-                try:
-                    values = parser(raw.decode(errors="replace"))
-                except Exception:
-                    values = None  # unparseable line: skip, keep the stream alive
-                if not isinstance(values, dict):
-                    continue
-                if self.with_metadata:
-                    values["_metadata"] = {
-                        "path": fp,
-                        "modified_at": int(os.path.getmtime(fp)),
-                    }
-                if pk:
-                    key = ref_scalar(*[values[c] for c in pk])
+                at_eof = len(data) < _BLOCK
+                if at_eof and self.mode == "static":
+                    complete = data  # static: consume the unterminated tail too
                 else:
-                    seq += 1
-                    key = ref_scalar("__fs__", self.tag, fp, seq)
-                w, n = self._part
-                if n > 1 and int(key) % n != w:
-                    continue  # another worker's share
-                events.add(key, coerce_row(values, self.schema))
+                    nl = data.rfind(b"\n")
+                    if nl < 0:
+                        # a single line longer than the block: keep reading
+                        # until its newline (or EOF) so the offset can
+                        # advance — breaking here would re-read the same
+                        # block forever in streaming mode
+                        parts = [data]
+                        while True:
+                            more = f.read(_BLOCK)
+                            if not more:
+                                at_eof = True
+                                break
+                            nl = more.find(b"\n")
+                            if nl >= 0:
+                                parts.append(more[: nl + 1])
+                                break
+                            parts.append(more)
+                        if at_eof and self.mode != "static":
+                            break  # unterminated giant line: retry later
+                        data = b"".join(parts)
+                        complete = data
+                        f.seek(offset + len(complete))
+                    else:
+                        complete = data[: nl + 1]
+                        if nl + 1 < len(data):
+                            f.seek(offset + len(complete))
+                rows = self.parse_block(complete) if self.parse_block else None
+                if rows is None:
+                    rows = []
+                    for raw in complete.split(b"\n"):
+                        if not raw:
+                            continue
+                        try:
+                            values = parser(raw.decode(errors="replace"))
+                        except Exception:
+                            values = None  # unparseable line: skip
+                        if isinstance(values, dict):
+                            rows.append(values)
+                emit_rows(rows)
+                offset += len(complete)
+                if at_eof:
+                    break
+            if chunk:
+                add_many(chunk)
             return offset, seq
 
     def run(self, events: Any) -> None:

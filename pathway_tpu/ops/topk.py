@@ -7,7 +7,11 @@ import jax.numpy as jnp
 
 __all__ = ["masked_top_k"]
 
-NEG_INF = jnp.float32(-3.0e38)
+# Python float, NOT a jnp device array: a device-resident constant baked
+# into jitted closures forces a host<->device round trip on EVERY call on
+# remote/tunneled backends (~70-90 ms each — measured; it masqueraded as
+# "link RTT" in earlier benchmarks).
+NEG_INF = -3.0e38
 
 
 def masked_top_k(

@@ -12,6 +12,7 @@ build splits the two planes:
 
 from pathway_tpu.parallel.mesh import best_mesh, make_mesh, mesh_axis_size
 from pathway_tpu.parallel.executor import JittedEncoder
+from pathway_tpu.parallel.ivf_knn import IvfKnnIndex
 from pathway_tpu.parallel.sharded_knn import ShardedKnnIndex
 
 __all__ = [
@@ -19,5 +20,6 @@ __all__ = [
     "best_mesh",
     "mesh_axis_size",
     "JittedEncoder",
+    "IvfKnnIndex",
     "ShardedKnnIndex",
 ]

@@ -134,18 +134,47 @@ class JittedEncoder:
         mask[n:, 0] = 1
         return ids, mask, tps, n
 
-    def _run(self, ids: np.ndarray, mask: np.ndarray, tps: np.ndarray) -> np.ndarray:
+    def _dispatch(self, ids: np.ndarray, mask: np.ndarray, tps: np.ndarray):
+        """Enqueue one padded chunk; returns (device_out, n_real_rows).
+        The device->host copy is started immediately (non-blocking), so on
+        remote/tunneled backends the transfer of chunk i overlaps the
+        tokenize+compute of chunk i+1."""
         ids, mask, tps, n = self._pad_batch(ids, mask, tps)
         args = [jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(tps)]
         if self._in_batch_sharding is not None:
             args = [jax.device_put(a, self._in_batch_sharding) for a in args]
         out = self._apply(self.params, *args)
+        copy_async = getattr(out, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+        return out, n
+
+    def _run(self, ids: np.ndarray, mask: np.ndarray, tps: np.ndarray) -> np.ndarray:
+        out, n = self._dispatch(ids, mask, tps)
         return np.asarray(out)[:n]
 
     def _chunks(self, texts: Sequence[str], pair: Sequence[str] | None):
         for i in range(0, len(texts), self.max_batch):
             sl = slice(i, i + self.max_batch)
             yield texts[sl], None if pair is None else pair[sl]
+
+    def _run_pipelined(
+        self, texts: list, pair: "list | None"
+    ) -> list[np.ndarray]:
+        """Tokenize/dispatch chunk i+1 before collecting chunk i."""
+        outs: list[np.ndarray] = []
+        prev = None
+        for chunk, pchunk in self._chunks(texts, pair):
+            ids, mask, tps = self.tokenizer.encode_batch(
+                chunk, pair=pchunk, max_len=self.max_len
+            )
+            cur = self._dispatch(ids, mask, tps)
+            if prev is not None:
+                outs.append(np.asarray(prev[0])[: prev[1]])
+            prev = cur
+        if prev is not None:
+            outs.append(np.asarray(prev[0])[: prev[1]])
+        return outs
 
     # ------------------------------------------------------------------
     def encode(self, texts: Sequence[str]) -> np.ndarray:
@@ -154,11 +183,7 @@ class JittedEncoder:
             raise TypeError("cross-encoder executor: use score_pairs()")
         if not texts:
             return np.zeros((0, self.config.hidden), np.float32)
-        outs = []
-        for chunk, _ in self._chunks(list(texts), None):
-            ids, mask, tps = self.tokenizer.encode_batch(chunk, max_len=self.max_len)
-            outs.append(self._run(ids, mask, tps))
-        return np.concatenate(outs, axis=0)
+        return np.concatenate(self._run_pipelined(list(texts), None), axis=0)
 
     def score_pairs(self, queries: Sequence[str], docs: Sequence[str]) -> np.ndarray:
         """Cross-encoder scores for aligned (query, doc) pairs -> [n]."""
@@ -168,10 +193,6 @@ class JittedEncoder:
             raise ValueError("queries and docs must align")
         if not queries:
             return np.zeros((0,), np.float32)
-        outs = []
-        for q_chunk, d_chunk in self._chunks(list(queries), list(docs)):
-            ids, mask, tps = self.tokenizer.encode_batch(
-                q_chunk, pair=d_chunk, max_len=self.max_len
-            )
-            outs.append(self._run(ids, mask, tps))
-        return np.concatenate(outs, axis=0)
+        return np.concatenate(
+            self._run_pipelined(list(queries), list(docs)), axis=0
+        )

@@ -200,10 +200,18 @@ class _FsBackend(_BackendImpl):
             return f.read()
 
     def put_meta(self, data):
-        tmp = os.path.join(self.path, "metadata.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(data, f)
-        os.replace(tmp, os.path.join(self.path, "metadata.json"))
+        # unique tmp per writer: check_topology runs on EVERY worker, so
+        # first-run meta writes race across threads AND processes — a
+        # shared tmp path would let one writer os.replace a peer's
+        # half-written file (or find its own renamed away)
+        tmp = os.path.join(
+            self.path,
+            f"metadata.json.tmp.{os.getpid()}.{threading.get_ident()}",
+        )
+        with self._lock:
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, os.path.join(self.path, "metadata.json"))
 
     def get_meta(self):
         path = os.path.join(self.path, "metadata.json")
@@ -292,6 +300,20 @@ class _RecordingEvents:
 
     def add(self, key, values):
         self._record_and_forward("add", key, values, self._inner.add)
+
+    def add_many(self, rows):
+        """Chunked ingest: skip the replayed prefix, log the surviving
+        chunk as ONE "addmany" record (one pickle per chunk, not per row —
+        the log write must not bound ingest throughput), then forward."""
+        skip = min(self.resume_offset, len(rows))
+        if skip:
+            self.resume_offset -= skip
+            rows = rows[skip:]
+        if not rows:
+            return
+        self._impl.append(self._stream, pickle.dumps(("addmany", rows, None)))
+        self._dirty = True
+        self._inner.add_many(rows)
 
     def remove(self, key, values):
         self._record_and_forward("remove", key, values, self._inner.remove)
@@ -446,7 +468,13 @@ class PersistenceHooks:
         from pathway_tpu.io import _connector as _conn
 
         _conn._autogen_counter.advance_to(counter_mark)
-        return records[: last_commit + 1]
+        out: list[tuple[str, Any, Any]] = []
+        for kind, k, v in records[: last_commit + 1]:
+            if kind == "addmany":  # chunked record: expand to per-row events
+                out.extend(("add", kk, vv) for kk, vv in k)
+            else:
+                out.append((kind, k, v))
+        return out
 
     def wrap_events(self, node: Any, events: Any, replayed: int, worker: int = 0) -> Any:
         if self.replay_only:

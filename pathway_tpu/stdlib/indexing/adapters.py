@@ -11,13 +11,14 @@ over-fetch, mirroring the reference's filter-then-trim flow
 
 from __future__ import annotations
 
+import heapq
 import math
 from collections import defaultdict
 from typing import Any, Callable, Sequence
 
 import numpy as np
 
-__all__ = ["KnnAdapter", "BM25Adapter", "HybridAdapter"]
+__all__ = ["KnnAdapter", "IvfAdapter", "BM25Adapter", "HybridAdapter"]
 
 _OVERFETCH = 4
 
@@ -162,10 +163,19 @@ class BM25Adapter:
                     denom = tf + self.k1 * (1 - self.b + self.b * dl / avgdl)
                     scores[key] += idf * tf * (self.k1 + 1) / denom
             f = filters[qi]
-            ranked = sorted(scores.items(), key=lambda kv: (-kv[1], str(kv[0])))
+            items: Any = scores.items()
             if f is not None:
-                ranked = [(key, s) for key, s in ranked if f(self.meta.get(key) or {})]
-            out.append([(key, float(s)) for key, s in ranked[: k[qi]]])
+                # filter BEFORE top-k selection so a restrictive filter
+                # still yields k matching docs when they exist
+                items = [
+                    (key, s) for key, s in items if f(self.meta.get(key) or {})
+                ]
+            # heap selection instead of a full sort of every matching doc:
+            # O(N log k); same ordering as sorted(..)[:k] incl. tie-break
+            ranked = heapq.nsmallest(
+                k[qi], items, key=lambda kv: (-kv[1], str(kv[0]))
+            )
+            out.append([(key, float(s)) for key, s in ranked])
         return out
 
 
@@ -212,3 +222,33 @@ def _simple_tokens(s: str):
     import re
 
     return re.findall(r"[a-z0-9]+", s.lower())
+
+
+class IvfAdapter(KnnAdapter):
+    """(key, vector) index over the approximate :class:`IvfKnnIndex`
+    (reference USearch HNSW role; see
+    ``pathway_tpu/parallel/ivf_knn.py``)."""
+
+    def __init__(
+        self,
+        dim: int,
+        *,
+        metric: str = "cos",
+        capacity: int = 1024,
+        dtype: Any = None,
+        nlist: int | None = None,
+        nprobe: int | None = None,
+    ):
+        import jax.numpy as jnp
+
+        from pathway_tpu.parallel import IvfKnnIndex
+
+        self.index = IvfKnnIndex(
+            dim,
+            metric=metric,
+            capacity=capacity,
+            dtype=dtype or jnp.bfloat16,
+            nlist=nlist,
+            nprobe=nprobe,
+        )
+        self.meta: dict[Any, dict | None] = {}

@@ -102,9 +102,64 @@ class BruteForceKnn(InnerIndex):
 
 
 class UsearchKnn(BruteForceKnn):
-    """Approximate-KNN API surface (reference ``USearchKnn``).  On TPU the
-    brute-force matmul over HBM shards outruns host HNSW at the target
-    corpus sizes, so this is exact under the hood."""
+    """Approximate KNN (reference ``USearchKnn`` fronting an HNSW,
+    ``src/external_integration/usearch_integration.rs``).  TPU re-design:
+    an IVF-flat index (:class:`pathway_tpu.parallel.IvfKnnIndex`) —
+    k-means cells in HBM, query = centroid matmul -> gather nprobe cells
+    -> einsum + top-k, scanning ``nprobe/nlist`` of the corpus instead of
+    all of it (HNSW's pointer-chasing walk is hostile to XLA).  ``l2sq``
+    falls back to the exact brute-force index (IVF cells here are inner-
+    product trained)."""
+
+    def __init__(
+        self,
+        data_column: ColumnReference,
+        metadata_column: ColumnReference | None = None,
+        *,
+        dimensions: int,
+        reserved_space: int = 1024,
+        metric: str = BruteForceKnnMetricKind.COS,
+        mesh: Any = None,
+        dtype: Any = None,
+        nlist: int | None = None,
+        nprobe: int | None = None,
+    ):
+        super().__init__(
+            data_column,
+            metadata_column,
+            dimensions=dimensions,
+            reserved_space=reserved_space,
+            metric=metric,
+            mesh=mesh,
+            dtype=dtype,
+        )
+        self.nlist = nlist
+        self.nprobe = nprobe
+
+    def make_adapter(self) -> Any:
+        if self.metric == BruteForceKnnMetricKind.L2SQ:
+            return super().make_adapter()  # exact fallback
+        if self.mesh is not None:
+            # IVF is single-device; a mesh caller sized reserved_space for
+            # the aggregate HBM of all chips — give them the SHARDED exact
+            # index rather than silently dropping the mesh
+            import logging
+
+            logging.getLogger("pathway_tpu").info(
+                "UsearchKnn: mesh given -> using the mesh-sharded exact "
+                "brute-force index (IVF cells are single-device)"
+            )
+            return super().make_adapter()
+        from pathway_tpu.stdlib.indexing.adapters import IvfAdapter
+
+        return IvfAdapter(
+            self.dimensions,
+            metric=self.metric,
+            capacity=self.reserved_space,
+            dtype=self.dtype,
+            nlist=self.nlist,
+            nprobe=self.nprobe,
+        )
 
 
 class LshKnn(BruteForceKnn):

@@ -259,3 +259,36 @@ def test_fs_csv_delimiter_passthrough(tmp_path):
         csv_settings=pw.io.csv.CsvParserSettings(delimiter=";"),
     )
     assert sorted(_rows_of(t).values()) == [(1, "x")]
+
+
+def test_jsonlines_invalid_utf8_line_skipped(tmp_path):
+    """A non-UTF-8 line must be skipped (per-line fallback), not kill the
+    reader thread (block parser raises UnicodeDecodeError = ValueError)."""
+    import pathway_tpu as pw
+
+    fp = tmp_path / "x.jsonl"
+    fp.write_bytes(b'{"a": 1}\n{"a": \xff2}\n{"a": 3}\n')
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.jsonlines.read(str(fp), schema=S, mode="static")
+    res = pw.debug.table_to_pandas(t)
+    assert sorted(res["a"].tolist()) == [1, 3]
+
+
+def test_fs_line_longer_than_read_block(tmp_path, monkeypatch):
+    """A single line longer than the block size must still be consumed
+    (the block reader extends to the next newline instead of stalling)."""
+    import pathway_tpu as pw
+
+    fp = tmp_path / "y.jsonl"
+    big = "x" * (9 << 20)  # > the 8 MiB read block
+    fp.write_text('{"a": 7}\n{"a": 8, "pad": "%s"}\n{"a": 9}\n' % big)
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.jsonlines.read(str(fp), schema=S, mode="static")
+    res = pw.debug.table_to_pandas(t)
+    assert sorted(res["a"].tolist()) == [7, 8, 9]

@@ -1,0 +1,137 @@
+"""IVF-flat ANN index: recall vs brute force, upserts, API wiring.
+
+Mirrors the role of the reference's USearch HNSW integration tests
+(``src/external_integration/usearch_integration.rs``)."""
+
+import numpy as np
+import pytest
+
+from pathway_tpu.parallel import IvfKnnIndex, ShardedKnnIndex
+
+
+def _mixture(n, d, n_clusters=64, seed=0):
+    """Clustered synthetic data — the regime ANN indexes exist for."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_clusters, d)).astype(np.float32) * 3.0
+    assign = rng.integers(0, n_clusters, size=n)
+    x = centers[assign] + rng.normal(size=(n, d)).astype(np.float32)
+    return x.astype(np.float32)
+
+
+def test_ivf_recall_vs_brute_force():
+    n, d, k = 100_000, 64, 10
+    x = _mixture(n, d)
+    queries = _mixture(200, d, seed=1)
+
+    ivf = IvfKnnIndex(d, metric="cos", capacity=n)
+    ivf.add_batch(range(n), x)
+    ivf.train(x)  # explicit train on the full corpus sample
+
+    bf = ShardedKnnIndex(d, metric="cos", capacity=n)
+    bf.add_batch(range(n), x)
+
+    got = ivf.search(queries, k)
+    want = bf.search(queries, k)
+    hits = 0
+    for g, w in zip(got, want):
+        truth = {key for key, _ in w}
+        hits += sum(1 for key, _ in g if key in truth)
+    recall = hits / (len(queries) * k)
+    assert recall >= 0.95, f"recall@{k} = {recall:.3f} < 0.95"
+
+
+def test_ivf_upsert_remove_and_auto_train():
+    d = 16
+    idx = IvfKnnIndex(d, metric="cos", capacity=4096, nlist=16, nprobe=16)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2000, d)).astype(np.float32)
+    idx.add_batch(range(2000), x)  # buffers, then auto-trains at threshold
+    assert idx.trained
+    assert len(idx) == 2000
+
+    # exact self-query: with nprobe == nlist the scan is exhaustive
+    res = idx.search(x[:5], 1)
+    assert [r[0][0] for r in res] == [0, 1, 2, 3, 4]
+
+    # upsert moves a key to its new vector's cell
+    idx.add_batch([0], x[1][None, :])
+    res = idx.search(x[1][None, :], 2)
+    assert {key for key, _ in res[0]} == {0, 1}
+    assert len(idx) == 2000
+
+    idx.remove([0, 1])
+    assert len(idx) == 1998
+    res = idx.search(x[1][None, :], 2)
+    assert 0 not in {key for key, _ in res[0]}
+    assert 1 not in {key for key, _ in res[0]}
+
+
+def test_ivf_grow_cells():
+    d = 8
+    idx = IvfKnnIndex(d, metric="dot", capacity=64, nlist=16, nprobe=16)
+    rng = np.random.default_rng(0)
+    # everything lands near one centroid -> forces per-cell overflow growth
+    base = rng.normal(size=(1, d)).astype(np.float32)
+    x = base + 0.01 * rng.normal(size=(3000, d)).astype(np.float32)
+    idx.train(x[:500])
+    cap0 = idx.cell_cap
+    idx.add_batch(range(3000), x)
+    assert idx.cell_cap > cap0  # grew
+    # rows survive growth: an outlier added pre-growth is still findable
+    outlier = (100.0 * np.eye(1, d)).astype(np.float32)
+    idx.add_batch(["outlier"], outlier)
+    res = idx.search(outlier, 1)
+    assert res[0][0][0] == "outlier"
+    assert len(idx) == 3001
+
+
+def test_usearch_factory_uses_ivf():
+    from pathway_tpu.stdlib.indexing.adapters import IvfAdapter
+    from pathway_tpu.stdlib.indexing.data_index import UsearchKnn
+
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        v: list
+
+    t = pw.debug.table_from_rows(S, [(1, ([1.0, 0.0],))])
+    knn = UsearchKnn(t.v, dimensions=2, reserved_space=64)
+    adapter = knn.make_adapter()
+    assert isinstance(adapter, IvfAdapter)
+
+    # l2sq falls back to the exact brute-force adapter
+    knn2 = UsearchKnn(t.v, dimensions=2, reserved_space=64, metric="l2sq")
+    a2 = knn2.make_adapter()
+    assert not isinstance(a2, IvfAdapter)
+
+
+def test_ivf_state_roundtrip():
+    d = 8
+    idx = IvfKnnIndex(d, metric="cos", capacity=512, nlist=16, nprobe=16)
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(300, d)).astype(np.float32)
+    idx.train(x)
+    idx.add_batch(range(300), x)
+    state = idx.state_dict()
+
+    idx2 = IvfKnnIndex(d, metric="cos", capacity=512, nlist=16, nprobe=16)
+    idx2.load_state_dict(state)
+    r1 = idx.search(x[:4], 3)
+    r2 = idx2.search(x[:4], 3)
+    assert [[k for k, _ in row] for row in r1] == [[k for k, _ in row] for row in r2]
+
+
+def test_ivf_duplicate_key_in_one_batch():
+    """Upsert semantics for a key repeated within one batch: exactly one
+    live slot; remove() leaves no orphan."""
+    idx = IvfKnnIndex(8, metric="cos", capacity=256, nlist=4, nprobe=4)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(100, 8)).astype(np.float32)
+    idx.train(x)
+    idx.add_batch(["k", "k"], x[:2])
+    assert len(idx) == 1
+    res = idx.search(x[1][None, :], 3)
+    assert [key for key, _ in res[0]].count("k") == 1
+    idx.remove(["k"])
+    res = idx.search(x[1][None, :], 3)
+    assert "k" not in [key for key, _ in res[0]]
