@@ -127,10 +127,12 @@ class InputNode(Node):
         # inbatches[0] is the externally injected batch for this epoch
         raw = inbatches[0] if inbatches else []
         if not self.upsert:
+            if not isinstance(raw, list):
+                raw = list(raw)  # the all() scan below must not consume it
             # append-only batch (no retractions): consolidation is a
             # semantic no-op on the multiset — skip the hash pass
             if all(u.diff > 0 for u in raw):
-                return raw if isinstance(raw, list) else list(raw)
+                return raw
             return consolidate(raw)
         # Upsert session semantics (reference SessionType::Upsert,
         # src/connectors/adaptors.rs:23-40): +1 overwrites, -1 deletes by key.
@@ -814,6 +816,74 @@ class ZipNode(Node):
                 st["out"][key] = new
             else:
                 st["out"].pop(key, None)
+        return consolidate(out)
+
+
+class GradualBroadcastNode(Node):
+    """Apportioned broadcast of a changing scalar (reference
+    ``gradual_broadcast`` operator,
+    ``src/engine/dataflow/operators/gradual_broadcast.rs``, 490 LoC).
+
+    Port 0: the keyed table; port 1: a (usually 1-row) threshold table
+    whose rows yield an approximation triplet ``(lower, value, upper)``
+    via ``triplet_fn``.  Every output row carries an extra ``apx_value``
+    column holding SOME value within the most recent ``[lower, upper]``
+    window; a row's apx only changes when its held value falls OUTSIDE
+    the new window.  This is the churn-damping contract the reference
+    provides: a slightly-changed global aggregate (e.g. Louvain's total
+    edge weight) does not retract/re-emit every row downstream."""
+
+    def __init__(
+        self,
+        graph: EngineGraph,
+        input: Node,
+        threshold: Node,
+        triplet_fn: Callable[[Pointer, tuple], tuple],
+        name: str = "gradual_broadcast",
+    ):
+        super().__init__(graph, [input, threshold], name)
+        self.triplet_fn = triplet_fn
+
+    # the threshold triplet is global state: centralize like the
+    # reference's temporal buffers (TimeKey::shard() -> one worker)
+    exchange_routes = cl.route_all_to_zero
+
+    def make_state(self):
+        return {"rows": {}, "apx": {}, "cur": None}
+
+    def process(self, ctx, time, inbatches):
+        st = ctx.state(self)
+        out: list[Update] = []
+        # newest triplet first, so rows arriving this epoch use it
+        trip = None
+        for u in inbatches[1]:
+            if u.diff > 0:
+                trip = self.triplet_fn(u.key, u.values)
+        if trip is not None:
+            lower, value, upper = (float(x) for x in trip)
+            st["cur"] = (lower, value, upper)
+            for key, apx in list(st["apx"].items()):
+                if apx is not None and lower <= apx <= upper:
+                    continue  # still inside the window: no churn
+                vals = st["rows"].get(key)
+                if vals is None:
+                    continue
+                out.append(Update(key, vals + (apx,), -1))
+                out.append(Update(key, vals + (value,), 1))
+                st["apx"][key] = value
+        removals = [u for u in inbatches[0] if u.diff < 0]
+        additions = [u for u in inbatches[0] if u.diff > 0]
+        for u in removals:
+            vals = st["rows"].pop(u.key, None)
+            apx = st["apx"].pop(u.key, None)
+            if vals is not None:
+                out.append(Update(u.key, vals + (apx,), -1))
+        cur = st["cur"]
+        for u in additions:
+            apx = cur[1] if cur is not None else None
+            st["rows"][u.key] = u.values
+            st["apx"][u.key] = apx
+            out.append(Update(u.key, u.values + (apx,), 1))
         return consolidate(out)
 
 

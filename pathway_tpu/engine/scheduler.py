@@ -101,6 +101,14 @@ class Scheduler:
         self._last_snapshot_at: dict[int, float] = {}
 
     # ------------------------------------------------------------------
+    def _snapshot_interval(self) -> float:
+        """Snapshot rate limit in ms — ONE policy for single-worker and
+        cluster paths (they must snapshot at the same cadence)."""
+        return max(
+            getattr(self.persistence.config, "snapshot_interval_ms", 0),
+            self.autocommit_ms,
+        )
+
     def _maybe_snapshot(
         self,
         worker: int,
@@ -112,10 +120,7 @@ class Scheduler:
         """Operator snapshot, rate-limited by snapshot_interval_ms.  The
         input logs are force-committed FIRST so the snapshot's consumed
         counts always lie within each log's committed prefix."""
-        interval = max(
-            getattr(self.persistence.config, "snapshot_interval_ms", 0),
-            self.autocommit_ms,
-        )
+        interval = self._snapshot_interval()
         now = _time.monotonic()
         if (now - self._last_snapshot_at.get(worker, 0.0)) * 1000.0 < interval:
             return
@@ -596,13 +601,7 @@ class Scheduler:
                     self.persistence is not None
                     and self.persistence.operator_mode
                 ):
-                    interval = max(
-                        getattr(
-                            self.persistence.config, "snapshot_interval_ms", 0
-                        ),
-                        self.autocommit_ms,
-                    )
-                    if snapshot_due >= interval:
+                    if snapshot_due >= self._snapshot_interval():
                         # every worker reaches the same verdict (gathered
                         # max), so all snapshot this same cut epoch
                         self._last_snapshot_at[w] = _time.monotonic()

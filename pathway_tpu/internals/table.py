@@ -405,6 +405,32 @@ class Table:
             layout_token=self._layout_token,
         )
 
+    def _gradual_broadcast(
+        self,
+        threshold_table: "Table",
+        lower_column: Any,
+        value_column: Any,
+        upper_column: Any,
+    ) -> "Table":
+        """Append an ``apx_value`` column broadcast from a (usually 1-row)
+        threshold table's ``(lower, value, upper)`` approximation triplet;
+        rows only re-emit when their held value leaves the new window
+        (reference ``Table._gradual_broadcast``, ``internals/table.py:631``
+        over ``src/engine/dataflow/operators/gradual_broadcast.rs``)."""
+        exprs = [
+            threshold_table._subst(e)
+            for e in (lower_column, value_column, upper_column)
+        ]
+        tlayout = threshold_table._layout()
+        triplet_fn = compile_exprs(exprs, tlayout)
+        node = eg.GradualBroadcastNode(
+            G.engine_graph, self._node, threshold_table._node, triplet_fn
+        )
+        cols = self._column_names + ["apx_value"]
+        dtypes = dict(self._dtypes)
+        dtypes["apx_value"] = dt.Optional(dt.FLOAT)
+        return Table(node, cols, dtypes, name=f"{self._name}.gradual_broadcast")
+
     def filter(self, expr: Any) -> "Table":
         e = self._subst(expr)
         layout, in_node = self._prepare([e])

@@ -293,6 +293,16 @@ def attach_writer(table: Table, writer: Writer, *, name: str = "output") -> None
     eg.OutputNode(G.engine_graph, table._node, on_change, on_time_end, on_end, name=name)
 
 
+def format_change_row(row: dict[str, Any], time: int, diff: int) -> dict[str, Any]:
+    """Standard change-stream document for service sinks: formatted row
+    columns (``id`` dropped) plus integral ``time``/``diff`` fields — the
+    reference's writer contract (a modification = a -1 doc then a +1 doc)."""
+    doc = {k: fmt_value(v) for k, v in row.items() if k != "id"}
+    doc["time"] = time
+    doc["diff"] = diff
+    return doc
+
+
 def fmt_value(v: Any) -> Any:
     import datetime
 

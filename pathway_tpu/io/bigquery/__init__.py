@@ -1,16 +1,87 @@
-"""``pw.io.bigquery`` — BigQuery sink (reference python/pathway/io/bigquery).
+"""``pw.io.bigquery`` — BigQuery sink (reference
+``python/pathway/io/bigquery``).
 
-API-surface parity module: the row/format plumbing routes through the shared
-connector framework; the transport activates when the client library is
-available (external services are unreachable in this build environment).
+Each epoch's updates flush as one ``insert_rows_json`` batch; rows carry
+``time``/``diff`` fields exactly like the reference contract (a modified
+row arrives as a -1 row then a +1 row).  The client is injectable
+(anything with ``insert_rows_json(table_ref, rows)``); without one,
+``google.cloud.bigquery.Client`` is constructed from the service-user
+credentials file.
 """
 
 from __future__ import annotations
 
+import json
 from typing import Any
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
-
-write = gated_writer("bigquery", "google.cloud.bigquery")
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import Writer, attach_writer, format_change_row
+from pathway_tpu.io._gated import MissingDependency
 
 __all__ = ["write"]
+
+
+class _BigQueryWriter(Writer):
+    def __init__(
+        self,
+        dataset_name: str,
+        table_name: str,
+        credentials_file: str | None,
+        client: Any,
+    ):
+        self.table_ref = f"{dataset_name}.{table_name}"
+        self.credentials_file = credentials_file
+        self._client = client
+        self._rows: list[dict] = []
+
+    def _get_client(self) -> Any:
+        if self._client is None:
+            try:
+                from google.cloud import bigquery  # type: ignore[import-not-found]
+            except ImportError as e:
+                raise MissingDependency(
+                    "google-cloud-bigquery is not installed; pass client= "
+                    "with an insert_rows_json-capable object"
+                ) from e
+            self._client = bigquery.Client.from_service_account_json(
+                self.credentials_file
+            )
+        return self._client
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        doc = {k: _json_safe(v) for k, v in format_change_row(row, time, diff).items()}
+        self._rows.append(doc)
+
+    def flush(self) -> None:
+        if not self._rows:
+            return
+        errors = self._get_client().insert_rows_json(self.table_ref, self._rows)
+        if errors:
+            raise RuntimeError(f"BigQuery insert failed: {errors}")
+        self._rows = []
+
+
+def _json_safe(v: Any) -> Any:
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def write(
+    table: Table,
+    dataset_name: str,
+    table_name: str,
+    service_user_credentials_file: str | None = None,
+    *,
+    client: Any = None,
+    name: str = "bigquery_out",
+) -> None:
+    """Write the table's change stream to a BigQuery table (whose schema
+    must include integral ``time`` and ``diff`` fields)."""
+    attach_writer(
+        table,
+        _BigQueryWriter(dataset_name, table_name, service_user_credentials_file, client),
+        name=name,
+    )

@@ -131,10 +131,18 @@ class _DebeziumSource(RowSource):
             return
         from kafka import KafkaConsumer  # type: ignore[import-not-found]
 
+        group_id = self.rdkafka_settings.get("group.id")
+        if group_id:
+            # committed group offsets: the broker resumes PAST consumed
+            # history, so nothing is redelivered — an armed resume skip
+            # would silently drop the first N FRESH CDC events.  The skip
+            # only applies to transports that actually replay from the
+            # start (mock broker, or no consumer group below).
+            self._resume = 0
         consumer = KafkaConsumer(
             self.topic,
             bootstrap_servers=servers,
-            group_id=self.rdkafka_settings.get("group.id"),
+            group_id=group_id,
             auto_offset_reset=self.rdkafka_settings.get(
                 "auto.offset.reset", "earliest"
             ),

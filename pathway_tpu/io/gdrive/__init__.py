@@ -1,16 +1,26 @@
-"""``pw.io.gdrive`` — Google Drive reader (reference python/pathway/io/gdrive).
+"""``pw.io.gdrive`` — Google Drive reader (reference
+``python/pathway/io/gdrive``).
 
-API-surface parity module: the row/format plumbing routes through the shared
-connector framework; the transport activates when the client library is
-available (external services are unreachable in this build environment).
+Intentionally gated, not implemented: the reference connector is a thin
+loop over the authenticated Google Drive v3 REST client
+(``files().list`` by folder id + ``files().get_media`` downloads), and
+every interesting behavior — OAuth2 service-account flow, token refresh,
+export of Google-native docs, 404-on-revoked-share handling — lives
+inside ``googleapiclient`` + live Google endpoints that are unreachable
+from this environment (zero egress, no credentials).  A fake-client
+"implementation" would test nothing beyond what ``pw.io.pyfilesystem``
+(which accepts ANY PyFilesystem, including a Drive-backed one) and
+``pw.io.s3``'s injectable-client pattern already prove.  The API
+surface matches the reference so code written against it ports; calls
+raise ``MissingDependency`` until ``googleapiclient`` is installed.
 """
 
 from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from pathway_tpu.io._gated import gated_reader
 
-read = gated_reader("gdrive", "google.oauth2")
+read = gated_reader("gdrive", "googleapiclient")
 
 __all__ = ["read"]

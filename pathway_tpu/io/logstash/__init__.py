@@ -1,16 +1,68 @@
-"""``pw.io.logstash`` — Logstash sink (reference python/pathway/io/logstash).
+"""``pw.io.logstash`` — Logstash HTTP-input sink (reference
+``python/pathway/io/logstash``): every update is POSTed as a flat JSON
+object with extra ``time``/``diff`` fields.
 
-API-surface parity module: the row/format plumbing routes through the shared
-connector framework; the transport activates when the client library is
-available (external services are unreachable in this build environment).
+The sender is injectable (``sender(endpoint, payload_bytes)``); the
+default uses urllib with the configured retry count.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import json
+import time as _time
+from typing import Any, Callable
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
-
-write = gated_writer("logstash", "aiohttp")
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import Writer, attach_writer, format_change_row
 
 __all__ = ["write"]
+
+
+def _default_sender(endpoint: str, payload: bytes) -> None:
+    import urllib.request
+
+    req = urllib.request.Request(
+        endpoint,
+        data=payload,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+
+
+class _LogstashWriter(Writer):
+    def __init__(self, endpoint: str, n_retries: int, sender: Callable):
+        self.endpoint = endpoint
+        self.n_retries = n_retries
+        self.sender = sender
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        payload = json.dumps(format_change_row(row, time, diff)).encode()
+        attempt = 0
+        while True:
+            try:
+                self.sender(self.endpoint, payload)
+                return
+            except Exception:
+                attempt += 1
+                if attempt > self.n_retries:
+                    raise
+                _time.sleep(min(0.1 * 2**attempt, 2.0))
+
+
+def write(
+    table: Table,
+    endpoint: str,
+    n_retries: int = 0,
+    retry_policy: Any = None,
+    connect_timeout_ms: int | None = None,
+    request_timeout_ms: int | None = None,
+    *,
+    sender: Callable | None = None,
+) -> None:
+    """Send the table's update stream to a Logstash HTTP input."""
+    attach_writer(
+        table,
+        _LogstashWriter(endpoint, n_retries, sender or _default_sender),
+        name="logstash_out",
+    )

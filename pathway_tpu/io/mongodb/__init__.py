@@ -1,16 +1,77 @@
-"""``pw.io.mongodb`` — MongoDB sink (reference python/pathway/io/mongodb; writer src/connectors/data_storage.rs:2232).
+"""``pw.io.mongodb`` — MongoDB sink (reference ``python/pathway/io/mongodb``;
+Rust writer ``src/connectors/data_storage.rs:2232``).
 
-API-surface parity module: the row/format plumbing routes through the shared
-connector framework; the transport activates when the client library is
-available (external services are unreachable in this build environment).
+Each epoch's updates flush as one ``insert_many`` of BSON-able documents
+carrying the engine's ``time``/``diff`` fields (the reference writes the
+change stream the same way — a modification is a -1 doc then a +1 doc).
+The client is injectable (anything shaped like ``pymongo.MongoClient``:
+``client[db][collection].insert_many(docs)``); without one, pymongo is
+imported lazily.
 """
 
 from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
-
-write = gated_writer("mongodb", "pymongo")
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import Writer, attach_writer, format_change_row
+from pathway_tpu.io._gated import MissingDependency
 
 __all__ = ["write"]
+
+
+class _MongoWriter(Writer):
+    def __init__(
+        self,
+        connection_string: str,
+        database: str,
+        collection: str,
+        max_batch_size: int | None,
+        client: Any,
+    ):
+        self.connection_string = connection_string
+        self.database = database
+        self.collection = collection
+        self.max_batch_size = max_batch_size
+        self._client = client
+        self._docs: list[dict] = []
+
+    def _coll(self) -> Any:
+        if self._client is None:
+            try:
+                from pymongo import MongoClient  # type: ignore[import-not-found]
+            except ImportError as e:
+                raise MissingDependency(
+                    "pymongo is not installed; pass client= with a "
+                    "MongoClient-compatible object"
+                ) from e
+            self._client = MongoClient(self.connection_string)
+        return self._client[self.database][self.collection]
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        self._docs.append(format_change_row(row, time, diff))
+        if self.max_batch_size and len(self._docs) >= self.max_batch_size:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._docs:
+            self._coll().insert_many(self._docs)
+            self._docs = []
+
+
+def write(
+    table: Table,
+    *,
+    connection_string: str,
+    database: str,
+    collection: str,
+    max_batch_size: int | None = None,
+    client: Any = None,
+    name: str = "mongodb_out",
+) -> None:
+    """Write the table's change stream to a MongoDB collection."""
+    attach_writer(
+        table,
+        _MongoWriter(connection_string, database, collection, max_batch_size, client),
+        name=name,
+    )

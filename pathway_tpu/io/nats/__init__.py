@@ -1,17 +1,227 @@
-"""``pw.io.nats`` — NATS connector (reference python/pathway/io/nats; reader src/connectors/data_storage.rs:2271, writer :2345).
+"""``pw.io.nats`` — NATS connector (reference ``python/pathway/io/nats``;
+Rust reader ``src/connectors/data_storage.rs:2271``, writer ``:2345``).
 
-API-surface parity module: the row/format plumbing routes through the shared
-connector framework; the transport activates when the client library is
-available (external services are unreachable in this build environment).
+Messages are subject-addressed payloads.  The client is injectable — a
+minimal duck-typed broker with ``publish(subject, payload, headers)``
+and ``subscribe(subject, on_message) -> unsubscribe`` (tests use the
+in-process :class:`MockNats`); without one, the async ``nats-py`` client
+is wrapped in a background asyncio loop.
+
+Formats follow the reference: reader ``raw``/``plaintext`` (autogen key,
+single ``data`` column) or ``json``; writer ``json``/``plaintext`` with
+``pathway_time``/``pathway_diff`` headers on every message.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import json as _json
+import threading
+import time as _time
+from collections import defaultdict
+from typing import Any, Callable
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.keys import ref_scalar
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import RowSource, Writer, attach_writer, coerce_row, fmt_value, input_table
+from pathway_tpu.io._gated import MissingDependency
 
-read = gated_reader("nats", "nats")
-write = gated_writer("nats", "nats")
+__all__ = ["read", "write", "MockNats"]
 
-__all__ = ["read", "write"]
+
+class MockNats:
+    """In-process NATS double (the kafka MockBroker pattern): pub/sub by
+    subject, shared per uri via ``MockNats.get("mock://name")``."""
+
+    _instances: dict[str, "MockNats"] = {}
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._subs: dict[str, list[Callable]] = defaultdict(list)
+        self.published: list[tuple[str, bytes, dict]] = []
+
+    @classmethod
+    def get(cls, uri: str) -> "MockNats":
+        with cls._lock:
+            return cls._instances.setdefault(uri, cls())
+
+    def publish(self, subject: str, payload: bytes, headers: dict | None = None) -> None:
+        self.published.append((subject, payload, headers or {}))
+        for cb in list(self._subs.get(subject, ())):
+            cb(payload, headers or {})
+
+    def subscribe(self, subject: str, on_message: Callable) -> Callable:
+        self._subs[subject].append(on_message)
+
+        def unsubscribe():
+            try:
+                self._subs[subject].remove(on_message)
+            except ValueError:
+                pass
+
+        return unsubscribe
+
+
+def _client_for(uri: str, client: Any) -> Any:
+    if client is not None:
+        return client
+    if uri.startswith("mock://"):
+        return MockNats.get(uri)
+    try:
+        import nats  # type: ignore[import-not-found]  # noqa: F401
+    except ImportError as e:
+        raise MissingDependency(
+            "nats-py is not installed; pass client= with a "
+            "publish/subscribe-capable object or use a mock:// uri"
+        ) from e
+    return _AsyncNatsBridge(uri)
+
+
+class _AsyncNatsBridge:
+    """Wraps the asyncio nats-py client behind the sync duck-type."""
+
+    def __init__(self, uri: str):
+        import asyncio
+
+        import nats  # type: ignore[import-not-found]
+
+        self._loop = asyncio.new_event_loop()
+        threading.Thread(target=self._loop.run_forever, daemon=True).start()
+        fut = asyncio.run_coroutine_threadsafe(nats.connect(uri), self._loop)
+        self._nc = fut.result(timeout=30)
+
+    def publish(self, subject, payload, headers=None):
+        import asyncio
+
+        asyncio.run_coroutine_threadsafe(
+            self._nc.publish(subject, payload, headers=headers or {}), self._loop
+        ).result(timeout=30)
+
+    def subscribe(self, subject, on_message):
+        import asyncio
+
+        async def handler(msg):
+            on_message(msg.data, dict(msg.headers or {}))
+
+        fut = asyncio.run_coroutine_threadsafe(
+            self._nc.subscribe(subject, cb=handler), self._loop
+        )
+        sub = fut.result(timeout=30)
+
+        def unsubscribe():
+            asyncio.run_coroutine_threadsafe(
+                sub.unsubscribe(), self._loop
+            ).result(timeout=30)
+
+        return unsubscribe
+
+
+class _NatsSource(RowSource):
+    deterministic_replay = False  # live subject; no replay from broker
+
+    def __init__(self, uri: str, topic: str, schema, format: str, client: Any):
+        self.uri = uri
+        self.topic = topic
+        self.schema = schema
+        self.format = format
+        self.client = client
+        self._seq = 0
+
+    def run(self, events: Any) -> None:
+        client = _client_for(self.uri, self.client)
+        lock = threading.Lock()
+
+        def on_message(payload: bytes, headers: dict) -> None:
+            with lock:
+                self._seq += 1
+                seq = self._seq
+            if self.format == "raw":
+                values = {"data": payload}
+            elif self.format == "plaintext":
+                values = {"data": payload.decode(errors="replace")}
+            else:  # json
+                try:
+                    values = _json.loads(payload)
+                except Exception:
+                    return
+                if not isinstance(values, dict):
+                    return
+            pk = self.schema.primary_key_columns()
+            if pk:
+                key = ref_scalar(*[values.get(c) for c in pk])
+            else:
+                key = ref_scalar("__nats__", self.topic, seq)
+            events.add(key, coerce_row(values, self.schema))
+            events.commit()
+
+        unsubscribe = client.subscribe(self.topic, on_message)
+        try:
+            while not events.stopped:
+                _time.sleep(0.1)
+        finally:
+            unsubscribe()
+
+
+class _NatsWriter(Writer):
+    def __init__(self, uri: str, topic: str, format: str, value_col: str | None, client: Any):
+        self.uri = uri
+        self.topic = topic
+        self.format = format
+        self.value_col = value_col
+        self._client = client
+
+    def _get_client(self):
+        if self._client is None or isinstance(self._client, str):
+            self._client = _client_for(self.uri, None)
+        return self._client
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        if self.format == "plaintext":
+            col = self.value_col or next(k for k in row if k != "id")
+            payload = str(row[col]).encode()
+        else:  # json
+            doc = {k: fmt_value(v) for k, v in row.items() if k != "id"}
+            payload = _json.dumps(doc).encode()
+        self._get_client().publish(
+            self.topic,
+            payload,
+            {"pathway_time": str(time), "pathway_diff": str(diff)},
+        )
+
+
+def read(
+    uri: str,
+    topic: str,
+    *,
+    schema: sch.SchemaMetaclass | None = None,
+    format: str = "raw",
+    autocommit_duration_ms: int | None = 1500,
+    client: Any = None,
+    name: str = "nats",
+    **kwargs: Any,
+) -> Table:
+    """Subscribe to a NATS subject; ``raw``/``plaintext`` yield a single
+    ``data`` column, ``json`` parses the payload against ``schema``."""
+    if schema is None:
+        schema = sch.schema_from_types(data=bytes if format == "raw" else str)
+    src = _NatsSource(uri, topic, schema, format, client)
+    return input_table(src, schema, name=name)
+
+
+def write(
+    table: Table,
+    uri: str,
+    topic: str,
+    *,
+    format: str = "json",
+    value: Any = None,
+    headers: Any = None,
+    client: Any = None,
+    name: str = "nats_out",
+    **kwargs: Any,
+) -> None:
+    """Publish the table's change stream to a NATS subject."""
+    value_col = getattr(value, "_name", value) if value is not None else None
+    attach_writer(
+        table, _NatsWriter(uri, topic, format, value_col, client), name=name
+    )

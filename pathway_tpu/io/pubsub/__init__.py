@@ -1,16 +1,63 @@
-"""``pw.io.pubsub`` — Google Pub/Sub sink (reference python/pathway/io/pubsub).
+"""``pw.io.pubsub`` — Google Pub/Sub sink (reference
+``python/pathway/io/pubsub``).
 
-API-surface parity module: the row/format plumbing routes through the shared
-connector framework; the transport activates when the client library is
-available (external services are unreachable in this build environment).
+The reference API takes the CONFIGURED ``pubsub_v1.PublisherClient`` as
+an argument — the publisher is the injection point by design, so tests
+pass a double with ``topic_path``/``publish``.  The table must have a
+single binary/string payload column; the connector adds ``pathway_time``
+and ``pathway_diff`` attributes to every message.
 """
 
 from __future__ import annotations
 
 from typing import Any
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
-
-write = gated_writer("pubsub", "google.cloud.pubsub")
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import Writer, attach_writer
 
 __all__ = ["write"]
+
+
+class _PubSubWriter(Writer):
+    def __init__(self, publisher: Any, project_id: str, topic_id: str, column: str):
+        self.publisher = publisher
+        self.topic = publisher.topic_path(project_id, topic_id)
+        self.column = column
+        self._futures: list[Any] = []
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        payload = row[self.column]
+        if isinstance(payload, str):
+            payload = payload.encode()
+        elif not isinstance(payload, (bytes, bytearray)):
+            payload = str(payload).encode()
+        fut = self.publisher.publish(
+            self.topic,
+            data=bytes(payload),
+            pathway_time=str(time),
+            pathway_diff=str(diff),
+        )
+        if fut is not None:
+            self._futures.append(fut)
+
+    def flush(self) -> None:
+        for fut in self._futures:
+            result = getattr(fut, "result", None)
+            if result is not None:
+                result()
+        self._futures = []
+
+
+def write(table: Table, publisher: Any, project_id: str, topic_id: str) -> None:
+    """Publish the table's change stream to a Pub/Sub topic; ``table``
+    must have exactly one (binary/string) payload column."""
+    cols = table.column_names()
+    if len(cols) != 1:
+        raise ValueError(
+            f"pw.io.pubsub.write expects a single payload column; got {cols}"
+        )
+    attach_writer(
+        table,
+        _PubSubWriter(publisher, project_id, topic_id, cols[0]),
+        name="pubsub_out",
+    )

@@ -87,7 +87,11 @@ class _S3Source(RowSource):
         poll_interval: float = 1.0,
         downloader_threads: int = 8,
         tag: str = "s3",
+        object_cache: Any = None,
     ):
+        #: optional pathway_tpu.persistence.CachedObjectStorage — serves
+        #: unchanged object versions (by ETag) without re-downloading
+        self.object_cache = object_cache
         self.settings = settings
         self.prefix = prefix
         self.schema = schema
@@ -125,15 +129,29 @@ class _S3Source(RowSource):
             token = resp.get("NextContinuationToken")
         return sorted(out, key=lambda o: o["Key"])
 
-    def _fetch(self, client: Any, key: str) -> bytes:
+    def _fetch(self, client: Any, key: str, etag: str = "") -> bytes:
+        cache = self.object_cache
+        uri = f"s3://{self.settings.bucket_name}/{key}"
+        if cache is not None and etag:
+            hit = cache.get(uri, etag)
+            if hit is not None:
+                return hit
         body = client.get_object(Bucket=self.settings.bucket_name, Key=key)["Body"]
-        return body.read() if hasattr(body, "read") else bytes(body)
+        data = body.read() if hasattr(body, "read") else bytes(body)
+        if cache is not None and etag:
+            cache.put(uri, etag, data)
+        return data
 
-    def _emit_object(self, events: Any, key: str, data: bytes, meta: dict) -> None:
+    def _emit_object(
+        self, events: Any, key: str, data: bytes, meta: dict
+    ) -> list[tuple]:
+        """Emit an object's rows; returns the emitted (row_key, row) pairs
+        so a later version of the object can retract them first."""
         pk = self.schema.primary_key_columns()
         parser = self.parser_factory(key)
         w, n = self._part
         seq = 0
+        emitted: list[tuple] = []
         for raw in data.split(b"\n"):
             line = raw.decode(errors="replace")
             if not line.strip():
@@ -153,11 +171,15 @@ class _S3Source(RowSource):
                 row_key = ref_scalar("__s3__", self.tag, key, seq)
             if n > 1 and int(row_key) % n != w:
                 continue
-            events.add(row_key, coerce_row(values, self.schema))
+            row = coerce_row(values, self.schema)
+            events.add(row_key, row)
+            emitted.append((row_key, row))
+        return emitted
 
     def run(self, events: Any) -> None:
         client = self.settings.create_client()
         seen: dict[str, tuple] = {}  # object key -> (etag, size)
+        emitted: dict[str, list[tuple]] = {}  # object key -> emitted rows
         while True:
             objects = self._list(client)
             fresh = [
@@ -170,7 +192,12 @@ class _S3Source(RowSource):
                 # with deterministic key-ordered emission
                 with ThreadPoolExecutor(self.downloader_threads) as pool:
                     blobs = list(
-                        pool.map(lambda o: self._fetch(client, o["Key"]), fresh)
+                        pool.map(
+                            lambda o: self._fetch(
+                                client, o["Key"], str(o.get("ETag", ""))
+                            ),
+                            fresh,
+                        )
                     )
                 for obj, data in zip(fresh, blobs):
                     meta = {
@@ -178,7 +205,15 @@ class _S3Source(RowSource):
                         "modified_at": str(obj.get("LastModified", "")),
                         "size": obj.get("Size"),
                     }
-                    self._emit_object(events, obj["Key"], data, meta)
+                    # an object VERSION replaces its predecessor: retract
+                    # the old version's rows before re-adding, or the
+                    # unchanged prefix would double-count under the same
+                    # autogen keys (reference retracts modified objects)
+                    for row_key, row in emitted.get(obj["Key"], ()):
+                        events.remove(row_key, row)
+                    emitted[obj["Key"]] = self._emit_object(
+                        events, obj["Key"], data, meta
+                    )
                     seen[obj["Key"]] = (obj.get("ETag"), obj.get("Size"))
                 events.commit()
             if self.mode == "static":
@@ -242,6 +277,7 @@ def read(
     with_metadata: bool = False,
     downloader_threads_count: int = 8,
     name: str = "s3",
+    object_cache: Any = None,
     **kwargs: Any,
 ) -> Table:
     """Read objects under ``path`` (``s3://bucket/prefix``, or a bare
@@ -265,5 +301,6 @@ def read(
         with_metadata=with_metadata,
         downloader_threads=downloader_threads_count,
         tag=f"s3:{settings.bucket_name}/{prefix}",
+        object_cache=object_cache,
     )
     return input_table(src, schema, name=name)

@@ -1,16 +1,71 @@
-"""``pw.io.slack`` — Slack alert sink (reference python/pathway/io/slack).
+"""``pw.io.slack`` — Slack alert sink (reference
+``python/pathway/io/slack``: ``send_alerts(alerts, channel, token)``).
 
-API-surface parity module: the row/format plumbing routes through the shared
-connector framework; the transport activates when the client library is
-available (external services are unreachable in this build environment).
+Every ADDED value of the alert column becomes one
+``chat.postMessage`` call (retractions are ignored — an alert, once
+sent, cannot be unsent).  The HTTP poster is injectable
+(``poster(url, headers, payload_dict)``); the default uses urllib —
+no slack_sdk dependency.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import json
+from typing import Any, Callable
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from pathway_tpu.internals.expression import ColumnReference
+from pathway_tpu.io._connector import Writer, attach_writer
 
-write = gated_writer("slack", "aiohttp")
+__all__ = ["send_alerts"]
 
-__all__ = ["write"]
+_API_URL = "https://slack.com/api/chat.postMessage"
+
+
+def _default_poster(url: str, headers: dict, payload: dict) -> None:
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), headers=headers, method="POST"
+    )
+    urllib.request.urlopen(req, timeout=10).read()
+
+
+class _SlackWriter(Writer):
+    def __init__(self, channel: str, token: str, column: str, poster: Callable):
+        self.channel = channel
+        self.token = token
+        self.column = column
+        self.poster = poster
+
+    def write(self, row: dict[str, Any], time: int, diff: int) -> None:
+        if diff <= 0:
+            return  # alerts are not retractable
+        self.poster(
+            _API_URL,
+            {
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {self.token}",
+            },
+            {"channel": self.channel, "text": str(row[self.column])},
+        )
+
+
+def send_alerts(
+    alerts: ColumnReference,
+    slack_channel_id: str,
+    slack_token: str,
+    *,
+    poster: Callable | None = None,
+) -> None:
+    """Post every new value of ``alerts`` to a Slack channel."""
+    table = alerts._table.select(alert=alerts)
+    attach_writer(
+        table,
+        _SlackWriter(
+            slack_channel_id, slack_token, "alert", poster or _default_poster
+        ),
+        name="slack_out",
+    )
+
+
+write = send_alerts  # convenience alias

@@ -29,7 +29,13 @@ import pickle
 import threading
 from typing import Any
 
-__all__ = ["Backend", "Config", "PersistenceMode", "attach_persistence"]
+__all__ = [
+    "Backend",
+    "CachedObjectStorage",
+    "Config",
+    "PersistenceMode",
+    "attach_persistence",
+]
 
 _logger = logging.getLogger("pathway_tpu.persistence")
 
@@ -221,6 +227,95 @@ class _FsBackend(_BackendImpl):
             return json.load(f)
 
 
+class _S3Backend(_BackendImpl):
+    """Persistence over an S3-compatible object store (reference
+    ``src/persistence/backends/s3.rs``).  S3 has no append: every
+    ``append`` writes one immutable object under
+    ``{root}/streams/{stream}/{counter:012d}`` — the chunked "addmany"
+    log records keep that to ~one PUT per ingest chunk.  The client is
+    injectable (boto3-compatible: put/get/list/delete_object), the same
+    pattern as ``pw.io.s3``."""
+
+    def __init__(self, root: str, settings: Any):
+        self.root = root.strip("/")
+        self.settings = settings
+        self._client = settings.create_client()
+        self._bucket = settings.bucket_name
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+
+    # -- low-level ------------------------------------------------------
+    def _key(self, *parts: str) -> str:
+        return "/".join([self.root, *parts])
+
+    def _put(self, key: str, data: bytes) -> None:
+        self._client.put_object(Bucket=self._bucket, Key=key, Body=data)
+
+    def _get(self, key: str) -> bytes | None:
+        try:
+            body = self._client.get_object(Bucket=self._bucket, Key=key)["Body"]
+        except Exception:
+            return None
+        return body.read() if hasattr(body, "read") else bytes(body)
+
+    def _list(self, prefix: str) -> list[str]:
+        keys: list[str] = []
+        token = None
+        while True:
+            kwargs = {"Bucket": self._bucket, "Prefix": prefix}
+            if token:
+                kwargs["ContinuationToken"] = token
+            resp = self._client.list_objects_v2(**kwargs)
+            keys.extend(o["Key"] for o in resp.get("Contents", []))
+            if not resp.get("IsTruncated"):
+                return sorted(keys)
+            token = resp.get("NextContinuationToken")
+
+    # -- streams --------------------------------------------------------
+    def _stream_keys(self, stream: str) -> list[str]:
+        return self._list(self._key("streams", stream) + "/")
+
+    def append(self, stream, record):
+        with self._lock:
+            n = self._counters.get(stream)
+            if n is None:
+                n = len(self._stream_keys(stream))
+            self._put(self._key("streams", stream, f"{n:012d}"), record)
+            self._counters[stream] = n + 1
+
+    def read_all(self, stream):
+        keys = self._stream_keys(stream)
+        with self._lock:
+            self._counters[stream] = len(keys)
+        out = []
+        for k in keys:
+            data = self._get(k)
+            if data is not None:
+                out.append(data)
+        return out
+
+    def truncate(self, stream, n_records):
+        keys = self._stream_keys(stream)
+        with self._lock:
+            for k in keys[n_records:]:
+                self._client.delete_object(Bucket=self._bucket, Key=k)
+            self._counters[stream] = min(n_records, len(keys))
+
+    # -- blobs / meta ---------------------------------------------------
+    def put_blob(self, name, data):
+        self._put(self._key("blobs", name), data)
+
+    def get_blob(self, name):
+        return self._get(self._key("blobs", name))
+
+    def put_meta(self, data):
+        self._put(self._key("metadata.json"), json.dumps(data).encode())
+
+    def get_meta(self):
+        raw = self._get(self._key("metadata.json"))
+        return json.loads(raw) if raw else {}
+
+
 class Backend:
     """reference ``pw.persistence.Backend`` factory methods."""
 
@@ -240,12 +335,71 @@ class Backend:
 
     @classmethod
     def s3(cls, root_path: str, bucket_settings: Any = None) -> "Backend":
-        raise NotImplementedError(
-            "S3 persistence needs the boto3 package (unavailable in this "
-            "environment); use Backend.filesystem"
-        )
+        """Snapshots in an S3 bucket (reference ``Backend::s3``,
+        ``python/pathway/persistence/__init__.py`` over
+        ``src/persistence/backends/s3.rs``).  ``bucket_settings`` is a
+        ``pw.io.s3.AwsS3Settings``; pass ``client=`` there to inject a
+        boto3-compatible client (tests use a fake)."""
+        if bucket_settings is None:
+            raise ValueError(
+                "Backend.s3 requires bucket_settings (pw.io.s3.AwsS3Settings)"
+            )
+        return cls(_S3Backend(root_path, bucket_settings), "s3")
 
     azure = s3
+
+
+class CachedObjectStorage:
+    """Versioned blob cache for connector-downloaded objects (reference
+    ``src/persistence/cached_object_storage.rs:1-377``): a connector that
+    downloads remote objects (S3 blobs, parsed documents) stores them
+    here keyed by (uri, version); after a restart — or when the remote
+    charges per GET — an unchanged version is served from the cache.
+    Backed by any persistence backend (fs/memory/S3)."""
+
+    _INDEX = "__object_cache_index__"
+
+    def __init__(self, backend: Backend):
+        self.impl = backend._impl
+        raw = self.impl.get_blob(self._INDEX)
+        self._index: dict[str, dict] = json.loads(raw) if raw else {}
+        # callers include the S3 source's 8-thread downloader pool — the
+        # index mutation + serialization must be atomic
+        self._lock = threading.Lock()
+
+    def _blob_name(self, uri: str) -> str:
+        import hashlib
+
+        return "objcache_" + hashlib.blake2b(uri.encode(), digest_size=16).hexdigest()
+
+    def contains(self, uri: str, version: str) -> bool:
+        with self._lock:
+            entry = self._index.get(uri)
+            return entry is not None and entry.get("version") == str(version)
+
+    def get(self, uri: str, version: str) -> bytes | None:
+        if not self.contains(uri, version):
+            return None
+        return self.impl.get_blob(self._blob_name(uri))
+
+    def put(self, uri: str, version: str, data: bytes) -> None:
+        self.impl.put_blob(self._blob_name(uri), data)
+        with self._lock:
+            self._index[uri] = {"version": str(version), "size": len(data)}
+            self._flush_index()
+
+    def invalidate(self, uri: str) -> None:
+        with self._lock:
+            if self._index.pop(uri, None) is not None:
+                self._flush_index()
+
+    def uris(self) -> list[str]:
+        with self._lock:
+            return sorted(self._index)
+
+    def _flush_index(self) -> None:
+        """Caller holds ``self._lock``."""
+        self.impl.put_blob(self._INDEX, json.dumps(self._index).encode())
 
 
 class Config:

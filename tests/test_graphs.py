@@ -1,0 +1,113 @@
+"""gradual_broadcast operator + multi-level Louvain communities
+(reference ``src/engine/dataflow/operators/gradual_broadcast.rs`` and
+``python/pathway/stdlib/graphs/louvain_communities/impl.py``)."""
+
+import itertools
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.graphs import (
+    WeightedGraph,
+    exact_modularity,
+    louvain_communities,
+    louvain_level,
+)
+from tests.utils import T
+
+
+def test_gradual_broadcast_appends_apx_value():
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    th = t.reduce(m=pw.reducers.sum(pw.this.a)).select(
+        lower=pw.apply(lambda m: m - 1.0, pw.this.m),
+        value=pw.apply(float, pw.this.m),
+        upper=pw.apply(lambda m: m + 1.0, pw.this.m),
+    )
+    b = t._gradual_broadcast(th, th.lower, th.value, th.upper)
+    _, cols = pw.debug.table_to_dicts(b)
+    assert set(cols["apx_value"].values()) == {6.0}
+    assert sorted(cols["a"].values()) == [1, 2, 3]
+
+
+def test_gradual_broadcast_damps_churn():
+    """Rows only re-emit when their held value leaves the new window —
+    a triplet move WITHIN the window must not retract anything."""
+    t = T(
+        """
+        a | __time__ | __diff__
+        1 | 2        | 1
+        2 | 2        | 1
+        """
+    )
+    # threshold stream: (5, 6, 8) at t=2, then (5, 7, 8) at t=4 (inside
+    # the old window), then (20, 21, 22) at t=6 (outside)
+    th = T(
+        """
+        lower | value | upper | __time__ | __diff__
+        5.0   | 6.0   | 8.0   | 2        | 1
+        5.0   | 7.0   | 8.0   | 4        | 1
+        20.0  | 21.0  | 22.0  | 6        | 1
+        """
+    )
+    b = t._gradual_broadcast(th, th.lower, th.value, th.upper)
+    from tests.utils import stream_rows
+
+    stream = stream_rows(b)
+    # apx per row: 6.0 at t=2 (held through the t=4 update — inside
+    # [5, 8]), then 21.0 at t=6
+    apx_changes = [
+        (vals[-1], time, diff) for _k, vals, time, diff in stream
+    ]
+    assert (6.0, 2, 1) in apx_changes
+    # no churn at t=4: nothing retracted/emitted then
+    assert not any(time == 4 for _v, time, _d in apx_changes)
+    assert (6.0, 6, -1) in apx_changes
+    assert (21.0, 6, 1) in apx_changes
+
+
+def _two_cliques():
+    rows = []
+    for members in (range(5), range(5, 10)):
+        for u, v in itertools.combinations(members, 2):
+            rows.append((u, v, 1.0))
+    rows.append((0, 5, 0.1))  # weak bridge
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(u=int, v=int, weight=float), rows
+    )
+
+
+def test_louvain_communities_two_cliques():
+    G = WeightedGraph(_two_cliques())
+    lc = louvain_communities(G, levels=2)
+    keys, cols = pw.debug.table_to_dicts(lc.final_clustering)
+    assign = {cols["v"][k]: cols["c"][k] for k in keys}
+    assert len(assign) == 10
+    c_a = {assign[i] for i in range(5)}
+    c_b = {assign[i] for i in range(5, 10)}
+    assert len(c_a) == 1 and len(c_b) == 1 and c_a != c_b
+
+    # hierarchical clustering has every vertex at level 0 and parents above
+    _, hcols = pw.debug.table_to_dicts(lc.hierarchical_clustering)
+    assert set(hcols["level"].values()) == {0, 1, 2}
+
+    # community quality: known-good modularity for two 5-cliques + bridge
+    _, mcols = pw.debug.table_to_dicts(exact_modularity(G, lc.final_clustering))
+    (q,) = mcols["modularity"].values()
+    assert q > 0.45
+
+
+def test_louvain_level_with_gradual_total_weight():
+    from pathway_tpu.stdlib.graphs import _approximate_total_weight
+
+    edges = _two_cliques()
+    tw = _approximate_total_weight(edges)
+    c = louvain_level(WeightedGraph(edges), total_weight=tw)
+    keys, cols = pw.debug.table_to_dicts(c)
+    assign = {cols["node"][k]: cols["community"][k] for k in keys}
+    assert len({assign[i] for i in range(5)}) == 1
+    assert len({assign[i] for i in range(5, 10)}) == 1

@@ -452,3 +452,169 @@ def test_operator_snapshot_windows_not_reflushed(tmp_path):
     build(fired2)
     _run_op(tmp_path)
     assert fired2 == []  # nothing re-flushes on a no-new-data restart
+
+
+class FakeS3Client:
+    """boto3-compatible in-memory object store shared across instances."""
+
+    def __init__(self, store=None):
+        self.store = store if store is not None else {}
+        self.get_calls = 0
+
+    def put_object(self, Bucket, Key, Body):
+        self.store[Key] = bytes(Body)
+
+    def get_object(self, Bucket, Key):
+        self.get_calls += 1
+        if Key not in self.store:
+            raise KeyError(Key)
+        return {"Body": self.store[Key]}
+
+    def list_objects_v2(self, Bucket, Prefix, **kw):
+        return {
+            "Contents": [
+                {"Key": k, "Size": len(v)}
+                for k, v in sorted(self.store.items())
+                if k.startswith(Prefix)
+            ],
+            "IsTruncated": False,
+        }
+
+    def delete_object(self, Bucket, Key):
+        self.store.pop(Key, None)
+
+
+def _s3_backend(store):
+    from pathway_tpu.io.s3 import AwsS3Settings
+
+    return Backend.s3(
+        "pstorage/test",
+        AwsS3Settings(bucket_name="bkt", client=FakeS3Client(store)),
+    )
+
+
+def test_s3_backend_snapshot_resume(tmp_path):
+    """Full snapshot/restore cycle through the S3 persistence backend
+    (reference ``src/persistence/backends/s3.rs``) with a fake client."""
+    input_file = tmp_path / "words.jsonl"
+    input_file.write_text(
+        "\n".join('{"word": "%s"}' % w for w in ["a", "b", "a"])
+    )
+    store: dict = {}
+
+    results1: dict = {}
+    _build_wordcount(input_file, results1)
+    sched = Scheduler(G.engine_graph, autocommit_ms=10)
+    attach_persistence(sched, Config.simple_config(_s3_backend(store)))
+    sched.run()
+    assert results1 == {"a": 2, "b": 1}
+    assert any(k.startswith("pstorage/test/streams/") for k in store)
+
+    G.clear()
+    with input_file.open("a") as f:
+        f.write('\n{"word": "a"}\n{"word": "c"}')
+    results2: dict = {}
+    _build_wordcount(input_file, results2)
+    sched = Scheduler(G.engine_graph, autocommit_ms=10)
+    attach_persistence(sched, Config.simple_config(_s3_backend(store)))
+    sched.run()
+    assert results2 == {"a": 3, "b": 1, "c": 1}
+
+
+def test_s3_backend_stream_truncate_roundtrip():
+    store: dict = {}
+    impl = _s3_backend(store)._impl
+    for i in range(5):
+        impl.append("st", b"rec%d" % i)
+    assert impl.read_all("st") == [b"rec0", b"rec1", b"rec2", b"rec3", b"rec4"]
+    impl.truncate("st", 2)
+    assert impl.read_all("st") == [b"rec0", b"rec1"]
+    impl.append("st", b"new")
+    assert impl.read_all("st") == [b"rec0", b"rec1", b"new"]
+    impl.put_meta({"n_workers": 2})
+    assert impl.get_meta() == {"n_workers": 2}
+    impl.put_blob("blb", b"xyz")
+    assert impl.get_blob("blb") == b"xyz"
+
+
+def test_cached_object_storage():
+    from pathway_tpu.persistence import CachedObjectStorage
+
+    backend = Backend.memory("obj_cache_test")
+    cache = CachedObjectStorage(backend)
+    assert cache.get("s3://b/k", "v1") is None
+    cache.put("s3://b/k", "v1", b"data1")
+    assert cache.contains("s3://b/k", "v1")
+    assert not cache.contains("s3://b/k", "v2")
+    assert cache.get("s3://b/k", "v1") == b"data1"
+    # new version replaces
+    cache.put("s3://b/k", "v2", b"data2")
+    assert cache.get("s3://b/k", "v1") is None
+    assert cache.get("s3://b/k", "v2") == b"data2"
+    # survives a "restart" (new instance, same backend)
+    cache2 = CachedObjectStorage(Backend.memory("obj_cache_test"))
+    assert cache2.get("s3://b/k", "v2") == b"data2"
+    cache2.invalidate("s3://b/k")
+    assert cache2.get("s3://b/k", "v2") is None
+
+
+def test_s3_source_uses_object_cache():
+    """Unchanged object versions are served from the cache: the second
+    source run does ZERO get_object calls."""
+    import threading
+    import time
+
+    from pathway_tpu.io.s3 import AwsS3Settings, _parser_for, _S3Source
+    from pathway_tpu.persistence import CachedObjectStorage
+
+    store = {"pre/a.jsonl": b'{"v": 1}\n'}
+
+    class ListingClient(FakeS3Client):
+        def list_objects_v2(self, Bucket, Prefix, **kw):
+            return {
+                "Contents": [
+                    {"Key": k, "Size": len(v), "ETag": "tag1"}
+                    for k, v in sorted(self.store.items())
+                ],
+                "IsTruncated": False,
+            }
+
+    cache = CachedObjectStorage(Backend.memory("s3_src_cache_test"))
+
+    class S(pw.Schema):
+        v: int
+
+    def run_once():
+        client = ListingClient(store)
+        src = _S3Source(
+            AwsS3Settings(bucket_name="b", client=client),
+            "pre/",
+            S,
+            _parser_for("jsonlines", S, None),
+            mode="static",
+            object_cache=cache,
+        )
+        rows = []
+
+        class Events:
+            stopped = False
+
+            def add(self, key, row):
+                rows.append(row)
+
+            def remove(self, key, row):
+                pass
+
+            def commit(self):
+                pass
+
+            def close(self):
+                pass
+
+        src.run(Events())
+        return client.get_calls, rows
+
+    calls1, rows1 = run_once()
+    assert calls1 == 1 and rows1 == [(1,)]
+    calls2, rows2 = run_once()
+    assert calls2 == 0 and rows2 == [(1,)]  # cache hit, no download
