@@ -35,7 +35,7 @@ from pathway_tpu.internals.expression import (
 from pathway_tpu.internals.config import set_license_key, set_monitoring_config
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.keys import Pointer
-from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.parse_graph import G, global_error_log
 from pathway_tpu.internals.run import MonitoringLevel, run, run_all
 from pathway_tpu.internals.schema import (
     Schema,
@@ -186,6 +186,7 @@ __all__ = [
     "UDF",
     "run",
     "run_all",
+    "global_error_log",
     "MonitoringLevel",
     "debug",
     "reducers",

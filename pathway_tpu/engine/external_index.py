@@ -139,7 +139,7 @@ class ExternalIndexNode(Node):
         return changed
 
     def _log_error(self, msg: str) -> None:
-        self._ctx.error_log.append(f"{self.name}: {msg}")
+        self._ctx.log_error(self, f"{self.name}: {msg}")
 
     def _filter_for(self, key: Pointer, values: tuple):
         spec = self.query_filter_fn(key, values)

@@ -17,6 +17,7 @@ implementation (``src/engine/dataflow.rs``).  Design differences, on purpose:
 from __future__ import annotations
 
 import itertools
+import os
 from typing import Any, Callable, Iterable, Sequence
 
 from pathway_tpu.internals import api
@@ -25,6 +26,55 @@ from pathway_tpu.internals.keys import Pointer
 from pathway_tpu.engine import cluster as cl
 from pathway_tpu.engine.reducers import ReducerImpl
 from pathway_tpu.engine.stream import Batch, Update, consolidate, per_key_changes
+
+
+class ErrorEntry(str):
+    """One error-log record.  A ``str`` subclass so every existing
+    consumer (substring checks, len, logging) keeps working, with the
+    structured fields the reference routes to its global error-log table
+    (``src/engine/error.rs`` + ``parse_graph.add_error_log``)."""
+
+    operator: str
+    trace: str
+    time: int
+
+    def __new__(cls, message: str, operator: str = "", trace: str = "", time: int = 0):
+        text = f"{message} [at {trace}]" if trace else message
+        self = super().__new__(cls, text)
+        self.message = message
+        self.operator = operator
+        self.trace = trace
+        self.time = time
+        return self
+
+
+_ctx_local = __import__("threading").local()
+
+
+def current_ctx() -> "RunContext | None":
+    """The RunContext this worker thread is currently processing an epoch
+    for — lets per-cell expression errors reach the run's error log."""
+    return getattr(_ctx_local, "ctx", None)
+
+
+def set_current_ctx(ctx: "RunContext | None") -> None:
+    _ctx_local.ctx = ctx
+
+
+def _user_trace() -> str:
+    """file:line of the first stack frame OUTSIDE pathway_tpu — the user
+    code that created the operator (reference ``internals/trace.py``
+    captures the creation frame the same way)."""
+    import sys
+
+    f = sys._getframe(1)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(pkg_root) and "pathway_tpu" not in fn:
+            return f"{fn}:{f.f_lineno} in {f.f_code.co_name}"
+        f = f.f_back
+    return ""
 
 
 class RunContext:
@@ -37,11 +87,31 @@ class RunContext:
         self.worker_id = worker_id
         self.error_log: list[str] = []
         self.stats: dict[str, Any] = {}
+        #: entries not yet drained into the error-log table node; ONLY
+        #: filled when the graph has an ErrorLogNode (the scheduler sets
+        #: error_sink_enabled) — otherwise nothing ever drains it and a
+        #: long streaming run would leak unboundedly
+        self.error_pending: list[ErrorEntry] = []
+        self.error_sink_enabled: bool = False
 
     def state(self, node: "Node") -> Any:
         if node.id not in self.states:
             self.states[node.id] = node.make_state()
         return self.states[node.id]
+
+    def log_error(self, node: "Node | None", message: str) -> ErrorEntry:
+        """Record an operator error with its creation trace; the entry
+        feeds both ``ctx.error_log`` and the global error-log table."""
+        entry = ErrorEntry(
+            message,
+            operator=repr(node) if node is not None else "",
+            trace=getattr(node, "trace", "") or "",
+            time=self.time,
+        )
+        self.error_log.append(entry)
+        if self.error_sink_enabled:
+            self.error_pending.append(entry)
+        return entry
 
 
 class Node:
@@ -55,6 +125,10 @@ class Node:
         self.inputs = list(inputs)
         self.name = name or type(self).__name__
         self.id = graph.register(self)
+        #: user file:line that created this operator (engine errors are
+        #: re-annotated with it — reference OperatorProperties.trace,
+        #: ``src/engine/graph.rs:441-463``)
+        self.trace = _user_trace()
 
     def exchange_routes(self) -> list | None:
         """Multi-worker co-location: one route function per input port
@@ -141,6 +215,9 @@ class InputNode(Node):
         for u in raw:
             old = rows.get(u.key)
             if u.diff > 0:
+                if old == u.values:
+                    continue  # no-op overwrite: an object re-read's
+                    # unchanged prefix must not churn downstream
                 if old is not None:
                     out.append(Update(u.key, old, -1))
                 rows[u.key] = u.values
@@ -171,7 +248,7 @@ class RowwiseNode(Node):
             try:
                 vals = fn(u.key, u.values)
             except Exception as e:
-                ctx.error_log.append(f"{self.name}: {e!r}")
+                ctx.log_error(self, f"{self.name}: {e!r}")
                 vals = tuple([api.ERROR])
             out.append(Update(u.key, vals, u.diff))
         return out
@@ -552,7 +629,7 @@ class DeduplicateNode(Node):
             try:
                 accept = self.acceptor(u.values, old[1] if old else None)
             except Exception as e:
-                ctx.error_log.append(f"deduplicate acceptor failed: {e!r}")
+                ctx.log_error(self, f"deduplicate acceptor failed: {e!r}")
                 continue
             if accept:
                 if old is not None:
@@ -819,6 +896,37 @@ class ZipNode(Node):
         return consolidate(out)
 
 
+class ErrorLogNode(Node):
+    """The global error-log TABLE's source (reference
+    ``parse_graph.add_error_log`` + ``src/engine/error.rs``): drains the
+    run context's pending error entries every epoch into rows
+    ``(message, operator, trace)``.  Errors raised by operators processed
+    after this node in an epoch surface one epoch later (and the final
+    flush epoch drains the tail)."""
+
+    always_tick = True
+
+    def __init__(self, graph: EngineGraph, name: str = "error_log"):
+        super().__init__(graph, [], name)
+
+    def make_state(self):
+        return {"seq": 0}
+
+    def process(self, ctx, time, inbatches):
+        if not ctx.error_pending:
+            return []
+        st = ctx.state(self)
+        out = []
+        for entry in ctx.error_pending:
+            st["seq"] += 1
+            key = K.ref_scalar("__error__", ctx.worker_id, st["seq"])
+            out.append(
+                Update(key, (entry.message, entry.operator, entry.trace), 1)
+            )
+        ctx.error_pending = []
+        return out
+
+
 class GradualBroadcastNode(Node):
     """Apportioned broadcast of a changing scalar (reference
     ``gradual_broadcast`` operator,
@@ -987,7 +1095,7 @@ class AsyncMapNode(Node):
             try:
                 results = self.batch_fn([u.values for u in additions])
             except Exception as e:
-                ctx.error_log.append(f"{self.name}: batched UDF failed: {e!r}")
+                ctx.log_error(self, f"{self.name}: batched UDF failed: {e!r}")
                 results = [api.ERROR] * len(additions)
             for u, res in zip(additions, results):
                 st["cache"][u.key] = res

@@ -44,10 +44,18 @@ class ConnectorEvents:
         q: "queue.Queue",
         node_id: int,
         stop_event: threading.Event | None = None,
+        stats: dict | None = None,
     ):
         self._q = q
         self._node_id = node_id
         self._stop_event = stop_event
+        #: per-connector counters (reference src/connectors/monitoring.rs);
+        #: approximate under concurrent readers — monitoring only
+        self.stats = stats if stats is not None else {}
+        self.stats.setdefault("rows", 0)
+        self.stats.setdefault("retractions", 0)
+        self.stats.setdefault("commits", 0)
+        self.stats.setdefault("closed", False)
 
     @property
     def stopped(self) -> bool:
@@ -55,9 +63,11 @@ class ConnectorEvents:
         return self._stop_event is not None and self._stop_event.is_set()
 
     def add(self, key: Pointer, values: tuple) -> None:
+        self.stats["rows"] += 1
         self._q.put((self._node_id, "add", key, values))
 
     def remove(self, key: Pointer, values: tuple) -> None:
+        self.stats["retractions"] += 1
         self._q.put((self._node_id, "remove", key, values))
 
     def add_many(self, rows: list) -> None:
@@ -67,14 +77,17 @@ class ConnectorEvents:
         construction happens here, on the READER thread, overlapping the
         scheduler's epoch work."""
         if rows:
+            self.stats["rows"] += len(rows)
             self._q.put(
                 (self._node_id, "batch", [Update(k, v, 1) for k, v in rows], None)
             )
 
     def commit(self) -> None:
+        self.stats["commits"] += 1
         self._q.put((self._node_id, "commit", None, None))
 
     def close(self) -> None:
+        self.stats["closed"] = True
         self._q.put((self._node_id, "close", None, None))
 
 
@@ -94,11 +107,19 @@ class Scheduler:
             for port, inp in enumerate(node.inputs):
                 self.consumers[inp.id].append((node, port))
         self.ctx = RunContext(n_workers=n_workers, worker_id=worker_id)
+        from pathway_tpu.engine.graph import ErrorLogNode
+
+        self._has_error_sink = any(
+            isinstance(n, ErrorLogNode) for n in graph.nodes
+        )
+        self.ctx.error_sink_enabled = self._has_error_sink
         self._stop = threading.Event()
         #: persistence hooks (set by pathway_tpu.persistence.attach_persistence)
         self.persistence: Any = None
         #: per-worker wall time of the last operator snapshot (rate limit)
         self._last_snapshot_at: dict[int, float] = {}
+        #: per-connector counters keyed by input name (monitoring)
+        self.connector_stats: dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     def _snapshot_interval(self) -> float:
@@ -182,6 +203,9 @@ class Scheduler:
     ) -> None:
         ctx = ctx or self.ctx
         ctx.time = time
+        from pathway_tpu.engine.graph import set_current_ctx
+
+        set_current_ctx(ctx)  # per-cell errors route to this run's log
         W = cluster.n_workers if cluster is not None else 1
         pending: dict[int, dict[int, list[Update]]] = defaultdict(lambda: defaultdict(list))
         for nid, batch in inject.items():
@@ -215,6 +239,7 @@ class Scheduler:
                 continue
             n_ports = max(1, len(node.inputs))
             inbatches = [ins.get(i, []) if ins else [] for i in range(n_ports)]
+            t0 = _time.perf_counter()
             try:
                 out = node.process(ctx, time, inbatches)
             except Exception as e:
@@ -226,14 +251,35 @@ class Scheduler:
                 # may be degraded: log loudly, not just to the error table.
                 import logging
 
-                msg = f"{node.name}#{node.id}: {e!r}"
-                ctx.error_log.append(msg)
+                entry = ctx.log_error(node, f"{node.name}#{node.id}: {e!r}")
+                msg = str(entry)
                 logging.getLogger("pathway_tpu").error(
                     "operator failed (epoch %d dropped for this node): %s",
                     time,
                     msg,
                 )
                 out = []
+            # per-operator probe (reference attach_prober/probe_table,
+            # src/engine/graph.rs:988-995): latency + row counts feed the
+            # dashboard and the /metrics endpoint
+            dt_ms = (_time.perf_counter() - t0) * 1000.0
+            probe = ctx.stats.setdefault("operators", {}).get(node.id)
+            if probe is None:
+                probe = {
+                    "name": f"{node.name}#{node.id}",
+                    "kind": type(node).__name__,
+                    "rows_in": 0,
+                    "rows_out": 0,
+                    "total_ms": 0.0,
+                    "max_ms": 0.0,
+                    "epochs": 0,
+                }
+                ctx.stats["operators"][node.id] = probe
+            probe["rows_in"] += sum(len(b) for b in inbatches)
+            probe["rows_out"] += len(out)
+            probe["total_ms"] += dt_ms
+            probe["max_ms"] = max(probe["max_ms"], dt_ms)
+            probe["epochs"] += 1
             if out:
                 for consumer, port in self.consumers.get(node.id, ()):  # fan-out
                     pending[consumer.id][port].extend(out)
@@ -337,7 +383,8 @@ class Scheduler:
         threads: list[threading.Thread] = []
         wrappers: dict[int, Any] = {}
         for node in live_inputs:
-            events: Any = ConnectorEvents(q, node.id, self._stop)
+            cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
+            events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
             if self.persistence is not None:
                 events = self.persistence.wrap_events(
                     node, events, replayed_counts.get(node.id, 0)
@@ -428,6 +475,8 @@ class Scheduler:
             )
             for tid in range(T)
         ]
+        for c in ctxs:
+            c.error_sink_enabled = self._has_error_sink
         errors: list[BaseException] = []
 
         def work(tid: int) -> None:
@@ -503,7 +552,8 @@ class Scheduler:
         q: "queue.Queue" = queue.Queue()
         wrappers: dict[int, Any] = {}
         for node, subject in my_inputs:
-            events: Any = ConnectorEvents(q, node.id, self._stop)
+            cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
+            events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
             if self.persistence is not None:
                 events = self.persistence.wrap_events(
                     node, events, replayed_counts.get(node.id, 0), worker=w

@@ -503,6 +503,11 @@ class ApplyExpression(ColumnExpression):
         self._dtype = dt.wrap(return_type)
         self._propagate_none = propagate_none
         self._deterministic = deterministic
+        from pathway_tpu.engine.graph import _user_trace
+
+        #: user file:line of the pw.apply(...) call — attached to runtime
+        #: error-log entries (reference internals/trace.py)
+        self._trace = _user_trace()
 
     def _children(self):
         return (*self._args, *self._kwargs.values())
@@ -523,6 +528,7 @@ class ApplyExpression(ColumnExpression):
         kcs = {k: v._compile(resolver) for k, v in self._kwargs.items()}
         fun = self._fun
         propagate_none = self._propagate_none
+        trace = self._trace
 
         def run(row: tuple) -> Any:
             args = [c(row) for c in acs]
@@ -536,7 +542,10 @@ class ApplyExpression(ColumnExpression):
             except Exception as e:
                 from pathway_tpu.internals.parse_graph import G
 
-                G.log_error(f"apply({getattr(fun, '__name__', fun)!r}) failed: {e!r}")
+                G.log_error(
+                    f"apply({getattr(fun, '__name__', fun)!r}) failed: {e!r}",
+                    trace=trace,
+                )
                 return api.ERROR
 
         return run

@@ -1,5 +1,6 @@
 """Monitoring dashboard (reference ``internals/monitoring.py:56-232``:
-rich-based live TUI driven by ProberStats)."""
+rich-based live TUI driven by ProberStats — connectors table, operator
+latency table, recent errors)."""
 
 from __future__ import annotations
 
@@ -8,7 +9,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-__all__ = ["MonitoringLevel", "ProberStats", "start_dashboard"]
+__all__ = ["MonitoringLevel", "ProberStats", "collect_stats", "start_dashboard"]
 
 
 class MonitoringLevel:
@@ -30,31 +31,91 @@ class ProberStats:
     output_rows: int = 0
     latency_ms: float | None = None
     connectors: dict[str, dict] = field(default_factory=dict)
+    operator_probes: dict[int, dict] = field(default_factory=dict)
 
 
 def collect_stats(sched: Any) -> ProberStats:
     ctx = sched.ctx
+    connectors = {k: dict(v) for k, v in sched.connector_stats.items()}
+    probes = {k: dict(v) for k, v in ctx.stats.get("operators", {}).items()}
     return ProberStats(
         epoch=ctx.time,
         operators=len(sched.graph.nodes),
         errors=len(ctx.error_log),
+        input_rows=sum(c.get("rows", 0) for c in connectors.values()),
+        output_rows=sum(
+            # OutputNodes consume rows and emit none: rows_in IS the
+            # number of updates written (matched by node TYPE — sink
+            # names vary: "bigquery_out", "kafka_out", ...)
+            p["rows_in"]
+            for p in probes.values()
+            if p.get("kind") == "OutputNode"
+        ),
+        connectors=connectors,
+        operator_probes=probes,
     )
 
 
-def start_dashboard(sched: Any, refresh_per_second: float = 4.0) -> threading.Thread:
-    """Live rich dashboard on the terminal (call before ``sched.run``)."""
+def start_dashboard(
+    sched: Any, refresh_per_second: float = 4.0, level: str = MonitoringLevel.ALL
+) -> threading.Thread:
+    """Live rich dashboard (call before ``sched.run``); sections mirror
+    the reference TUI: connector counters, per-operator latency probes
+    (``level=ALL``), recent errors."""
+    from rich.console import Group
     from rich.live import Live
     from rich.table import Table as RichTable
 
-    def render() -> RichTable:
+    def render() -> Group:
         stats = collect_stats(sched)
-        t = RichTable(title="pathway_tpu")
-        t.add_column("metric")
-        t.add_column("value")
-        t.add_row("epoch", str(stats.epoch))
-        t.add_row("operators", str(stats.operators))
-        t.add_row("errors", str(stats.errors))
-        return t
+        parts: list[Any] = []
+
+        head = RichTable(title="pathway_tpu")
+        head.add_column("epoch")
+        head.add_column("operators")
+        head.add_column("errors")
+        head.add_row(str(stats.epoch), str(stats.operators), str(stats.errors))
+        parts.append(head)
+
+        if stats.connectors:
+            ct = RichTable(title="connectors")
+            for col in ("input", "rows", "retractions", "commits", "state"):
+                ct.add_column(col)
+            for name, c in sorted(stats.connectors.items()):
+                ct.add_row(
+                    name,
+                    str(c.get("rows", 0)),
+                    str(c.get("retractions", 0)),
+                    str(c.get("commits", 0)),
+                    "closed" if c.get("closed") else "live",
+                )
+            parts.append(ct)
+
+        if level == MonitoringLevel.ALL and stats.operator_probes:
+            ot = RichTable(title="operators (top by total latency)")
+            for col in ("operator", "rows in", "rows out", "total ms", "max ms"):
+                ot.add_column(col)
+            top = sorted(
+                stats.operator_probes.values(),
+                key=lambda p: -p["total_ms"],
+            )[:12]
+            for p in top:
+                ot.add_row(
+                    p["name"],
+                    str(p["rows_in"]),
+                    str(p["rows_out"]),
+                    f"{p['total_ms']:.1f}",
+                    f"{p['max_ms']:.2f}",
+                )
+            parts.append(ot)
+
+        if sched.ctx.error_log:
+            et = RichTable(title="recent errors")
+            et.add_column("message")
+            for e in sched.ctx.error_log[-5:]:
+                et.add_row(str(e)[:120])
+            parts.append(et)
+        return Group(*parts)
 
     def loop() -> None:
         with Live(render(), refresh_per_second=refresh_per_second) as live:

@@ -24,6 +24,40 @@ def _metrics_text(sched: Any) -> str:
         "# TYPE pathway_tpu_operator_count gauge",
         f"pathway_tpu_operator_count {len(sched.graph.nodes)}",
     ]
+    # per-connector counters (reference src/connectors/monitoring.rs)
+    if sched.connector_stats:
+        lines.append("# TYPE pathway_tpu_connector_rows_total counter")
+        lines.append("# TYPE pathway_tpu_connector_commits_total counter")
+        for name, c in sorted(sched.connector_stats.items()):
+            label = name.replace('"', "'")
+            lines.append(
+                f'pathway_tpu_connector_rows_total{{input="{label}"}} '
+                f"{c.get('rows', 0)}"
+            )
+            lines.append(
+                f'pathway_tpu_connector_commits_total{{input="{label}"}} '
+                f"{c.get('commits', 0)}"
+            )
+    # per-operator probes (reference attach_prober, graph.rs:988-995)
+    probes = ctx.stats.get("operators", {})
+    if probes:
+        lines.append("# TYPE pathway_tpu_operator_rows_in_total counter")
+        lines.append("# TYPE pathway_tpu_operator_rows_out_total counter")
+        lines.append("# TYPE pathway_tpu_operator_latency_ms_total counter")
+        for p in probes.values():
+            label = p["name"].replace('"', "'")
+            lines.append(
+                f'pathway_tpu_operator_rows_in_total{{operator="{label}"}} '
+                f"{p['rows_in']}"
+            )
+            lines.append(
+                f'pathway_tpu_operator_rows_out_total{{operator="{label}"}} '
+                f"{p['rows_out']}"
+            )
+            lines.append(
+                f'pathway_tpu_operator_latency_ms_total{{operator="{label}"}} '
+                f"{p['total_ms']:.3f}"
+            )
     return "\n".join(lines) + "\n# EOF\n"
 
 

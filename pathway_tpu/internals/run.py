@@ -46,6 +46,27 @@ def run(
         from pathway_tpu.internals.monitoring_server import start_http_server
 
         start_http_server(sched)
+    # live TUI dashboard (reference pw.run(monitoring_level=...) rich TUI):
+    # AUTO shows it only on a real terminal; NONE never
+    show = monitoring_level in (MonitoringLevel.ALL, MonitoringLevel.IN_OUT)
+    if monitoring_level == MonitoringLevel.AUTO:
+        import sys
+
+        show = sys.stderr.isatty()
+    if show:
+        try:
+            from pathway_tpu.internals.monitoring import start_dashboard
+
+            start_dashboard(
+                sched,
+                level=(
+                    monitoring_level
+                    if monitoring_level != MonitoringLevel.AUTO
+                    else MonitoringLevel.ALL
+                ),
+            )
+        except ImportError:
+            pass  # rich unavailable: run silently
     if persistence_config is not None:
         from pathway_tpu.persistence import attach_persistence
 

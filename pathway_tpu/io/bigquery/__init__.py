@@ -43,9 +43,12 @@ class _BigQueryWriter(Writer):
                     "google-cloud-bigquery is not installed; pass client= "
                     "with an insert_rows_json-capable object"
                 ) from e
-            self._client = bigquery.Client.from_service_account_json(
-                self.credentials_file
-            )
+            if self.credentials_file:
+                self._client = bigquery.Client.from_service_account_json(
+                    self.credentials_file
+                )
+            else:  # application-default credentials
+                self._client = bigquery.Client()
         return self._client
 
     def write(self, row: dict[str, Any], time: int, diff: int) -> None:

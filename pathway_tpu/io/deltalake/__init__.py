@@ -63,6 +63,17 @@ def _list_versions(table_path: str) -> list[int]:
     return sorted(out)
 
 
+def _write_commit(path: str, actions: list[dict]) -> None:
+    """Atomic commit publication (tmp + rename): a concurrent reader
+    polling the log must never observe an empty or half-written file."""
+    tmp = f"{path}.tmp.{uuid.uuid4()}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(json.dumps(a) for a in actions))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def _delta_type(v: Any) -> str:
     if isinstance(v, bool):
         return "boolean"
@@ -133,8 +144,7 @@ class _DeltaWriter(Writer):
                 }
             },
         ]
-        with open(_log_path(self.table_path, 0), "w") as f:
-            f.write("\n".join(json.dumps(a) for a in actions))
+        _write_commit(_log_path(self.table_path, 0), actions)
         return 1
 
     def write(self, row: dict[str, Any], time: int, diff: int) -> None:
@@ -162,8 +172,7 @@ class _DeltaWriter(Writer):
                 "dataChange": True,
             }
         }
-        with open(_log_path(self.table_path, self._version), "w") as f:
-            f.write(json.dumps(add))
+        _write_commit(_log_path(self.table_path, self._version), [add])
         self._version += 1
         self._rows = []
 
@@ -245,8 +254,14 @@ class _DeltaSource(RowSource):
             for v in _list_versions(self.table_path):
                 if v <= done:
                     continue
-                if self._emit_version(events, v):
-                    emitted = True
+                try:
+                    if self._emit_version(events, v):
+                        emitted = True
+                except (json.JSONDecodeError, FileNotFoundError, OSError):
+                    # a foreign writer publishing non-atomically: do NOT
+                    # advance past the torn commit — retry next poll
+                    # (static mode consumes what exists and returns)
+                    break
                 done = v
             if emitted:
                 events.commit()

@@ -144,14 +144,16 @@ class _S3Source(RowSource):
 
     def _emit_object(
         self, events: Any, key: str, data: bytes, meta: dict
-    ) -> list[tuple]:
-        """Emit an object's rows; returns the emitted (row_key, row) pairs
-        so a later version of the object can retract them first."""
+    ) -> set:
+        """Emit an object's rows (upsert adds); returns the emitted row
+        KEYS so a later version can delete rows that vanished.  Only keys
+        are retained — the downstream upsert input session holds the old
+        values, so the reader never duplicates the dataset in memory."""
         pk = self.schema.primary_key_columns()
         parser = self.parser_factory(key)
         w, n = self._part
         seq = 0
-        emitted: list[tuple] = []
+        emitted: set = set()
         for raw in data.split(b"\n"):
             line = raw.decode(errors="replace")
             if not line.strip():
@@ -171,15 +173,14 @@ class _S3Source(RowSource):
                 row_key = ref_scalar("__s3__", self.tag, key, seq)
             if n > 1 and int(row_key) % n != w:
                 continue
-            row = coerce_row(values, self.schema)
-            events.add(row_key, row)
-            emitted.append((row_key, row))
+            events.add(row_key, coerce_row(values, self.schema))
+            emitted.add(row_key)
         return emitted
 
     def run(self, events: Any) -> None:
         client = self.settings.create_client()
         seen: dict[str, tuple] = {}  # object key -> (etag, size)
-        emitted: dict[str, list[tuple]] = {}  # object key -> emitted rows
+        emitted: dict[str, set] = {}  # object key -> emitted row keys
         while True:
             objects = self._list(client)
             fresh = [
@@ -205,15 +206,15 @@ class _S3Source(RowSource):
                         "modified_at": str(obj.get("LastModified", "")),
                         "size": obj.get("Size"),
                     }
-                    # an object VERSION replaces its predecessor: retract
-                    # the old version's rows before re-adding, or the
-                    # unchanged prefix would double-count under the same
-                    # autogen keys (reference retracts modified objects)
-                    for row_key, row in emitted.get(obj["Key"], ()):
-                        events.remove(row_key, row)
-                    emitted[obj["Key"]] = self._emit_object(
-                        events, obj["Key"], data, meta
-                    )
+                    # an object VERSION replaces its predecessor via the
+                    # upsert input session: re-added keys overwrite in
+                    # place (no-op when unchanged); keys of rows that
+                    # VANISHED in the new version are deleted by key
+                    # (reference retracts modified objects)
+                    new_keys = self._emit_object(events, obj["Key"], data, meta)
+                    for row_key in emitted.get(obj["Key"], set()) - new_keys:
+                        events.remove(row_key, ())
+                    emitted[obj["Key"]] = new_keys
                     seen[obj["Key"]] = (obj.get("ETag"), obj.get("Size"))
                 events.commit()
             if self.mode == "static":
@@ -303,4 +304,6 @@ def read(
         tag=f"s3:{settings.bucket_name}/{prefix}",
         object_cache=object_cache,
     )
-    return input_table(src, schema, name=name)
+    # upsert session: object re-reads overwrite by key (reference
+    # SessionType::Upsert for key-overwrite sources)
+    return input_table(src, schema, name=name, upsert=True)

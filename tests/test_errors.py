@@ -1,0 +1,83 @@
+"""Global error-log table + operator traces (reference
+``pw.global_error_log``, ``internals/parse_graph.py:183-202`` and
+``internals/trace.py`` / ``src/engine/error.rs``)."""
+
+import pathway_tpu as pw
+from tests.utils import T
+
+
+def test_failing_udf_lands_in_error_table_with_user_trace():
+    t = T(
+        """
+        a | b
+        1 | 0
+        2 | 1
+        """
+    )
+    err = pw.global_error_log()
+    r = t.select(x=pw.apply(lambda a, b: a // b, t.a, t.b))
+    cap_r = r._capture_node()
+    cap_e = err._capture_node()
+    ctx = pw.run()
+
+    rows_r = ctx.state(cap_r)["rows"]
+    vals = sorted(str(v[0]) for v in rows_r.values())
+    assert "Error" in vals[0] or vals[0] == "2"  # ERROR value + the good row
+
+    rows_e = ctx.state(cap_e)["rows"]
+    assert len(rows_e) == 1
+    message, operator, trace = next(iter(rows_e.values()))
+    assert "ZeroDivisionError" in message
+    # the trace points at THIS test file (the user's pw.apply call site)
+    assert "test_errors.py" in trace
+
+
+def test_operator_failure_lands_in_error_table():
+    t = T(
+        """
+        a
+        1
+        2
+        """
+    )
+    err = pw.global_error_log()
+
+    def bad_acceptor(new, old):
+        raise RuntimeError("acceptor exploded")
+
+    d = t.deduplicate(value=pw.this.a, acceptor=bad_acceptor)
+    cap_e = err._capture_node()
+    ctx = pw.run()
+    rows_e = ctx.state(cap_e)["rows"]
+    assert any("acceptor" in v[0] for v in rows_e.values())
+    # engine error_log strings carry the [at file:line] suffix
+    assert any("[at " in str(e) for e in ctx.error_log)
+    assert any("test_errors.py" in str(e) for e in ctx.error_log)
+
+
+def test_error_table_composes_like_any_table():
+    t = T(
+        """
+        a
+        0
+        """
+    )
+    err = pw.global_error_log()
+    only_div = err.filter(
+        pw.apply(lambda m: "ZeroDivisionError" in m, err.message)
+    )
+    t.select(x=pw.apply(lambda a: 1 // a, t.a))
+    cap = only_div._capture_node()
+    ctx = pw.run()
+    assert len(ctx.state(cap)["rows"]) == 1
+
+
+def test_every_node_records_creation_trace():
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    r = t.filter(t.a > 0)
+    assert "test_errors.py" in r._node.trace

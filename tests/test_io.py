@@ -295,9 +295,9 @@ def test_fs_line_longer_than_read_block(tmp_path, monkeypatch):
 
 
 def test_s3_modified_object_retracts_old_version():
-    """A changed object (new ETag/size) must retract the previous
-    version's rows before re-adding — otherwise the unchanged prefix
-    double-counts under the same autogen keys."""
+    """A changed object (new ETag/size) replaces its predecessor through
+    the upsert session: re-added keys overwrite in place, vanished keys
+    are deleted — the unchanged prefix never double-counts."""
     import threading
     import time
 
@@ -356,20 +356,19 @@ def test_s3_modified_object_retracts_old_version():
     while commits[0] < 1 and time.time() < deadline:
         time.sleep(0.02)
     assert len(adds) == 2 and not removes
-    # append a row -> new ETag/size: old version retracted, full re-add
+    # append a row -> new ETag/size: upsert re-add of the (unchanged)
+    # prefix under the same keys + the new row; nothing vanished
     client.objects["a.jsonl"] += b'{"v": 3}\n'
     while commits[0] < 2 and time.time() < deadline:
         time.sleep(0.02)
+    assert len(adds) == 5 and not removes  # 2 + (2 re-upserts + 1 new)
+    assert len({k for k, _ in adds}) == 3  # deterministic (object, seq) keys
+    # shrink the object -> the vanished tail row is deleted BY KEY
+    client.objects["a.jsonl"] = b'{"v": 1}\n'
+    while commits[0] < 3 and time.time() < deadline:
+        time.sleep(0.02)
     stop.set()
     th.join(timeout=5)
-    assert len(removes) == 2  # the first version's rows
-    assert len(adds) == 5  # 2 + 3
-    # net multiset: rows {1,2,3} exactly once each
-    net = {}
-    for key, row in adds:
-        net[key] = net.get(key, 0) + 1
-    for key, row in removes:
-        net[key] = net.get(key, 0) - 1
-    # keys are deterministic per (object, seq): rows 1,2 retract and
-    # re-add under the same keys, row 3 is new — every key nets to +1
-    assert sorted(net.values()) == [1, 1, 1]
+    assert len(removes) == 2  # rows 2 and 3's keys deleted
+    add_keys = {k for k, _ in adds}
+    assert all(k in add_keys for k, _ in removes)

@@ -495,3 +495,45 @@ def test_monitoring_http_server():
         metrics = r.read().decode()
     assert "pathway_tpu_operator_count" in metrics
     sched._monitoring_server.shutdown()
+
+
+def test_operator_probes_and_connector_counters():
+    """Per-operator latency/row probes + per-connector counters feed
+    ProberStats and the /metrics endpoint (reference attach_prober
+    graph.rs:988-995, connectors/monitoring.rs)."""
+    import json
+    import urllib.request
+
+    import pathway_tpu as pw
+    from pathway_tpu.engine.scheduler import Scheduler
+    from pathway_tpu.internals.monitoring import collect_stats
+    from pathway_tpu.internals.monitoring_server import _metrics_text
+    from pathway_tpu.internals.parse_graph import G
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(10):
+                self.next(a=i)
+            self.commit()
+
+    class S(pw.Schema):
+        a: int
+
+    t = pw.io.python.read(Src(), schema=S)
+    c = t.groupby(t.a).reduce(t.a, n=pw.reducers.count())
+    cap = c._capture_node()
+    sched = Scheduler(G.engine_graph, autocommit_ms=10)
+    G.active_scheduler = sched
+    sched.run()
+
+    stats = collect_stats(sched)
+    assert stats.input_rows == 10
+    (cstats,) = stats.connectors.values()
+    assert cstats["rows"] == 10 and cstats["commits"] >= 1 and cstats["closed"]
+    probes = stats.operator_probes
+    gb = next(p for p in probes.values() if p["name"].startswith("groupby"))
+    assert gb["rows_in"] == 10 and gb["total_ms"] >= 0.0 and gb["epochs"] >= 1
+
+    text = _metrics_text(sched)
+    assert "pathway_tpu_connector_rows_total" in text
+    assert 'pathway_tpu_operator_latency_ms_total{operator="groupby' in text
