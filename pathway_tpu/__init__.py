@@ -36,6 +36,14 @@ from pathway_tpu.internals.config import set_license_key, set_monitoring_config
 from pathway_tpu.internals.json import Json
 from pathway_tpu.internals.keys import Pointer
 from pathway_tpu.internals.parse_graph import G, global_error_log
+from pathway_tpu.internals.row_transformer import (
+    ClassArg,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 from pathway_tpu.internals.run import MonitoringLevel, run, run_all
 from pathway_tpu.internals.schema import (
     Schema,
@@ -187,6 +195,12 @@ __all__ = [
     "run",
     "run_all",
     "global_error_log",
+    "ClassArg",
+    "input_attribute",
+    "input_method",
+    "method",
+    "output_attribute",
+    "transformer",
     "MonitoringLevel",
     "debug",
     "reducers",

@@ -1076,12 +1076,20 @@ class AsyncMapNode(Node):
         input: Node,
         batch_fn: Callable[[list[tuple]], list[Any]],
         name: str = "async_map",
+        distributed: bool = False,
     ):
         super().__init__(graph, [input], name)
         self.batch_fn = batch_fn
+        #: False (default): all rows route to worker 0 — REQUIRED for
+        #: device-batched UDFs (one TPU host executes one big batch;
+        #: sharding would split it into per-worker fragments on workers
+        #: without the device).  True: shard rows by key — right for
+        #: IO-bound async UDFs (API calls), whose concurrency scales with
+        #: workers instead of funneling through one.
+        self.distributed = distributed
 
     def exchange_routes(self):
-        return [cl.route_to_zero]
+        return [cl.route_by_key if self.distributed else cl.route_to_zero]
 
     def make_state(self):
         return {"cache": {}}  # key -> result

@@ -66,3 +66,7 @@ def set_license_key(key: str | None) -> None:
 
 def set_monitoring_config(*, server_endpoint: str | None = None, **kwargs: Any) -> None:
     pathway_config.monitoring_endpoint = server_endpoint  # type: ignore[attr-defined]
+    # the endpoint also drives the OTLP span/metric exporter
+    from pathway_tpu.internals import telemetry
+
+    telemetry.set_monitoring_config(server_endpoint=server_endpoint)

@@ -72,23 +72,33 @@ def run(
 
         attach_persistence(sched, persistence_config)
     G.active_scheduler = sched  # handle for stopping threaded servers
-    if threads * processes > 1:
-        # multi-worker topology from the spawn env contract
-        # (PATHWAY_THREADS × PATHWAY_PROCESSES, reference config.rs:86-120)
-        from pathway_tpu.engine.cluster import Cluster
+    from pathway_tpu.internals.telemetry import get_telemetry
 
-        cluster = Cluster(
-            threads=threads,
-            processes=processes,
-            process_id=pc.process_id,
-            first_port=pc.first_port,
-        )
-        try:
-            ctx = sched.run_cluster(cluster)
-        finally:
-            cluster.close()
-    else:
-        ctx = sched.run()
+    telemetry = get_telemetry()
+    with telemetry.span(
+        "graph_runner.run", operators=len(G.engine_graph.nodes)
+    ):
+        if threads * processes > 1:
+            # multi-worker topology from the spawn env contract
+            # (PATHWAY_THREADS × PATHWAY_PROCESSES, reference config.rs:86-120)
+            from pathway_tpu.engine.cluster import Cluster
+
+            cluster = Cluster(
+                threads=threads,
+                processes=processes,
+                process_id=pc.process_id,
+                first_port=pc.first_port,
+            )
+            try:
+                ctx = sched.run_cluster(cluster)
+            finally:
+                cluster.close()
+        else:
+            ctx = sched.run()
+    telemetry.record_process_metrics()
+    telemetry.gauge("run.epoch", ctx.time)
+    telemetry.gauge("run.errors", len(ctx.error_log))
+    telemetry.export_metrics()
     G.last_run_ctx = ctx
     return ctx
 

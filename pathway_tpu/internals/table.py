@@ -138,7 +138,11 @@ class Table:
         for c in column_names:
             self._dtypes.setdefault(c, dt.ANY)
         self._name = name
-        self._layout_token = layout_token if layout_token is not None else object()
+        from pathway_tpu.internals.universe_solver import UniverseToken
+
+        self._layout_token = (
+            layout_token if layout_token is not None else UniverseToken()
+        )
         self._id_dtype = id_dtype
         #: node ids sharing this table's (universe, column layout) — a
         #: reference to any of them resolves positionally on this table
@@ -392,7 +396,15 @@ class Table:
             lambda key, values: values + (key,),
             name="attach_key",
         )
-        anode = eg.AsyncMapNode(G.engine_graph, key_node, batch_fn, name="async_select")
+        anode = eg.AsyncMapNode(
+            G.engine_graph,
+            key_node,
+            batch_fn,
+            name="async_select",
+            # device-batched UDFs need the whole epoch batch on the TPU
+            # host (worker 0); pure async-IO UDFs shard across workers
+            distributed=not any(plan[4] for plan in async_plans),
+        )
         # AsyncMapNode emits values + (result,); extract the result tuple
         unpack = eg.RowwiseNode(
             G.engine_graph,
@@ -654,6 +666,25 @@ class Table:
         )
 
     def with_universe_of(self, other: "Table") -> "Table":
+        from pathway_tpu.internals.universe_solver import solver
+
+        # reference semantics: with_universe_of REQUIRES a provable key-set
+        # relation.  Rebinding with NO declared relation is a correctness
+        # smell (zips may silently drop/misalign rows) — warn, then record
+        # the equality claim so later rebinding of the same pair is known.
+        if (
+            self._layout_token is not other._layout_token
+            and not solver.query_related(self._layout_token, other._layout_token)
+        ):
+            from pathway_tpu.internals.parse_graph import logger
+
+            logger.debug(
+                "with_universe_of: no declared key-set relation between "
+                "%r and %r (use pw.universes.promise_* to declare one)",
+                self._name,
+                other._name,
+            )
+        solver.register_as_equal(other._layout_token, self._layout_token)
         out = self.copy()
         out._layout_token = other._layout_token
         return out

@@ -1,7 +1,20 @@
 """``pw.ml`` (reference ``python/pathway/stdlib/ml/``): legacy KNNIndex,
-classifiers, HMM, smart-table fuzzy join."""
+classifiers (incl. real LSH banding), HMM, smart-table fuzzy join."""
 
-from pathway_tpu.stdlib.ml.index import KNNIndex
 from pathway_tpu.stdlib.ml import classifiers, hmm, smart_table_ops
+from pathway_tpu.stdlib.ml.classifiers import (
+    LshBandingIndex,
+    generate_cosine_lsh_bucketer,
+    generate_euclidean_lsh_bucketer,
+)
+from pathway_tpu.stdlib.ml.index import KNNIndex
 
-__all__ = ["KNNIndex", "classifiers", "hmm", "smart_table_ops"]
+__all__ = [
+    "KNNIndex",
+    "LshBandingIndex",
+    "classifiers",
+    "generate_cosine_lsh_bucketer",
+    "generate_euclidean_lsh_bucketer",
+    "hmm",
+    "smart_table_ops",
+]

@@ -22,7 +22,6 @@ def pandas_transformer(
         def transformer(*tables: Table) -> Table:
             import pandas as pd
 
-            first = tables[0]
             cols_list = [t._column_names for t in tables]
 
             def run_batch(*col_lists) -> list:
@@ -42,22 +41,45 @@ def pandas_transformer(
                     for _, row in out_df.reset_index(drop=True).iterrows()
                 ]
 
-            if len(tables) != 1:
-                raise NotImplementedError(
-                    "pandas_transformer currently supports one input table"
+            # pack EVERY input table into one row of tuples, cross-join the
+            # packs, and rebuild the DataFrames inside one apply
+            packs = [
+                t.reduce(
+                    _pw_rows=pw.reducers.tuple(
+                        pw.apply(
+                            lambda *vs: tuple(vs), *[t[c] for c in t._column_names]
+                        )
+                    )
                 )
-            t = first
-            res = t.reduce(
-                _pw_rows=pw.reducers.tuple(
-                    pw.apply(lambda *vs: tuple(vs), *[t[c] for c in t._column_names])
-                )
-            )
+                for t in tables
+            ]
 
-            def expand(rows_tuple):
-                col_lists = list(zip(*rows_tuple)) if rows_tuple else [[] for _ in t._column_names]
+            def expand(*row_tuples):
+                col_lists: list = []
+                for t_cols, rows_tuple in zip(cols_list, row_tuples):
+                    if rows_tuple:
+                        col_lists.extend(list(zip(*rows_tuple)))
+                    else:
+                        col_lists.extend([[] for _ in t_cols])
                 return run_batch(*col_lists)
 
-            flat_src = res.select(_pw_out=pw.apply(expand, res["_pw_rows"]))
+            joined = packs[0].select(_pw_rows0=pw.this._pw_rows)
+            for i, p in enumerate(packs[1:], start=1):
+                # join_left: an EMPTY later table contributes an empty
+                # DataFrame instead of wiping the whole output
+                joined = joined.join_left(p).select(
+                    **{
+                        f"_pw_rows{j}": getattr(pw.left, f"_pw_rows{j}")
+                        for j in range(i)
+                    },
+                    **{f"_pw_rows{i}": pw.right._pw_rows},
+                )
+            flat_src = joined.select(
+                _pw_out=pw.apply(
+                    expand,
+                    *[joined[f"_pw_rows{j}"] for j in range(len(packs))],
+                )
+            )
             flat = flat_src.flatten(flat_src["_pw_out"])
             out_cols = output_schema.column_names()
             return flat.select(

@@ -19,7 +19,12 @@ __all__ = [
 
 def promise_is_subset_of(subset: Table, superset: Table) -> Table:
     """Declare subset's keys ⊆ superset's keys; returns ``subset`` bound to
-    superset's universe (enables cross-table column use in select)."""
+    superset's universe (enables cross-table column use in select).  The
+    relation is also registered with the universe solver (reference
+    ``universe_solver.py``) so later operations can query it."""
+    from pathway_tpu.internals.universe_solver import solver
+
+    solver.register_as_subset(subset._layout_token, superset._layout_token)
     out = subset.copy()
     out._layout_token = superset._layout_token
     return out
@@ -27,10 +32,13 @@ def promise_is_subset_of(subset: Table, superset: Table) -> Table:
 
 def promise_are_equal(*tables: Table) -> None:
     """Declare all tables share the same key set."""
+    from pathway_tpu.internals.universe_solver import solver
+
     if not tables:
         return
     token = tables[0]._layout_token
     for t in tables[1:]:
+        solver.register_as_equal(token, t._layout_token)
         t._layout_token = token
 
 

@@ -1,0 +1,288 @@
+"""Row transformers, universe solver, LSH banding, SharePoint connector
+(reference: internals/row_transformer.py + decorators.py,
+internals/universe_solver.py, stdlib/ml/classifiers/_knn_lsh.py,
+xpacks/connectors/sharepoint)."""
+
+import numpy as np
+
+import pathway_tpu as pw
+from pathway_tpu.internals.keys import ref_scalar
+
+
+def _chain_table(n=4):
+    """a -> b -> c -> d linked list as a 1-column table of next-pointers."""
+    import pathway_tpu.engine.graph as eg
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.table import Table
+
+    keys = [ref_scalar("n", i) for i in range(n)]
+    rows = [(keys[i], (keys[i + 1],)) for i in range(n - 1)] + [(keys[-1], (None,))]
+    node = eg.InputNode(G.engine_graph, n_cols=1, static_rows=rows, name="nodes")
+    return Table(node, ["next"], name="nodes"), keys
+
+
+def test_row_transformer_linked_list():
+    """The reference's canonical linked-list example: output attribute
+    computed by a recursive pointer walk + a callable method column."""
+
+    @pw.transformer
+    class linked_list_transformer:
+        class linked_list(pw.ClassArg):
+            next = pw.input_attribute()
+
+            @pw.output_attribute
+            def len(self):
+                if self.next is None:
+                    return 1
+                return 1 + self.transformer.linked_list[self.next].len
+
+            @pw.method
+            def forward(self, steps):
+                if steps == 0:
+                    return self.id
+                if self.next is not None:
+                    return self.transformer.linked_list[self.next].forward(steps - 1)
+                return None
+
+    t, keys = _chain_table(4)
+    res = linked_list_transformer(linked_list=t).linked_list
+    cap = res._capture_node()
+    ctx = pw.run()
+    rows = ctx.state(cap)["rows"]
+    assert sorted(v[0] for v in rows.values()) == [1, 2, 3, 4]
+    assert rows[keys[0]][1](2) == keys[2]
+    assert rows[keys[0]][1](5) is None
+
+
+def test_row_transformer_two_tables():
+    """Cross-table pointer dereference between two ClassArgs."""
+    import pathway_tpu.engine.graph as eg
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.table import Table
+
+    pkeys = [ref_scalar("p", i) for i in range(2)]
+    prices = Table(
+        eg.InputNode(
+            G.engine_graph,
+            n_cols=1,
+            static_rows=[(pkeys[0], (10.0,)), (pkeys[1], (20.0,))],
+            name="prices",
+        ),
+        ["price"],
+    )
+    orders = Table(
+        eg.InputNode(
+            G.engine_graph,
+            n_cols=2,
+            static_rows=[
+                (ref_scalar("o", 0), (pkeys[0], 3)),
+                (ref_scalar("o", 1), (pkeys[1], 2)),
+            ],
+            name="orders",
+        ),
+        ["product", "qty"],
+    )
+
+    @pw.transformer
+    class pricing:
+        class products(pw.ClassArg):
+            price = pw.input_attribute()
+
+            @pw.output_attribute
+            def doubled(self):
+                return self.price * 2
+
+        class orders(pw.ClassArg):
+            product = pw.input_attribute()
+            qty = pw.input_attribute()
+
+            @pw.output_attribute
+            def total(self):
+                return self.transformer.products[self.product].price * self.qty
+
+    res = pricing(products=prices, orders=orders)
+    cap = res.orders._capture_node()
+    ctx = pw.run()
+    rows = ctx.state(cap)["rows"]
+    assert sorted(v[0] for v in rows.values()) == [30.0, 40.0]
+
+
+def test_universe_solver_relations():
+    from pathway_tpu.internals.universe_solver import UniverseSolver, UniverseToken
+
+    s = UniverseSolver()
+    a, b, c, d = (UniverseToken() for _ in range(4))
+    s.register_as_subset(a, b)
+    s.register_as_subset(b, c)
+    assert s.query_is_subset_of(a, a)  # reflexive
+    assert s.query_is_subset_of(a, b)
+    assert s.query_is_subset_of(a, c)  # transitive
+    assert not s.query_is_subset_of(c, a)
+    s.register_as_equal(c, d)
+    assert s.query_are_equal(c, d)
+    assert s.query_is_subset_of(a, d)  # through the equivalence
+
+
+def test_promises_register_with_solver():
+    from pathway_tpu.internals.universe_solver import solver
+    from tests.utils import T
+
+    big = T(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    small = big.filter(big.a > 1)
+    tok_small = small._layout_token
+    bound = pw.universes.promise_is_subset_of(small, big)
+    assert solver.query_is_subset_of(tok_small, big._layout_token)
+    # the returned table is usable in big's universe
+    joined = big.select(a=big.a)
+    assert bound._layout_token is big._layout_token
+
+
+def test_lsh_banding_recall_and_removal():
+    from pathway_tpu.stdlib.ml import LshBandingIndex
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(8, 16)).astype(np.float64) * 5
+    x = np.concatenate([c + 0.05 * rng.normal(size=(50, 16)) for c in centers])
+    idx = LshBandingIndex(16, L=16, M=6, A=4.0, metric="euclidean")
+    for i, v in enumerate(x):
+        idx.add(i, v)
+    assert len(idx) == 400
+
+    # self-query: the point itself must be its own nearest neighbour
+    hits = 0
+    for i in range(0, 400, 25):
+        res = idx.query(x[i], 3)
+        if res and res[0][0] == i:
+            hits += 1
+    assert hits >= 14  # >= 87% self-recall on clustered data
+
+    # candidates are a strict subset (banding actually prunes)
+    cand = idx.candidates(x[0])
+    assert 0 < len(cand) < 400
+
+    idx.remove(0)
+    assert all(key != 0 for key, _ in idx.query(x[0], 3))
+
+    # cosine variant
+    c = LshBandingIndex(16, L=12, M=8, metric="cosine")
+    for i, v in enumerate(x[:100]):
+        c.add(i, v)
+    res = c.query(x[5], 1)
+    assert res and res[0][0] == 5
+
+
+def test_sharepoint_fake_connection():
+    from pathway_tpu.xpacks.connectors.sharepoint import FileEntry
+    from pathway_tpu.xpacks.connectors import sharepoint
+
+    class FakeConn:
+        def __init__(self):
+            self.files = {
+                "/sites/x/a.txt": (b"alpha", 100),
+                "/sites/x/b.pdf": (b"%PDF beta", 200),
+                "/sites/x/huge.bin": (b"X" * 1000, 300),
+            }
+
+        def list_files(self, root_path):
+            return [
+                FileEntry(path=p, size=len(d), created_at=t, modified_at=t)
+                for p, (d, t) in sorted(self.files.items())
+            ]
+
+        def download(self, path):
+            return self.files[path][0]
+
+    t = sharepoint.read(
+        connection=FakeConn(),
+        root_path="/sites/x",
+        mode="static",
+        object_size_limit=100,
+        with_metadata=True,
+    )
+    keys, cols = pw.debug.table_to_dicts(t)
+    datas = {cols["_metadata"][k]["path"]: cols["data"][k] for k in keys}
+    assert datas["/sites/x/a.txt"] == b"alpha"
+    # oversized file: explicit status, empty payload
+    assert datas["/sites/x/huge.bin"] == b""
+    statuses = {
+        cols["_metadata"][k]["path"]: cols["_metadata"][k]["status"] for k in keys
+    }
+    assert statuses["/sites/x/huge.bin"] == "size_limit_exceeded"
+    assert statuses["/sites/x/a.txt"] == "downloaded"
+
+
+def test_telemetry_spans_and_otlp_export():
+    """Spans/metrics record in-process and export OTLP/HTTP JSON to a
+    configured endpoint (reference src/engine/telemetry.rs)."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    from pathway_tpu.internals.telemetry import Telemetry
+
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, json.loads(body)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        tel = Telemetry(endpoint=f"http://127.0.0.1:{srv.server_port}")
+        with tel.span("graph_runner.run", operators=3):
+            pass
+        tel.gauge("run.epoch", 4)
+        tel.record_process_metrics()
+        tel.export_metrics()
+        assert tel.spans[0]["name"] == "graph_runner.run"
+        assert tel.gauges["run.epoch"] == 4.0
+        assert "process.memory.rss_kb" in tel.gauges
+        paths = [p for p, _ in received]
+        assert "/v1/traces" in paths and "/v1/metrics" in paths
+        trace_payload = next(b for p, b in received if p == "/v1/traces")
+        span = trace_payload["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+        assert span["name"] == "graph_runner.run"
+    finally:
+        srv.shutdown()
+
+
+def test_fuzzy_match_weighting_and_by_hand():
+    from pathway_tpu.stdlib.ml.smart_table_ops import (
+        FuzzyJoinNormalization,
+        fuzzy_match_tables,
+    )
+    from tests.utils import T
+
+    left = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("alpha beta common",), ("gamma delta common",)],
+    )
+    right = pw.debug.table_from_rows(
+        pw.schema_from_types(name=str),
+        [("alpha beta common",), ("delta gamma common",)],
+    )
+    res = fuzzy_match_tables(left, right)
+    keys, cols = pw.debug.table_to_dicts(res)
+    assert len(keys) == 2  # both rows matched 1:1
+    assert all(w > 0 for w in cols["weight"].values())
+
+    # rare features outweigh the ubiquitous "common" token
+    res2 = fuzzy_match_tables(
+        left, right, normalization=FuzzyJoinNormalization.WEIGHT
+    )
+    _, cols2 = pw.debug.table_to_dicts(res2)
+    assert all(w > 0 for w in cols2["weight"].values())
