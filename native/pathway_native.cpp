@@ -11,6 +11,18 @@
 //     BLAKE2b-128) — keys are stable across the two paths, which
 //     persistence snapshots rely on.
 //   - scan_lines(bytes): newline scanning for the file data loader.
+//   - consolidate(batch, update_cls, hashable_row): merge update deltas
+//     with equal (key, row) — the per-node compaction the reference runs
+//     inside differential arrangements (src/engine/dataflow.rs
+//     consolidation); single-occurrence updates are re-emitted by
+//     reference (no allocation).
+//   - per_key_changes(batch): group a batch into per-key (removals,
+//     additions) lists.
+//   - coerce_rows(rows, plan): bulk schema coercion of parsed row dicts
+//     into value tuples (reference parser hot loop,
+//     src/connectors/data_format.rs DsvParser/JsonLinesParser).
+//   - build_adds(rows, update_cls): bulk Update(key, values, +1)
+//     construction for chunked connector ingest.
 //
 // Unsupported value types (big ints, ndarrays, datetimes, arbitrary
 // objects) raise _Unsupported so the caller transparently falls back to
@@ -19,6 +31,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -213,6 +226,928 @@ PyObject* py_scan_lines(PyObject*, PyObject* arg) {
     return out;
 }
 
+// --------------------------------------------------------------------------
+// update-stream batch ops
+
+// Update is a Python NamedTuple (engine/stream.py); instances are plain
+// tuple subclass objects, so tuple's own tp_new builds them without going
+// through the Python-level __new__ (same trick as namedtuple._make).
+PyObject* make_update(PyObject* cls, PyObject* key, PyObject* values,
+                      long long diff) {
+    PyObject* d = PyLong_FromLongLong(diff);
+    if (d == nullptr) return nullptr;
+    PyObject* inner = PyTuple_Pack(3, key, values, d);
+    Py_DECREF(d);
+    if (inner == nullptr) return nullptr;
+    PyObject* args = PyTuple_Pack(1, inner);
+    Py_DECREF(inner);
+    if (args == nullptr) return nullptr;
+    PyObject* u = PyTuple_Type.tp_new(reinterpret_cast<PyTypeObject*>(cls),
+                                      args, nullptr);
+    Py_DECREF(args);
+    return u;
+}
+
+struct ConsEntry {
+    PyObject* first;   // borrowed from seq until output
+    PyObject* key;     // borrowed
+    PyObject* values;  // borrowed
+    long long diff;
+    bool merged;
+};
+
+PyObject* py_consolidate(PyObject*, PyObject* args) {
+    PyObject *batch, *update_cls, *hashable_row;
+    if (!PyArg_ParseTuple(args, "OOO", &batch, &update_cls, &hashable_row))
+        return nullptr;
+    PyObject* seq = PySequence_Fast(batch, "consolidate expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* acc = PyDict_New();  // (key, row) -> index into entries
+    if (acc == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    std::vector<ConsEntry> entries;
+    entries.reserve((size_t)n);
+    bool fail = false;
+    for (Py_ssize_t i = 0; i < n && !fail; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            fail = true;
+            break;
+        }
+        PyObject* key = PyTuple_GET_ITEM(u, 0);
+        PyObject* values = PyTuple_GET_ITEM(u, 1);
+        long long diff = PyLong_AsLongLong(PyTuple_GET_ITEM(u, 2));
+        if (diff == -1 && PyErr_Occurred()) {
+            fail = true;
+            break;
+        }
+        PyObject* k2 = PyTuple_Pack(2, key, values);
+        if (k2 == nullptr) {
+            fail = true;
+            break;
+        }
+        PyObject* found = PyDict_GetItemWithError(acc, k2);
+        if (found == nullptr && PyErr_Occurred()) {
+            if (!PyErr_ExceptionMatches(PyExc_TypeError)) {
+                Py_DECREF(k2);
+                fail = true;
+                break;
+            }
+            // unhashable cell (ndarray/dict/list): type-tagged fallback key
+            PyErr_Clear();
+            Py_DECREF(k2);
+            PyObject* tagged = PyObject_CallFunctionObjArgs(
+                hashable_row, values, nullptr);
+            if (tagged == nullptr) {
+                fail = true;
+                break;
+            }
+            k2 = PyTuple_Pack(2, key, tagged);
+            Py_DECREF(tagged);
+            if (k2 == nullptr) {
+                fail = true;
+                break;
+            }
+            found = PyDict_GetItemWithError(acc, k2);
+            if (found == nullptr && PyErr_Occurred()) {
+                Py_DECREF(k2);
+                fail = true;
+                break;
+            }
+        }
+        if (found != nullptr) {
+            size_t idx = (size_t)PyLong_AsSsize_t(found);
+            entries[idx].diff += diff;
+            entries[idx].merged = true;
+            Py_DECREF(k2);
+        } else {
+            PyObject* idx = PyLong_FromSsize_t((Py_ssize_t)entries.size());
+            if (idx == nullptr || PyDict_SetItem(acc, k2, idx) < 0) {
+                Py_XDECREF(idx);
+                Py_DECREF(k2);
+                fail = true;
+                break;
+            }
+            Py_DECREF(idx);
+            Py_DECREF(k2);
+            entries.push_back({u, key, values, diff, false});
+        }
+    }
+    Py_DECREF(acc);
+    if (fail) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    PyObject* out = PyList_New(0);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    for (const ConsEntry& e : entries) {
+        if (e.diff == 0) continue;
+        PyObject* u;
+        if (!e.merged) {
+            u = e.first;  // unchanged: re-emit the input object
+            Py_INCREF(u);
+        } else {
+            u = make_update(update_cls, e.key, e.values, e.diff);
+            if (u == nullptr) {
+                Py_DECREF(out);
+                Py_DECREF(seq);
+                return nullptr;
+            }
+        }
+        if (PyList_Append(out, u) < 0) {
+            Py_DECREF(u);
+            Py_DECREF(out);
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        Py_DECREF(u);
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
+PyObject* py_per_key_changes(PyObject*, PyObject* batch) {
+    PyObject* seq = PySequence_Fast(batch, "per_key_changes expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyDict_New();
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            goto fail;
+        }
+        {
+            PyObject* key = PyTuple_GET_ITEM(u, 0);
+            PyObject* values = PyTuple_GET_ITEM(u, 1);
+            long long diff = PyLong_AsLongLong(PyTuple_GET_ITEM(u, 2));
+            if (diff == -1 && PyErr_Occurred()) goto fail;
+            PyObject* pair = PyDict_GetItemWithError(out, key);
+            if (pair == nullptr) {
+                if (PyErr_Occurred()) goto fail;
+                PyObject* rem = PyList_New(0);
+                PyObject* add = PyList_New(0);
+                if (rem == nullptr || add == nullptr) {
+                    Py_XDECREF(rem);
+                    Py_XDECREF(add);
+                    goto fail;
+                }
+                pair = PyTuple_Pack(2, rem, add);
+                Py_DECREF(rem);
+                Py_DECREF(add);
+                if (pair == nullptr || PyDict_SetItem(out, key, pair) < 0) {
+                    Py_XDECREF(pair);
+                    goto fail;
+                }
+                Py_DECREF(pair);  // dict holds it; borrow below
+                pair = PyDict_GetItemWithError(out, key);
+                if (pair == nullptr) goto fail;
+            }
+            PyObject* lst = PyTuple_GET_ITEM(pair, diff < 0 ? 0 : 1);
+            long long reps = diff < 0 ? -diff : diff;
+            for (long long r = 0; r < reps; r++) {
+                if (PyList_Append(lst, values) < 0) goto fail;
+            }
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(seq);
+    Py_DECREF(out);
+    return nullptr;
+}
+
+PyObject* py_build_adds(PyObject*, PyObject* args) {
+    PyObject *rows, *update_cls;
+    if (!PyArg_ParseTuple(args, "OO", &rows, &update_cls)) return nullptr;
+    PyObject* seq = PySequence_Fast(rows, "build_adds expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(n);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* kv = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject *key, *values;
+        if (PyTuple_Check(kv) && PyTuple_GET_SIZE(kv) == 2) {
+            key = PyTuple_GET_ITEM(kv, 0);
+            values = PyTuple_GET_ITEM(kv, 1);
+        } else {
+            PyErr_SetString(PyExc_TypeError, "rows must be (key, values) pairs");
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyObject* u = make_update(update_cls, key, values, 1);
+        if (u == nullptr) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, i, u);
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
+PyObject* py_all_positive(PyObject*, PyObject* batch) {
+    // True iff every update's diff > 0 (append-only batch check)
+    PyObject* seq = PySequence_Fast(batch, "all_positive expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            return nullptr;
+        }
+        long long diff = PyLong_AsLongLong(PyTuple_GET_ITEM(u, 2));
+        if (diff == -1 && PyErr_Occurred()) {
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        if (diff <= 0) {
+            Py_DECREF(seq);
+            Py_RETURN_FALSE;
+        }
+    }
+    Py_DECREF(seq);
+    Py_RETURN_TRUE;
+}
+
+PyObject* py_all_dicts(PyObject*, PyObject* obj) {
+    PyObject* seq = PySequence_Fast(obj, "all_dicts expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (!PyDict_Check(PySequence_Fast_GET_ITEM(seq, i))) {
+            Py_DECREF(seq);
+            Py_RETURN_FALSE;
+        }
+    }
+    Py_DECREF(seq);
+    Py_RETURN_TRUE;
+}
+
+PyObject* py_rowwise_map(PyObject*, PyObject* args) {
+    // rowwise_map(batch, fn, update_cls, error_obj, on_error) -> list
+    // C loop of the expression_table hot path: vals = fn(key, values);
+    // a raising row becomes (ERROR,) after on_error(exc).
+    PyObject *batch, *fn, *update_cls, *error_obj, *on_error;
+    if (!PyArg_ParseTuple(args, "OOOOO", &batch, &fn, &update_cls, &error_obj,
+                          &on_error))
+        return nullptr;
+    PyObject* seq = PySequence_Fast(batch, "rowwise_map expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(n);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            goto fail;
+        }
+        {
+            PyObject* key = PyTuple_GET_ITEM(u, 0);
+            PyObject* values = PyTuple_GET_ITEM(u, 1);
+            PyObject* diff = PyTuple_GET_ITEM(u, 2);
+            PyObject* vals =
+                PyObject_CallFunctionObjArgs(fn, key, values, nullptr);
+            if (vals == nullptr) {
+                // row-level containment (Exception only, like the Python
+                // `except Exception`): report and emit an ERROR row
+                if (!PyErr_ExceptionMatches(PyExc_Exception)) goto fail;
+                PyObject *etype, *evalue, *etb;
+                PyErr_Fetch(&etype, &evalue, &etb);
+                PyErr_NormalizeException(&etype, &evalue, &etb);
+                PyObject* r = PyObject_CallFunctionObjArgs(
+                    on_error, evalue ? evalue : Py_None, nullptr);
+                Py_XDECREF(etype);
+                Py_XDECREF(evalue);
+                Py_XDECREF(etb);
+                if (r == nullptr) goto fail;
+                Py_DECREF(r);
+                vals = PyTuple_Pack(1, error_obj);
+                if (vals == nullptr) goto fail;
+            }
+            PyObject* inner = PyTuple_Pack(3, key, vals, diff);
+            Py_DECREF(vals);
+            if (inner == nullptr) goto fail;
+            PyObject* wrap = PyTuple_Pack(1, inner);
+            Py_DECREF(inner);
+            if (wrap == nullptr) goto fail;
+            PyObject* nu = PyTuple_Type.tp_new(
+                reinterpret_cast<PyTypeObject*>(update_cls), wrap, nullptr);
+            Py_DECREF(wrap);
+            if (nu == nullptr) goto fail;
+            PyList_SET_ITEM(out, i, nu);
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(seq);
+    Py_DECREF(out);
+    return nullptr;
+}
+
+PyObject* py_filter_batch(PyObject*, PyObject* args) {
+    // filter_batch(batch, pred, error_obj) -> list re-emitting the PASSING
+    // input update objects unchanged (no allocation per surviving row).
+    // Drop semantics mirror FilterNode: raising rows, None, and ERROR all
+    // drop; anything else keeps by truthiness.
+    PyObject *batch, *pred, *error_obj;
+    if (!PyArg_ParseTuple(args, "OOO", &batch, &pred, &error_obj))
+        return nullptr;
+    PyObject* seq = PySequence_Fast(batch, "filter_batch expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(0);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            goto fail;
+        }
+        {
+            PyObject* r = PyObject_CallFunctionObjArgs(
+                pred, PyTuple_GET_ITEM(u, 0), PyTuple_GET_ITEM(u, 1),
+                nullptr);
+            if (r == nullptr) {
+                if (!PyErr_ExceptionMatches(PyExc_Exception)) goto fail;
+                PyErr_Clear();
+                continue;  // raising predicate: drop the row
+            }
+            if (r == Py_None || r == error_obj) {
+                Py_DECREF(r);
+                continue;
+            }
+            int truthy = PyObject_IsTrue(r);
+            Py_DECREF(r);
+            // a raising truthiness test propagates (python parity: only
+            // the predicate CALL is containable, bool(keep) is not)
+            if (truthy < 0) goto fail;
+            if (truthy && PyList_Append(out, u) < 0) goto fail;
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(seq);
+    Py_DECREF(out);
+    return nullptr;
+}
+
+// --------------------------------------------------------------------------
+// groupby partial aggregation
+//
+// groupby_partials(batch, group_idx, red_specs, error_obj, hashable_fn)
+// reduces an update batch into per-group PARTIAL aggregates in one C pass
+// — the role of the reference's reduce arrangement inner loop
+// (src/engine/reduce.rs SemigroupReducerImpl).  Python merges one partial
+// per (dirty group, reducer) into the persistent accumulators, so the
+// per-row interpreter work (group_fn, arg_fn, reducer.update) disappears.
+//
+// red_specs: tuple of (code, idx_tuple); idx >= 0 -> values[idx],
+// idx == -1 -> row key.  Codes: 0 = count (partial: int), 1 = sum-like
+// (partial: (total|None, n_contributions)), 2 = multiset (partial:
+// {hashable_args: (delta, args)}).
+
+struct MsItem {
+    long long delta;
+    PyObject* args;  // owned
+    PyObject* h;     // owned
+};
+
+struct GPart {
+    PyObject* total = nullptr;  // owned (sum-like)
+    long long cnt = 0;
+    PyObject* msdict = nullptr;  // owned: h -> PyLong index (multiset)
+    std::vector<MsItem> msitems;
+};
+
+struct GEntry {
+    long long count = 0;
+    std::vector<GPart> parts;
+};
+
+void free_gentries(std::vector<GEntry>& entries) {
+    for (GEntry& e : entries) {
+        for (GPart& p : e.parts) {
+            Py_XDECREF(p.total);
+            Py_XDECREF(p.msdict);
+            for (MsItem& it : p.msitems) {
+                Py_XDECREF(it.args);
+                Py_XDECREF(it.h);
+            }
+        }
+    }
+    entries.clear();
+}
+
+PyObject* py_groupby_partials(PyObject*, PyObject* args) {
+    PyObject *batch, *group_idx, *red_specs, *error_obj, *hashable_fn;
+    if (!PyArg_ParseTuple(args, "OOOOO", &batch, &group_idx, &red_specs,
+                          &error_obj, &hashable_fn))
+        return nullptr;
+
+    // unpack specs
+    if (!PyTuple_Check(group_idx) || !PyTuple_Check(red_specs)) {
+        PyErr_SetString(PyExc_TypeError, "group_idx/red_specs must be tuples");
+        return nullptr;
+    }
+    Py_ssize_t ngroup = PyTuple_GET_SIZE(group_idx);
+    std::vector<Py_ssize_t> gidx((size_t)ngroup);
+    for (Py_ssize_t i = 0; i < ngroup; i++) {
+        gidx[(size_t)i] = PyLong_AsSsize_t(PyTuple_GET_ITEM(group_idx, i));
+        if (gidx[(size_t)i] == -1 && PyErr_Occurred()) return nullptr;
+    }
+    Py_ssize_t nred = PyTuple_GET_SIZE(red_specs);
+    std::vector<int> rcodes((size_t)nred);
+    std::vector<std::vector<Py_ssize_t>> ridx((size_t)nred);
+    for (Py_ssize_t r = 0; r < nred; r++) {
+        PyObject* spec = PyTuple_GET_ITEM(red_specs, r);
+        if (!PyTuple_Check(spec) || PyTuple_GET_SIZE(spec) != 2) {
+            PyErr_SetString(PyExc_TypeError, "red_specs items must be pairs");
+            return nullptr;
+        }
+        long code = PyLong_AsLong(PyTuple_GET_ITEM(spec, 0));
+        if (code == -1 && PyErr_Occurred()) return nullptr;
+        rcodes[(size_t)r] = (int)code;
+        PyObject* idxs = PyTuple_GET_ITEM(spec, 1);
+        if (!PyTuple_Check(idxs)) {
+            PyErr_SetString(PyExc_TypeError, "red spec idx must be a tuple");
+            return nullptr;
+        }
+        for (Py_ssize_t j = 0; j < PyTuple_GET_SIZE(idxs); j++) {
+            Py_ssize_t v = PyLong_AsSsize_t(PyTuple_GET_ITEM(idxs, j));
+            if (v == -1 && PyErr_Occurred()) return nullptr;
+            ridx[(size_t)r].push_back(v);
+        }
+    }
+
+    PyObject* seq = PySequence_Fast(batch, "batch must be a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+
+    PyObject* gmap = PyDict_New();  // gvals -> PyLong entry index
+    std::vector<GEntry> entries;
+    std::vector<PyObject*> gvals_by_entry;  // borrowed (gmap holds refs)
+    if (gmap == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+
+    bool fail = false;
+    bool unsupported = false;
+    for (Py_ssize_t i = 0; i < n && !fail; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            fail = true;
+            break;
+        }
+        PyObject* key = PyTuple_GET_ITEM(u, 0);
+        PyObject* values = PyTuple_GET_ITEM(u, 1);
+        if (!PyTuple_Check(values)) {
+            PyErr_SetString(g_unsupported, "values must be tuples");
+            fail = true;
+            break;
+        }
+        Py_ssize_t nvals = PyTuple_GET_SIZE(values);
+        long long diff = PyLong_AsLongLong(PyTuple_GET_ITEM(u, 2));
+        if (diff == -1 && PyErr_Occurred()) {
+            fail = true;
+            break;
+        }
+        // group key tuple
+        PyObject* gv = PyTuple_New(ngroup);
+        if (gv == nullptr) {
+            fail = true;
+            break;
+        }
+        for (Py_ssize_t j = 0; j < ngroup; j++) {
+            Py_ssize_t ix = gidx[(size_t)j];
+            PyObject* cell;
+            if (ix < 0) {
+                cell = key;
+            } else if (ix < nvals) {
+                cell = PyTuple_GET_ITEM(values, ix);
+            } else {
+                PyErr_SetString(g_unsupported, "column index out of range");
+                Py_DECREF(gv);
+                fail = true;
+                break;
+            }
+            Py_INCREF(cell);
+            PyTuple_SET_ITEM(gv, j, cell);
+        }
+        if (fail) break;
+        PyObject* found = PyDict_GetItemWithError(gmap, gv);
+        if (found == nullptr && PyErr_Occurred()) {
+            // unhashable group value: whole batch falls back to Python
+            Py_DECREF(gv);
+            if (PyErr_ExceptionMatches(PyExc_TypeError)) {
+                PyErr_Clear();
+                unsupported = true;
+            }
+            fail = true;
+            break;
+        }
+        size_t ei;
+        if (found != nullptr) {
+            ei = (size_t)PyLong_AsSsize_t(found);
+            Py_DECREF(gv);
+        } else {
+            ei = entries.size();
+            PyObject* idx = PyLong_FromSsize_t((Py_ssize_t)ei);
+            if (idx == nullptr || PyDict_SetItem(gmap, gv, idx) < 0) {
+                Py_XDECREF(idx);
+                Py_DECREF(gv);
+                fail = true;
+                break;
+            }
+            Py_DECREF(idx);
+            gvals_by_entry.push_back(gv);
+            Py_DECREF(gv);  // gmap key holds the reference
+            entries.emplace_back();
+            entries.back().parts.resize((size_t)nred);
+        }
+        GEntry& ge = entries[ei];
+        ge.count += diff;
+        for (Py_ssize_t r = 0; r < nred && !fail; r++) {
+            GPart& part = ge.parts[(size_t)r];
+            int code = rcodes[(size_t)r];
+            if (code == 0) continue;  // count: uses ge.count
+            if (code == 1) {
+                Py_ssize_t ix = ridx[(size_t)r][0];
+                PyObject* v = ix < 0 ? key
+                              : ix < nvals ? PyTuple_GET_ITEM(values, ix)
+                                           : nullptr;
+                if (v == nullptr) {
+                    PyErr_SetString(g_unsupported, "column index out of range");
+                    fail = true;
+                    break;
+                }
+                if (v == Py_None || v == error_obj) continue;
+                PyObject* term;
+                if (diff == 1 && (PyLong_Check(v) || PyFloat_Check(v))) {
+                    // immutable scalars may alias; everything else (ndarray!)
+                    // must copy via v * diff like the Python reducer does
+                    term = v;
+                    Py_INCREF(term);
+                } else {
+                    PyObject* d = PyLong_FromLongLong(diff);
+                    if (d == nullptr) {
+                        fail = true;
+                        break;
+                    }
+                    term = PyNumber_Multiply(v, d);
+                    Py_DECREF(d);
+                    if (term == nullptr) {
+                        fail = true;
+                        break;
+                    }
+                }
+                if (part.total == nullptr) {
+                    part.total = term;
+                } else {
+                    PyObject* s = PyNumber_Add(part.total, term);
+                    Py_DECREF(term);
+                    if (s == nullptr) {
+                        fail = true;
+                        break;
+                    }
+                    Py_DECREF(part.total);
+                    part.total = s;
+                }
+                part.cnt += diff;
+            } else {  // code == 2: multiset of args
+                const std::vector<Py_ssize_t>& idxs = ridx[(size_t)r];
+                PyObject* margs = PyTuple_New((Py_ssize_t)idxs.size());
+                if (margs == nullptr) {
+                    fail = true;
+                    break;
+                }
+                for (size_t j = 0; j < idxs.size(); j++) {
+                    Py_ssize_t ix = idxs[j];
+                    PyObject* cell;
+                    if (ix < 0) {
+                        cell = key;
+                    } else if (ix < nvals) {
+                        cell = PyTuple_GET_ITEM(values, ix);
+                    } else {
+                        PyErr_SetString(g_unsupported,
+                                        "column index out of range");
+                        Py_DECREF(margs);
+                        fail = true;
+                        break;
+                    }
+                    Py_INCREF(cell);
+                    PyTuple_SET_ITEM(margs, (Py_ssize_t)j, cell);
+                }
+                if (fail) break;
+                if (part.msdict == nullptr) {
+                    part.msdict = PyDict_New();
+                    if (part.msdict == nullptr) {
+                        Py_DECREF(margs);
+                        fail = true;
+                        break;
+                    }
+                }
+                PyObject* h = margs;  // try the raw tuple as hash key first
+                Py_INCREF(h);
+                PyObject* mf = PyDict_GetItemWithError(part.msdict, h);
+                if (mf == nullptr && PyErr_Occurred()) {
+                    if (!PyErr_ExceptionMatches(PyExc_TypeError)) {
+                        Py_DECREF(h);
+                        Py_DECREF(margs);
+                        fail = true;
+                        break;
+                    }
+                    PyErr_Clear();
+                    Py_DECREF(h);
+                    h = PyObject_CallFunctionObjArgs(hashable_fn, margs,
+                                                     nullptr);
+                    if (h == nullptr) {
+                        Py_DECREF(margs);
+                        fail = true;
+                        break;
+                    }
+                    mf = PyDict_GetItemWithError(part.msdict, h);
+                    if (mf == nullptr && PyErr_Occurred()) {
+                        Py_DECREF(h);
+                        Py_DECREF(margs);
+                        fail = true;
+                        break;
+                    }
+                }
+                if (mf != nullptr) {
+                    size_t mi = (size_t)PyLong_AsSsize_t(mf);
+                    part.msitems[mi].delta += diff;
+                    Py_DECREF(h);
+                    Py_DECREF(margs);
+                } else {
+                    PyObject* mi =
+                        PyLong_FromSsize_t((Py_ssize_t)part.msitems.size());
+                    if (mi == nullptr ||
+                        PyDict_SetItem(part.msdict, h, mi) < 0) {
+                        Py_XDECREF(mi);
+                        Py_DECREF(h);
+                        Py_DECREF(margs);
+                        fail = true;
+                        break;
+                    }
+                    Py_DECREF(mi);
+                    part.msitems.push_back({diff, margs, h});  // owns both
+                }
+            }
+        }
+    }
+    Py_DECREF(seq);
+    if (fail) {
+        free_gentries(entries);
+        Py_DECREF(gmap);
+        if (unsupported && !PyErr_Occurred())
+            PyErr_SetString(g_unsupported, "unhashable group values");
+        return nullptr;
+    }
+
+    // build the result: {gvals: (count, (partial, ...))}
+    PyObject* out = PyDict_New();
+    if (out == nullptr) {
+        free_gentries(entries);
+        Py_DECREF(gmap);
+        return nullptr;
+    }
+    for (size_t ei = 0; ei < entries.size() && !fail; ei++) {
+        GEntry& ge = entries[ei];
+        PyObject* parts = PyTuple_New(nred);
+        if (parts == nullptr) {
+            fail = true;
+            break;
+        }
+        for (Py_ssize_t r = 0; r < nred && !fail; r++) {
+            GPart& p = ge.parts[(size_t)r];
+            PyObject* payload = nullptr;
+            if (rcodes[(size_t)r] == 0) {
+                payload = PyLong_FromLongLong(ge.count);
+            } else if (rcodes[(size_t)r] == 1) {
+                PyObject* tot = p.total ? p.total : Py_None;
+                payload = Py_BuildValue("(OL)", tot, p.cnt);
+            } else {
+                payload = PyDict_New();
+                if (payload != nullptr) {
+                    for (MsItem& it : p.msitems) {
+                        PyObject* dv =
+                            Py_BuildValue("(LO)", it.delta, it.args);
+                        if (dv == nullptr ||
+                            PyDict_SetItem(payload, it.h, dv) < 0) {
+                            Py_XDECREF(dv);
+                            Py_DECREF(payload);
+                            payload = nullptr;
+                            break;
+                        }
+                        Py_DECREF(dv);
+                    }
+                }
+            }
+            if (payload == nullptr) {
+                Py_DECREF(parts);
+                fail = true;
+                break;
+            }
+            PyTuple_SET_ITEM(parts, r, payload);
+        }
+        if (fail) break;
+        PyObject* val = Py_BuildValue("(LO)", ge.count, parts);
+        Py_DECREF(parts);
+        if (val == nullptr ||
+            PyDict_SetItem(out, gvals_by_entry[ei], val) < 0) {
+            Py_XDECREF(val);
+            fail = true;
+            break;
+        }
+        Py_DECREF(val);
+    }
+    free_gentries(entries);
+    Py_DECREF(gmap);
+    if (fail) {
+        Py_DECREF(out);
+        return nullptr;
+    }
+    return out;
+}
+
+// --------------------------------------------------------------------------
+// bulk schema coercion
+
+enum CoerceCode {
+    CO_ANY = 0,
+    CO_INT = 1,
+    CO_FLOAT = 2,
+    CO_STR = 3,
+    CO_BOOL = 4,
+};
+
+// mirrors io/_connector.py _column_coercer — must stay behaviour-identical
+PyObject* coerce_one(PyObject* v, int code) {
+    switch (code) {
+        case CO_FLOAT: {
+            if (PyFloat_Check(v)) break;
+            if (PyLong_Check(v)) return PyNumber_Float(v);
+            if (PyUnicode_Check(v)) {
+                PyObject* f = PyFloat_FromString(v);
+                if (f != nullptr) return f;
+                PyErr_Clear();
+            }
+            break;
+        }
+        case CO_INT: {
+            if (PyLong_Check(v)) break;  // bools stay bools (python parity)
+            if (PyFloat_Check(v)) {
+                double d = PyFloat_AS_DOUBLE(v);
+                // float.is_integer() parity; PyLong_FromDouble is exact
+                // for integer-valued doubles of any magnitude
+                if (std::isfinite(d) && d == std::floor(d))
+                    return PyLong_FromDouble(d);
+                break;
+            }
+            if (PyUnicode_Check(v)) {
+                PyObject* iv = PyLong_FromUnicodeObject(v, 10);
+                if (iv != nullptr) return iv;
+                PyErr_Clear();
+            }
+            break;
+        }
+        case CO_STR: {
+            if (PyUnicode_Check(v)) break;
+            return PyObject_Str(v);
+        }
+        case CO_BOOL: {
+            if (PyUnicode_Check(v)) {
+                PyObject* lower = PyObject_CallMethod(v, "lower", nullptr);
+                if (lower == nullptr) return nullptr;
+                bool truthy =
+                    PyUnicode_CompareWithASCIIString(lower, "true") == 0 ||
+                    PyUnicode_CompareWithASCIIString(lower, "1") == 0 ||
+                    PyUnicode_CompareWithASCIIString(lower, "t") == 0 ||
+                    PyUnicode_CompareWithASCIIString(lower, "yes") == 0;
+                Py_DECREF(lower);
+                return PyBool_FromLong(truthy ? 1 : 0);
+            }
+            break;
+        }
+        default:
+            break;
+    }
+    Py_INCREF(v);
+    return v;
+}
+
+PyObject* py_coerce_rows(PyObject*, PyObject* args) {
+    // rows: list of dicts; plan: list of (name, default, code)
+    PyObject *rows, *plan;
+    if (!PyArg_ParseTuple(args, "OO", &rows, &plan)) return nullptr;
+    PyObject* plan_seq = PySequence_Fast(plan, "plan must be a sequence");
+    if (plan_seq == nullptr) return nullptr;
+    Py_ssize_t ncols = PySequence_Fast_GET_SIZE(plan_seq);
+    std::vector<PyObject*> names((size_t)ncols);
+    std::vector<PyObject*> defaults((size_t)ncols);
+    std::vector<int> codes((size_t)ncols);
+    for (Py_ssize_t c = 0; c < ncols; c++) {
+        PyObject* item = PySequence_Fast_GET_ITEM(plan_seq, c);
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 3) {
+            PyErr_SetString(PyExc_TypeError, "plan items must be 3-tuples");
+            Py_DECREF(plan_seq);
+            return nullptr;
+        }
+        names[(size_t)c] = PyTuple_GET_ITEM(item, 0);
+        defaults[(size_t)c] = PyTuple_GET_ITEM(item, 1);
+        long code = PyLong_AsLong(PyTuple_GET_ITEM(item, 2));
+        if (code == -1 && PyErr_Occurred()) {
+            Py_DECREF(plan_seq);
+            return nullptr;
+        }
+        codes[(size_t)c] = (int)code;
+    }
+    PyObject* rows_seq = PySequence_Fast(rows, "rows must be a sequence");
+    if (rows_seq == nullptr) {
+        Py_DECREF(plan_seq);
+        return nullptr;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(rows_seq);
+    PyObject* out = PyList_New(n);
+    if (out == nullptr) {
+        Py_DECREF(plan_seq);
+        Py_DECREF(rows_seq);
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* row = PySequence_Fast_GET_ITEM(rows_seq, i);
+        if (!PyDict_Check(row)) {
+            PyErr_SetString(g_unsupported, "rows must be dicts");
+            goto fail;
+        }
+        {
+            PyObject* tup = PyTuple_New(ncols);
+            if (tup == nullptr) goto fail;
+            for (Py_ssize_t c = 0; c < ncols; c++) {
+                PyObject* v = PyDict_GetItemWithError(row, names[(size_t)c]);
+                if (v == nullptr && PyErr_Occurred()) {
+                    Py_DECREF(tup);
+                    goto fail;
+                }
+                if (v == nullptr || v == Py_None) v = defaults[(size_t)c];
+                PyObject* cv;
+                if (v == nullptr || v == Py_None) {
+                    cv = Py_None;
+                    Py_INCREF(cv);
+                } else {
+                    cv = coerce_one(v, codes[(size_t)c]);
+                    if (cv == nullptr) {
+                        Py_DECREF(tup);
+                        goto fail;
+                    }
+                }
+                PyTuple_SET_ITEM(tup, c, cv);
+            }
+            PyList_SET_ITEM(out, i, tup);
+        }
+    }
+    Py_DECREF(plan_seq);
+    Py_DECREF(rows_seq);
+    return out;
+fail:
+    Py_DECREF(plan_seq);
+    Py_DECREF(rows_seq);
+    Py_DECREF(out);
+    return nullptr;
+}
+
 PyObject* py_set_pointer_type(PyObject*, PyObject* cls) {
     Py_XDECREF(g_pointer_type);
     Py_INCREF(cls);
@@ -227,6 +1162,24 @@ PyMethodDef kMethods[] = {
      "batch 128-bit key hashes for a sequence of value tuples"},
     {"scan_lines", py_scan_lines, METH_O,
      "offsets of non-empty lines in a bytes buffer"},
+    {"consolidate", py_consolidate, METH_VARARGS,
+     "merge updates with equal (key, row), dropping zero-diff entries"},
+    {"per_key_changes", py_per_key_changes, METH_O,
+     "group a batch into per-key (removals, additions) lists"},
+    {"build_adds", py_build_adds, METH_VARARGS,
+     "bulk Update(key, values, +1) construction"},
+    {"coerce_rows", py_coerce_rows, METH_VARARGS,
+     "bulk schema coercion of row dicts into value tuples"},
+    {"groupby_partials", py_groupby_partials, METH_VARARGS,
+     "per-group partial aggregates of an update batch"},
+    {"all_positive", py_all_positive, METH_O,
+     "True iff every update diff is > 0"},
+    {"all_dicts", py_all_dicts, METH_O,
+     "True iff every element is a dict"},
+    {"rowwise_map", py_rowwise_map, METH_VARARGS,
+     "apply a row function across a batch, containing row errors"},
+    {"filter_batch", py_filter_batch, METH_VARARGS,
+     "keep updates whose (key, values) satisfy the predicate"},
     {"set_pointer_type", py_set_pointer_type, METH_O,
      "register the Pointer class for type-tagged hashing"},
     {nullptr, nullptr, 0, nullptr}};

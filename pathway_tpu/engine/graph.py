@@ -22,6 +22,7 @@ from typing import Any, Callable, Iterable, Sequence
 
 from pathway_tpu.internals import api
 from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import native as _native
 from pathway_tpu.internals.keys import Pointer
 from pathway_tpu.engine import cluster as cl
 from pathway_tpu.engine.reducers import ReducerImpl
@@ -205,7 +206,11 @@ class InputNode(Node):
                 raw = list(raw)  # the all() scan below must not consume it
             # append-only batch (no retractions): consolidation is a
             # semantic no-op on the multiset — skip the hash pass
-            if all(u.diff > 0 for u in raw):
+            native = _native.load()
+            if native is not None:
+                if native.all_positive(raw):
+                    return raw
+            elif all(u.diff > 0 for u in raw):
                 return raw
             return consolidate(raw)
         # Upsert session semantics (reference SessionType::Upsert,
@@ -243,6 +248,15 @@ class RowwiseNode(Node):
 
     def process(self, ctx, time, inbatches):
         fn = self.row_fn
+        native = _native.load()
+        if native is not None:
+            return native.rowwise_map(
+                inbatches[0],
+                fn,
+                Update,
+                api.ERROR,
+                lambda e: ctx.log_error(self, f"{self.name}: {e!r}"),
+            )
         out = []
         for u in inbatches[0]:
             try:
@@ -261,6 +275,9 @@ class FilterNode(Node):
 
     def process(self, ctx, time, inbatches):
         pred = self.pred
+        native = _native.load()
+        if native is not None:
+            return native.filter_batch(inbatches[0], pred, api.ERROR)
         out = []
         for u in inbatches[0]:
             try:
@@ -527,12 +544,17 @@ class GroupByNode(Node):
         output_key_fn: Callable[[tuple], Pointer] | None = None,
         include_group_values: bool = True,
         name: str = "groupby",
+        fast_spec: tuple | None = None,
     ):
         super().__init__(graph, [input], name)
         self.group_fn = group_fn
         self.reducer_args = reducer_args
         self.output_key_fn = output_key_fn or (lambda gvals: K.ref_scalar(*gvals))
         self.include_group_values = include_group_values
+        #: (group_positions, reducer_specs) for the native partial
+        #: aggregation path (groupbys.py builds it when every grouping and
+        #: reducer argument is a plain positional column)
+        self.fast_spec = fast_spec
 
     def exchange_routes(self):
         return [cl.route_by(self.group_fn)]
@@ -563,18 +585,54 @@ class GroupByNode(Node):
             groups[gh] = g
         return gh, g
 
-    def process(self, ctx, time, inbatches):
-        st = ctx.state(self)
+    def _accumulate_native(self, st, batch) -> dict | None:
+        """One C pass producing per-group partials, merged per dirty group
+        (native ``groupby_partials``); None -> caller runs the Python loop."""
+        from pathway_tpu.internals import native as _native
+        from pathway_tpu.engine.stream import hashable_row
+
+        native = _native.load()
+        if native is None:
+            return None
+        try:
+            partials = native.groupby_partials(
+                batch,
+                self.fast_spec[0],
+                self.fast_spec[1],
+                api.ERROR,
+                hashable_row,
+            )
+        except native.Unsupported:
+            return None
         dirty: dict[Any, Any] = {}
         reducer_args = self.reducer_args
-        group_fn = self.group_fn
-        for u in inbatches[0]:
-            gvals = group_fn(u.key, u.values)
+        for gvals, (cdelta, parts) in partials.items():
             gh, g = self._group(st, gvals)
-            g["count"] += u.diff
-            for (reducer, arg_fn), acc in zip(reducer_args, g["accs"]):
-                reducer.update(acc, arg_fn(u.key, u.values), u.diff)
+            g["count"] += cdelta
+            for (reducer, _), acc, part in zip(reducer_args, g["accs"], parts):
+                reducer.merge_partial(acc, part)
             dirty[gh] = g
+        return dirty
+
+    def process(self, ctx, time, inbatches):
+        st = ctx.state(self)
+        batch = inbatches[0]
+        if not isinstance(batch, list):
+            batch = list(batch)  # Unsupported fallback must re-iterate
+        dirty: dict[Any, Any] | None = None
+        if self.fast_spec is not None:
+            dirty = self._accumulate_native(st, batch)
+        if dirty is None:
+            dirty = {}
+            reducer_args = self.reducer_args
+            group_fn = self.group_fn
+            for u in batch:
+                gvals = group_fn(u.key, u.values)
+                gh, g = self._group(st, gvals)
+                g["count"] += u.diff
+                for (reducer, arg_fn), acc in zip(reducer_args, g["accs"]):
+                    reducer.update(acc, arg_fn(u.key, u.values), u.diff)
+                dirty[gh] = g
         out = []
         for gh, g in dirty.items():
             okey = self.output_key_fn(g["gvals"])

@@ -27,6 +27,10 @@ class ReducerImpl:
     name = "reducer"
     # how many expression arguments the reducer consumes
     n_args = 1
+    #: native partial-aggregation code (native/pathway_native.cpp
+    #: groupby_partials): 0 = count, 1 = sum-like, 2 = multiset,
+    #: None = no native fast path for this reducer
+    native_code: int | None = None
 
     def return_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
         return dt.ANY
@@ -37,6 +41,10 @@ class ReducerImpl:
     def update(self, acc: Any, args: tuple, diff: int) -> None:
         raise NotImplementedError
 
+    def merge_partial(self, acc: Any, partial: Any) -> None:
+        """Fold one native partial (see ``native_code``) into ``acc``."""
+        raise NotImplementedError
+
     def extract(self, acc: Any) -> Any:
         raise NotImplementedError
 
@@ -44,6 +52,7 @@ class ReducerImpl:
 class CountReducer(ReducerImpl):
     name = "count"
     n_args = 0
+    native_code = 0
 
     def return_dtype(self, arg_dtypes):
         return dt.INT
@@ -54,12 +63,16 @@ class CountReducer(ReducerImpl):
     def update(self, acc, args, diff):
         acc[0] += diff
 
+    def merge_partial(self, acc, partial):
+        acc[0] += partial
+
     def extract(self, acc):
         return acc[0]
 
 
 class SumReducer(ReducerImpl):
     name = "sum"
+    native_code = 1
 
     def return_dtype(self, arg_dtypes):
         return arg_dtypes[0] if arg_dtypes else dt.ANY
@@ -77,6 +90,13 @@ class SumReducer(ReducerImpl):
             acc[0] = acc[0] + v * diff
         acc[1] += diff
 
+    def merge_partial(self, acc, partial):
+        total, cnt = partial
+        if total is None:
+            return
+        acc[0] = total if acc[0] is None else acc[0] + total
+        acc[1] += cnt
+
     def extract(self, acc):
         if acc[1] == 0 and not isinstance(acc[0], np.ndarray):
             return 0 if acc[0] is None else type(acc[0])(0) if isinstance(acc[0], (int, float)) else acc[0]
@@ -85,6 +105,7 @@ class SumReducer(ReducerImpl):
 
 class AvgReducer(ReducerImpl):
     name = "avg"
+    native_code = 1
 
     def return_dtype(self, arg_dtypes):
         return dt.FLOAT
@@ -99,6 +120,13 @@ class AvgReducer(ReducerImpl):
         acc[0] += v * diff
         acc[1] += diff
 
+    def merge_partial(self, acc, partial):
+        total, cnt = partial
+        if total is None:
+            return
+        acc[0] += total
+        acc[1] += cnt
+
     def extract(self, acc):
         return acc[0] / acc[1] if acc[1] else None
 
@@ -110,6 +138,8 @@ class _MultisetReducer(ReducerImpl):
     def make_acc(self):
         return {"counter": Counter(), "orig": {}}
 
+    native_code = 2
+
     def update(self, acc, args, diff):
         h = hashable(args)
         acc["counter"][h] += diff
@@ -118,6 +148,17 @@ class _MultisetReducer(ReducerImpl):
             acc["orig"].pop(h, None)
         else:
             acc["orig"].setdefault(h, args)
+
+    def merge_partial(self, acc, partial):
+        counter = acc["counter"]
+        orig = acc["orig"]
+        for h, (delta, args) in partial.items():
+            counter[h] += delta
+            if counter[h] <= 0:
+                del counter[h]
+                orig.pop(h, None)
+            else:
+                orig.setdefault(h, args)
 
     def _items(self, acc):
         return [(acc["orig"][h], c) for h, c in acc["counter"].items()]
@@ -324,6 +365,7 @@ class StatefulReducer(ReducerImpl):
     ``retract`` only when available — otherwise replays from scratch."""
 
     name = "stateful"
+    native_code = 2
 
     def __init__(self, fold: Callable[[list[tuple]], Any], n_args: int = 1):
         self.fold = fold
@@ -338,6 +380,9 @@ class StatefulReducer(ReducerImpl):
 
     def update(self, acc, args, diff):
         self._ms.update(acc, args, diff)
+
+    def merge_partial(self, acc, partial):
+        self._ms.merge_partial(acc, partial)
 
     def extract(self, acc):
         rows: list[tuple] = []

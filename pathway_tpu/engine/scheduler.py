@@ -29,6 +29,7 @@ from typing import Any
 from pathway_tpu.engine.cluster import Cluster
 from pathway_tpu.engine.graph import EngineGraph, InputNode, Node, RunContext
 from pathway_tpu.engine.stream import TIME_STEP, Batch, Update
+from pathway_tpu.internals import native as _native
 from pathway_tpu.internals.keys import Pointer
 
 
@@ -78,9 +79,12 @@ class ConnectorEvents:
         scheduler's epoch work."""
         if rows:
             self.stats["rows"] += len(rows)
-            self._q.put(
-                (self._node_id, "batch", [Update(k, v, 1) for k, v in rows], None)
-            )
+            native = _native.load()
+            if native is not None:
+                batch = native.build_adds(rows, Update)
+            else:
+                batch = [Update(k, v, 1) for k, v in rows]
+            self._q.put((self._node_id, "batch", batch, None))
 
     def commit(self) -> None:
         self.stats["commits"] += 1

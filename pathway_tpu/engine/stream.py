@@ -20,6 +20,7 @@ from typing import Any, Iterable, NamedTuple
 
 import numpy as np
 
+from pathway_tpu.internals import native as _native
 from pathway_tpu.internals.keys import Pointer
 
 
@@ -52,13 +53,7 @@ def hashable_row(values: tuple) -> tuple:
     return tuple(hashable(v) for v in values)
 
 
-def consolidate(batch: Iterable[Update]) -> Batch:
-    """Merge updates with equal (key, row), dropping zero-diff entries.
-
-    Fast path hashes the row tuple directly (scalar cells — the common
-    case); rows holding unhashable cells (ndarray/dict/list) fall back to
-    the type-tagged :func:`hashable_row` per update, so both spellings of
-    an equal row land in the same bucket."""
+def _py_consolidate(batch: Iterable[Update]) -> Batch:
     acc: dict[tuple, list] = {}
     for u in batch:
         k = (u.key, u.values)
@@ -74,8 +69,37 @@ def consolidate(batch: Iterable[Update]) -> Batch:
     return [Update(key, vals, d) for key, vals, d in acc.values() if d != 0]
 
 
+def consolidate(batch: Iterable[Update]) -> Batch:
+    """Merge updates with equal (key, row), dropping zero-diff entries.
+
+    Fast path hashes the row tuple directly (scalar cells — the common
+    case); rows holding unhashable cells (ndarray/dict/list) fall back to
+    the type-tagged :func:`hashable_row` per update, so both spellings of
+    an equal row land in the same bucket.
+
+    Runs in C when the native extension is available
+    (``native/pathway_native.cpp`` ``consolidate`` — the compaction loop
+    the reference runs inside differential arrangements); unchanged
+    single-occurrence updates are re-emitted by reference, so the common
+    no-duplicate case allocates nothing."""
+    native = _native.load()
+    if native is not None:
+        try:
+            return native.consolidate(
+                batch if isinstance(batch, list) else list(batch),
+                Update,
+                hashable_row,
+            )
+        except native.Unsupported:
+            pass
+    return _py_consolidate(batch)
+
+
 def per_key_changes(batch: Iterable[Update]) -> dict[Pointer, tuple[list, list]]:
     """Group a batch into per-key (removals, additions) lists."""
+    native = _native.load()
+    if native is not None:
+        return native.per_key_changes(batch)
     out: dict[Pointer, tuple[list, list]] = {}
     for u in batch:
         rem, add = out.setdefault(u.key, ([], []))

@@ -119,10 +119,48 @@ class GroupedTable:
                 kv = (key, values)
                 return tuple(f(kv) for f in gfns)
 
+        # native partial-aggregation spec: usable when every grouping key
+        # and reducer argument is a plain positional column (the common
+        # case); engine falls back to the compiled-closure loop otherwise
+        fast_group: list[int] = []
+        fast_ok = True
+        for g in self._grouping:
+            ge = g._substitute({THIS: source})
+            pos = (
+                layout.resolve_pos(ge) if isinstance(ge, ColumnReference) else None
+            )
+            if pos is None:
+                fast_ok = False
+                break
+            fast_group.append(pos)
+        fast_reds: list[tuple[int, tuple]] = []
+
+        def _arg_positions(args: list) -> tuple | None:
+            poses = []
+            for a in args:
+                if not isinstance(a, ColumnReference):
+                    return None
+                p = layout.resolve_pos(a)
+                if p is None:
+                    return None
+                poses.append(p)
+            return tuple(poses)
+
         reducer_args: list[tuple[Any, Callable]] = []
         for re_expr in reducer_slots:
             impl = re_expr._reducer.make_impl(**re_expr._reducer_kwargs)
             arg_fns = [a._compile(layout.resolver) for a in re_expr._args]
+            if fast_ok:
+                code = impl.native_code
+                poses = _arg_positions(list(re_expr._args))
+                if code is None or poses is None:
+                    fast_ok = False
+                elif code == 0:
+                    fast_reds.append((0, ()))
+                elif impl.name in ("argmin", "argmax") and len(poses) == 1:
+                    fast_reds.append((code, (poses[0], -1)))  # (value, row key)
+                else:
+                    fast_reds.append((code, poses))
             if impl.name in ("argmin", "argmax"):
                 # one arg: returns the extreme row's KEY (reference
                 # semantics); two args: (sort_value, returned_value)
@@ -166,6 +204,7 @@ class GroupedTable:
             output_key_fn=output_key_fn,
             include_group_values=True,
             name="groupby",
+            fast_spec=(tuple(fast_group), tuple(fast_reds)) if fast_ok else None,
         )
         inter_cols = inter_names + [f"__r{i}" for i in range(len(reducer_slots))]
         inter_dtypes: dict[str, dt.DType] = {}

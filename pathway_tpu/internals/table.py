@@ -83,6 +83,28 @@ class _Layout:
             "of this operation (universes must match)"
         )
 
+    def resolve_pos(self, ref: ColumnReference) -> int | None:
+        """Positional resolution for native fast paths: the value-tuple
+        index, ``-1`` for the row key, or None when the reference isn't a
+        plain positional column of this layout."""
+        t = ref._table
+        entry = None
+        for table, mapping, id_pos in self.entries:
+            if table is t:
+                entry = (mapping, id_pos)
+                break
+        if entry is None:
+            for table, mapping, id_pos in self.entries:
+                if self._family_match(table, t):
+                    entry = (mapping, id_pos)
+                    break
+        if entry is None:
+            return None
+        mapping, id_pos = entry
+        if ref._name == "id":
+            return -1 if id_pos is None else id_pos
+        return mapping.get(ref._name)
+
 
 def compile_exprs(
     exprs: list[ColumnExpression], layout: _Layout

@@ -17,6 +17,7 @@ from typing import Any, Callable, Iterable
 
 from pathway_tpu.engine import graph as eg
 from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import native as _nat
 from pathway_tpu.internals import keys as K
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.parse_graph import G
@@ -129,19 +130,32 @@ def _column_coercer(dtype: Any):
     return co
 
 
-def _schema_coercers(schema: sch.SchemaMetaclass) -> list:
-    plan = _coercer_cache.get(schema)
-    if plan is None:
-        plan = [
-            (
-                name,
-                col.default_value if col.has_default else None,
-                _column_coercer(col.dtype),
-            )
+#: native coercion codes (native/pathway_native.cpp CoerceCode); every
+#: dtype outside this map coerces as identity (code 0)
+_NATIVE_CODES = {dt.INT: 1, dt.FLOAT: 2, dt.STR: 3, dt.BOOL: 4}
+
+
+def _schema_plans(schema: sch.SchemaMetaclass) -> tuple[list, tuple]:
+    """One cached plan per schema, built once: the Python coercer closures
+    and the equivalent native code table share the same (name, default)
+    extraction so the two paths cannot drift apart."""
+    plans = _coercer_cache.get(schema)
+    if plans is None:
+        cols = [
+            (name, col.default_value if col.has_default else None, col.dtype)
             for name, col in schema.__columns__.items()
         ]
-        _coercer_cache[schema] = plan
-    return plan
+        py_plan = [(n, d, _column_coercer(t)) for n, d, t in cols]
+        native_plan = tuple(
+            (n, d, _NATIVE_CODES.get(t.strip_optional(), 0)) for n, d, t in cols
+        )
+        plans = (py_plan, native_plan)
+        _coercer_cache[schema] = plans
+    return plans
+
+
+def _schema_coercers(schema: sch.SchemaMetaclass) -> list:
+    return _schema_plans(schema)[0]
 
 
 def coerce_row(values: dict[str, Any], schema: sch.SchemaMetaclass) -> tuple:
@@ -152,6 +166,19 @@ def coerce_row(values: dict[str, Any], schema: sch.SchemaMetaclass) -> tuple:
             v = default
         out.append(co(v) if v is not None else None)
     return tuple(out)
+
+
+def coerce_rows(rows: list, schema: sch.SchemaMetaclass) -> list:
+    """Bulk :func:`coerce_row` over a block of parsed row dicts — one C
+    call when the native extension is available (reference parser hot
+    loop, ``src/connectors/data_format.rs``)."""
+    native = _nat.load()
+    if native is not None:
+        try:
+            return native.coerce_rows(rows, _schema_plans(schema)[1])
+        except native.Unsupported:
+            pass
+    return [coerce_row(v, schema) for v in rows]
 
 
 def input_table(

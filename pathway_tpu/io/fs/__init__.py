@@ -16,6 +16,7 @@ from pathway_tpu.io._connector import (
     RowSource,
     attach_writer,
     coerce_row,
+    coerce_rows,
     fmt_value,
     input_table,
 )
@@ -120,17 +121,19 @@ class _FilesSource(RowSource):
                 ]
                 seq = base + len(rows)
             keys = keys_for_values(key_args)
-            for values, key in zip(rows, keys):
-                if n > 1 and int(key) % n != w:
-                    continue  # another worker's share
-                row = coerce_row(values, schema)
-                if add_many is None:
+            if n > 1:  # keep only this worker's key-hash share
+                kept = [(v, k) for v, k in zip(rows, keys) if int(k) % n == w]
+                rows = [v for v, _ in kept]
+                keys = [k for _, k in kept]
+            coerced = coerce_rows(rows, schema)
+            if add_many is None:
+                for key, row in zip(keys, coerced):
                     events.add(key, row)
-                else:
-                    chunk.append((key, row))
-                    if len(chunk) >= _CHUNK:
-                        add_many(chunk)
-                        chunk = []
+            else:
+                chunk.extend(zip(keys, coerced))
+                if len(chunk) >= _CHUNK:
+                    add_many(chunk)
+                    chunk = []
 
         # binary mode: byte-accurate offsets (text-mode tell() is unusable
         # with block reads), splitting on b"\n"; only COMPLETE lines are

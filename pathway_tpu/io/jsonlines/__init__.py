@@ -8,6 +8,7 @@ import json
 import os
 from typing import Any
 
+from pathway_tpu.internals import native as _native
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io._connector import (
@@ -76,8 +77,12 @@ def read(
             # are both ValueError; the per-line fallback skips bad rows
             # individually with errors="replace"
             return None
-        if not all(isinstance(r, dict) for r in rows):
-            return None  # non-object lines: per-line path skips them
+        native = _native.load()
+        if native is not None:
+            if not native.all_dicts(rows):
+                return None  # non-object lines: per-line path skips them
+        elif not all(isinstance(r, dict) for r in rows):
+            return None
         return rows
 
     source = _FilesSource(

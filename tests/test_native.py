@@ -70,3 +70,176 @@ def test_scan_lines(mod):
     assert mod.scan_lines(b"") == []
     assert mod.scan_lines(b"\n\n") == []
     assert mod.scan_lines(b"no-newline") == [(0, 10)]
+
+
+# ---------------------------------------------------------------------------
+# batch-op parity: every native batch function against its Python fallback
+
+
+def _k(i):
+    return K.ref_scalar(i)
+
+
+def _mixed_batch():
+    import numpy as np
+
+    from pathway_tpu.engine.stream import Update
+
+    return [
+        Update(_k(1), ("a", 1), 1),
+        Update(_k(1), ("a", 1), 1),
+        Update(_k(2), ("b", 2.5), 1),
+        Update(_k(1), ("a", 1), -2),
+        Update(_k(3), ("c", None), -1),
+        Update(_k(2), ("b", 2.5), 3),
+        Update(_k(4), (np.ones(3), "nd"), 1),  # unhashable cell
+        Update(_k(4), (np.ones(3), "nd"), 1),
+    ]
+
+
+def test_consolidate_parity(mod):
+    from pathway_tpu.engine import stream
+
+    batch = _mixed_batch()
+    got = stream.consolidate(list(batch))
+    exp = stream._py_consolidate(list(batch))
+
+    def canon(b):
+        return sorted(
+            (u.key, stream.hashable_row(u.values), u.diff) for u in b
+        )
+
+    assert canon(got) == canon(exp)
+    # single-occurrence updates are re-emitted by reference (no realloc)
+    single = [u for u in got if u.key == _k(2)]
+    assert single and type(single[0]) is stream.Update
+
+
+def test_per_key_changes_parity(mod):
+    from pathway_tpu.engine.stream import Update, per_key_changes
+
+    batch = [
+        Update(_k(1), ("a",), 2),
+        Update(_k(1), ("b",), -1),
+        Update(_k(2), ("c",), 1),
+    ]
+    out = per_key_changes(batch)
+    assert out[_k(1)] == ([("b",)], [("a",), ("a",)])
+    assert out[_k(2)] == ([], [("c",)])
+
+
+def test_coerce_rows_parity(mod):
+    from pathway_tpu.internals import schema as sch
+    from pathway_tpu.io._connector import coerce_row, coerce_rows
+
+    S = sch.schema_from_types(a=int, b=float, c=str, d=bool)
+    rows = [
+        {"a": "5", "b": 2, "c": 7, "d": "Yes"},
+        {"a": 3.0, "b": "1.5", "c": "x", "d": "nope"},
+        {"a": None, "b": None},
+        {"a": True, "b": "zz", "c": None, "d": 1},
+        {"a": 2.5, "b": float("inf"), "c": "", "d": "T"},
+    ]
+    bulk = coerce_rows(list(rows), S)
+    single = [coerce_row(r, S) for r in rows]
+    assert bulk == single
+    for x, y in zip(bulk, single):
+        for xi, yi in zip(x, y):
+            assert type(xi) is type(yi), (xi, yi)
+
+
+def test_filter_batch_parity_and_bool_error(mod):
+    import numpy as np
+
+    import pathway_tpu as pw
+    from pathway_tpu.internals import api
+    from pathway_tpu.engine.stream import Update
+
+    batch = [
+        Update(_k(1), (1,), 1),
+        Update(_k(2), (0,), 1),
+        Update(_k(3), (None,), 1),
+        Update(_k(4), (2,), 1),
+    ]
+    out = mod.filter_batch(batch, lambda k, v: v[0], api.ERROR)
+    assert [u.key for u in out] == [_k(1), _k(4)]
+    assert out[0] is batch[0]  # passing rows are re-emitted, not rebuilt
+    # raising predicate CALL drops the row (python parity)...
+    out = mod.filter_batch(batch, lambda k, v: 1 // v[0], api.ERROR)
+    assert [u.key for u in out] == [_k(1)]  # 1//1 truthy; 1//0 raises; None//..
+    # ...but a raising truthiness test propagates, like bool(ndarray) does
+    with pytest.raises(ValueError):
+        mod.filter_batch(batch, lambda k, v: np.array([1, 2]), api.ERROR)
+
+
+def test_rowwise_map_contains_errors(mod):
+    from pathway_tpu.internals import api
+    from pathway_tpu.engine.stream import Update
+
+    batch = [Update(_k(1), (4,), 1), Update(_k(2), (0,), -1)]
+    logged = []
+    out = mod.rowwise_map(
+        batch, lambda k, v: (8 // v[0],), Update, api.ERROR, logged.append
+    )
+    assert [(u.values, u.diff) for u in out] == [((2,), 1), ((api.ERROR,), -1)]
+    assert len(logged) == 1 and isinstance(logged[0], ZeroDivisionError)
+
+
+def test_groupby_partials_sum_does_not_alias_ndarray(mod):
+    """A one-contribution ndarray sum must copy (python `v * diff` parity),
+    not alias the ingested row's buffer."""
+    import numpy as np
+
+    import pathway_tpu as pw
+    from tests.utils import T
+
+    arr_rows = [("g", np.array([1.0, 2.0]))]
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(g=str, v=object), arr_rows
+    )
+    red = t.groupby(t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+    cap = red._capture_node()
+    ctx = pw.run()
+    (row,) = ctx.state(cap)["rows"].values()
+    assert row[1] is not arr_rows[0][1]
+    assert (row[1] == np.array([1.0, 2.0])).all()
+
+
+def test_engine_parity_native_vs_python_subprocess(mod):
+    """The same pipeline, native enabled vs PATHWAY_DISABLE_NATIVE=1,
+    must print byte-identical results."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import pathway_tpu as pw\n"
+        "t = pw.debug.table_from_markdown('''\n"
+        "grp | v | w\n"
+        "a   | 1 | x\n"
+        "b   | 2 | y\n"
+        "a   | 3 | x\n"
+        "b   | 6 | y\n"
+        "a   | 5 | q\n"
+        "''')\n"
+        "red = t.groupby(t.grp).reduce(t.grp, s=pw.reducers.sum(t.v),\n"
+        "    mx=pw.reducers.max(t.v), c=pw.reducers.count(),\n"
+        "    av=pw.reducers.avg(t.v), am=pw.reducers.argmax(t.v),\n"
+        "    u=pw.reducers.unique(t.w))\n"
+        "out = red.filter(red.s > 4).select(red.grp, d=red.s * 2)\n"
+        "pw.debug.compute_and_print(out, include_id=False)\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    a = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env
+    )
+    env["PATHWAY_DISABLE_NATIVE"] = "1"
+    b = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env
+    )
+    assert a.returncode == 0, a.stderr[-2000:]
+    assert b.returncode == 0, b.stderr[-2000:]
+    assert a.stdout == b.stdout and a.stdout.strip()
