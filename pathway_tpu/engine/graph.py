@@ -242,14 +242,44 @@ class RowwiseNode(Node):
     """expression_table (reference ``Graph::expression_table``): compute a new
     tuple of columns for each row via compiled expression closures."""
 
-    def __init__(self, graph: EngineGraph, input: Node, row_fn: Callable[[Pointer, tuple], tuple], name: str = "select"):
+    def __init__(
+        self,
+        graph: EngineGraph,
+        input: Node,
+        row_fn: Callable[[Pointer, tuple], tuple],
+        name: str = "select",
+        typecheck_info: tuple[list[str], list] | None = None,
+    ):
         super().__init__(graph, [input], name)
         self.row_fn = row_fn
+        #: (column names, declared dtypes) for PATHWAY_RUNTIME_TYPECHECKING
+        self.typecheck_info = typecheck_info
+        self._checker: Any = None
+
+    def _typecheck(self) -> Callable[[tuple], None] | None:
+        """The runtime validator iff typechecking is on for this run
+        (reference runtime typechecking mode) — checked per batch so
+        ``pw.run(runtime_typechecking=True)`` works after graph build."""
+        if self.typecheck_info is None:
+            return None
+        from pathway_tpu.internals.config import pathway_config
+
+        if not pathway_config.runtime_typechecking:
+            return None
+        if self._checker is None:
+            from pathway_tpu.internals.type_interpreter import (
+                make_runtime_checker,
+            )
+
+            names, dtypes = self.typecheck_info
+            self._checker = make_runtime_checker(names, dtypes, self.name)
+        return self._checker
 
     def process(self, ctx, time, inbatches):
         fn = self.row_fn
+        check = self._typecheck()
         native = _native.load()
-        if native is not None:
+        if native is not None and check is None:
             return native.rowwise_map(
                 inbatches[0],
                 fn,
@@ -264,6 +294,9 @@ class RowwiseNode(Node):
             except Exception as e:
                 ctx.log_error(self, f"{self.name}: {e!r}")
                 vals = tuple([api.ERROR])
+            else:
+                if check is not None:
+                    check(vals)  # declared-type violations fail the run
             out.append(Update(u.key, vals, u.diff))
         return out
 

@@ -29,6 +29,7 @@ from typing import Any
 from pathway_tpu.engine.cluster import Cluster
 from pathway_tpu.engine.graph import EngineGraph, InputNode, Node, RunContext
 from pathway_tpu.engine.stream import TIME_STEP, Batch, Update
+from pathway_tpu.internals import api
 from pathway_tpu.internals import native as _native
 from pathway_tpu.internals.keys import Pointer
 
@@ -246,6 +247,10 @@ class Scheduler:
             t0 = _time.perf_counter()
             try:
                 out = node.process(ctx, time, inbatches)
+            except api.FatalEngineError:
+                # unrecoverable by contract (runtime typecheck violations,
+                # corrupted state): fail the run, don't contain
+                raise
             except Exception as e:
                 # per-node containment: a failing operator must not abort
                 # the run (reference routes errors to the error log,

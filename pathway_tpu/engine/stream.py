@@ -81,17 +81,15 @@ def consolidate(batch: Iterable[Update]) -> Batch:
     (``native/pathway_native.cpp`` ``consolidate`` — the compaction loop
     the reference runs inside differential arrangements); unchanged
     single-occurrence updates are re-emitted by reference, so the common
-    no-duplicate case allocates nothing."""
+    no-duplicate case allocates nothing.  The C path handles unhashable
+    rows itself (via ``hashable_row``), so it needs no fallback."""
     native = _native.load()
     if native is not None:
-        try:
-            return native.consolidate(
-                batch if isinstance(batch, list) else list(batch),
-                Update,
-                hashable_row,
-            )
-        except native.Unsupported:
-            pass
+        return native.consolidate(
+            batch if isinstance(batch, list) else list(batch),
+            Update,
+            hashable_row,
+        )
     return _py_consolidate(batch)
 
 

@@ -45,7 +45,13 @@ def is_error(value: object) -> bool:
 
 
 class EngineError(Exception):
-    """Raised for unrecoverable engine failures."""
+    """Raised for engine failures; contained per-node by the scheduler
+    (routed to the error log) unless it is a :class:`FatalEngineError`."""
+
+
+class FatalEngineError(EngineError):
+    """An engine failure that must abort the run instead of being
+    contained (e.g. runtime typecheck violations)."""
 
 
 class EngineErrorWithTrace(EngineError):

@@ -251,22 +251,82 @@ def dtype_of_value(value: _Any) -> DType:
 _NUMERIC_ORDER = {BOOL: 0, INT: 1, FLOAT: 2}
 
 
-def lub(a: DType, b: DType) -> DType:
-    """Least upper bound of two dtypes (used by if_else/concat/coalesce)."""
-    if a == b:
+def is_subtype(sub: DType, sup: DType) -> bool:
+    """Lattice ordering ``sub <= sup`` (reference ``dtype.is_subclass`` /
+    the ``dtypes_pairs`` relation): INT <= FLOAT, T <= Optional(T),
+    NONE <= Optional(T), covariant Tuple/List/Array, everything <= ANY."""
+    if sup == ANY or sub == sup:
+        return True
+    if isinstance(sup, Optional):
+        if sub == NONE:
+            return True
+        return is_subtype(sub.strip_optional() if isinstance(sub, Optional) else sub, sup.wrapped)
+    if isinstance(sub, Optional):
+        return False  # Optional(T) </= non-optional
+    if sub in _NUMERIC_ORDER and sup in _NUMERIC_ORDER:
+        return _NUMERIC_ORDER[sub] <= _NUMERIC_ORDER[sup]
+    if isinstance(sub, Tuple) and isinstance(sup, Tuple):
+        return len(sub.element_types) == len(sup.element_types) and all(
+            is_subtype(s, t)
+            for s, t in zip(sub.element_types, sup.element_types)
+        )
+    if isinstance(sub, Tuple) and isinstance(sup, List):
+        return all(is_subtype(s, sup.element_type) for s in sub.element_types)
+    if isinstance(sub, List) and isinstance(sup, List):
+        return is_subtype(sub.element_type, sup.element_type)
+    if isinstance(sub, Array) and isinstance(sup, Array):
+        dim_ok = sup.n_dim is None or sub.n_dim == sup.n_dim
+        return dim_ok and is_subtype(sub.wrapped, sup.wrapped)
+    if isinstance(sub, Future) and isinstance(sup, Future):
+        return is_subtype(sub.wrapped, sup.wrapped)
+    return False
+
+
+def types_lca(a: DType, b: DType) -> DType:
+    """Least common ancestor in the lattice (reference ``dtype.types_lca``):
+    the narrowest type both sides convert to, structure-aware for
+    Optional/Tuple/List/Array; ANY when unrelated."""
+    if is_subtype(a, b):
+        return b
+    if is_subtype(b, a):
         return a
     if a == NONE:
         return Optional(b)
     if b == NONE:
         return Optional(a)
     if isinstance(a, Optional) or isinstance(b, Optional):
-        inner = lub(a.strip_optional(), b.strip_optional())
-        return Optional(inner)
+        return Optional(types_lca(a.strip_optional(), b.strip_optional()))
     if a in _NUMERIC_ORDER and b in _NUMERIC_ORDER:
         return a if _NUMERIC_ORDER[a] >= _NUMERIC_ORDER[b] else b
-    if a == ANY or b == ANY:
-        return ANY
+    if isinstance(a, Tuple) and isinstance(b, Tuple):
+        if len(a.element_types) == len(b.element_types):
+            return Tuple(
+                *[
+                    types_lca(x, y)
+                    for x, y in zip(a.element_types, b.element_types)
+                ]
+            )
+        return List(
+            types_lca(
+                lub_many(*a.element_types) if a.element_types else ANY,
+                lub_many(*b.element_types) if b.element_types else ANY,
+            )
+        )
+    if isinstance(a, (Tuple, List)) and isinstance(b, (Tuple, List)):
+        ea = lub_many(*a.element_types) if isinstance(a, Tuple) else a.element_type
+        eb = lub_many(*b.element_types) if isinstance(b, Tuple) else b.element_type
+        return List(types_lca(ea, eb))
+    if isinstance(a, Array) and isinstance(b, Array):
+        return Array(
+            a.n_dim if a.n_dim == b.n_dim else None,
+            types_lca(a.wrapped, b.wrapped),
+        )
     return ANY
+
+
+def lub(a: DType, b: DType) -> DType:
+    """Least upper bound of two dtypes (used by if_else/concat/coalesce)."""
+    return types_lca(a, b)
 
 
 def lub_many(*dtypes: DType) -> DType:

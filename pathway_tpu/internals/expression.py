@@ -305,17 +305,14 @@ _CMP_OPS = {"==", "!=", "<", "<=", ">", ">=" }
 
 class BinaryExpression(ColumnExpression):
     def __init__(self, op: str, left: ColumnExpression, right: ColumnExpression):
+        from pathway_tpu.internals.type_interpreter import binary_result_dtype
+
         self._op = op
         self._left = left
         self._right = right
-        if op in _CMP_OPS or op in ("&", "|", "^") and (
-            left._dtype.strip_optional() == dt.BOOL or right._dtype.strip_optional() == dt.BOOL
-        ):
-            self._dtype = dt.BOOL
-        elif op == "/":
-            self._dtype = dt.FLOAT
-        else:
-            self._dtype = dt.lub(left._dtype.strip_optional(), right._dtype.strip_optional())
+        # build-time operator typing (reference type_interpreter.py):
+        # raises TypeInterpreterError on e.g. STR + INT before the graph runs
+        self._dtype = binary_result_dtype(op, left._dtype, right._dtype)
 
     def __repr__(self) -> str:
         return f"({self._left!r} {self._op} {self._right!r})"
@@ -357,9 +354,11 @@ class UnaryExpression(ColumnExpression):
     _OPS: dict[str, Callable[[Any], Any]] = {"-": lambda a: -a, "~": lambda a: (not a) if isinstance(a, bool) else ~a}
 
     def __init__(self, op: str, operand: ColumnExpression):
+        from pathway_tpu.internals.type_interpreter import unary_result_dtype
+
         self._op = op
         self._operand = operand
-        self._dtype = dt.BOOL if op == "~" else operand._dtype
+        self._dtype = unary_result_dtype(op, operand._dtype)
 
     def _children(self):
         return (self._operand,)

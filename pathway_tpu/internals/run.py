@@ -36,6 +36,31 @@ def run(
     if persistence_config is None:
         persistence_config = cfg.pathway_config.persistence_config
     pc = cfg.pathway_config
+    saved_typecheck = pc.runtime_typechecking
+    if runtime_typechecking is not None:
+        pc.runtime_typechecking = runtime_typechecking
+    try:
+        return _run_inner(
+            pc,
+            monitoring_level,
+            with_http_server,
+            autocommit_duration_ms,
+            persistence_config,
+        )
+    finally:
+        # per-run override, not a process-wide setting
+        pc.runtime_typechecking = saved_typecheck
+
+
+def _run_inner(
+    pc: Any,
+    monitoring_level: Any,
+    with_http_server: bool,
+    autocommit_duration_ms: int | None,
+    persistence_config: Any,
+):
+    from pathway_tpu.internals import config as cfg
+
     threads = max(1, pc.threads)
     processes = max(1, pc.processes)
     sched = Scheduler(

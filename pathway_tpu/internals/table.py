@@ -305,7 +305,10 @@ class Table:
         if async_idx:
             return self._select_async(names, exprs, layout, dtypes, in_node)
         row_fn = compile_exprs(exprs, layout)
-        node = eg.RowwiseNode(G.engine_graph, in_node, row_fn, name="select")
+        node = eg.RowwiseNode(
+            G.engine_graph, in_node, row_fn, name="select",
+            typecheck_info=(names, [dtypes[n] for n in names]),
+        )
         # select keeps row keys -> same universe token; new layout family
         return Table(
             node, names, dtypes, name=f"{self._name}.select",

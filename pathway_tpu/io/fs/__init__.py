@@ -131,9 +131,10 @@ class _FilesSource(RowSource):
                     events.add(key, row)
             else:
                 chunk.extend(zip(keys, coerced))
-                if len(chunk) >= _CHUNK:
-                    add_many(chunk)
-                    chunk = []
+                while len(chunk) >= _CHUNK:  # bounded add_many batches:
+                    # one queue item / snapshot record per _CHUNK rows
+                    add_many(chunk[:_CHUNK])
+                    chunk = chunk[_CHUNK:]
 
         # binary mode: byte-accurate offsets (text-mode tell() is unusable
         # with block reads), splitting on b"\n"; only COMPLETE lines are

@@ -116,7 +116,21 @@ class JittedEncoder:
             self._in_batch_sharding = None
             self._out_sharding = None
         self.params = params
-        self._apply = jax.jit(self.model.apply, out_shardings=self._out_sharding)
+        # token ids upload as int16 when the vocab permits (mask/type as
+        # uint8): 3x less host->device traffic per chunk, which is what
+        # bounds steady-state throughput on remote/tunneled backends; the
+        # cast back to int32 is fused into the compiled apply
+        self._narrow_ids = config.vocab_size < 2**15
+
+        def _apply_cast(params, ids, mask, tps):
+            return self.model.apply(
+                params,
+                ids.astype(jnp.int32),
+                mask.astype(jnp.int32),
+                tps.astype(jnp.int32),
+            )
+
+        self._apply = jax.jit(_apply_cast, out_shardings=self._out_sharding)
         self._dp = 1 if mesh is None else mesh.shape.get(data_axis, 1)
 
     # ------------------------------------------------------------------
@@ -140,6 +154,10 @@ class JittedEncoder:
         remote/tunneled backends the transfer of chunk i overlaps the
         tokenize+compute of chunk i+1."""
         ids, mask, tps, n = self._pad_batch(ids, mask, tps)
+        if self._narrow_ids:
+            ids = ids.astype(np.int16, copy=False)
+            mask = mask.astype(np.uint8, copy=False)
+            tps = tps.astype(np.uint8, copy=False)
         args = [jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(tps)]
         if self._in_batch_sharding is not None:
             args = [jax.device_put(a, self._in_batch_sharding) for a in args]

@@ -1,0 +1,177 @@
+"""Type system: build-time operator typing, the dtype lattice, and
+runtime typechecking (reference ``internals/type_interpreter.py``,
+``internals/dtype.py``, PATHWAY_RUNTIME_TYPECHECKING)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.type_interpreter import (
+    TypeInterpreterError,
+    binary_result_dtype,
+    unary_result_dtype,
+)
+from tests.utils import T
+
+
+# ---------------------------------------------------------------------------
+# build-time operator typing
+
+
+def test_str_plus_int_raises_at_build_time():
+    t = T(
+        """
+        name | age
+        ann  | 3
+        """
+    )
+    with pytest.raises(TypeInterpreterError, match="STR.*INT|INT.*STR"):
+        t.select(bad=t.name + t.age)
+
+
+def test_ordering_comparison_of_incompatible_types_raises():
+    t = T(
+        """
+        name | age
+        ann  | 3
+        """
+    )
+    with pytest.raises(TypeInterpreterError):
+        t.select(bad=t.name < t.age)
+
+
+def test_equality_is_total_and_arithmetic_promotes():
+    t = T(
+        """
+        name | age | w
+        ann  | 3   | 1.5
+        bob  | 4   | 2.5
+        """
+    )
+    out = t.select(
+        eq=t.name == t.age,   # equality allowed across types
+        f=t.age + t.w,        # INT + FLOAT -> FLOAT
+        d=t.age / t.age,      # / always FLOAT
+        n=-t.age,
+    )
+    assert out._dtypes["eq"] == dt.BOOL
+    assert out._dtypes["f"] == dt.FLOAT
+    assert out._dtypes["d"] == dt.FLOAT
+    assert out._dtypes["n"] == dt.INT
+    cap = out._capture_node()
+    ctx = pw.run()
+    rows = sorted(ctx.state(cap)["rows"].values())
+    assert rows == [(False, 4.5, 1.0, -3), (False, 6.5, 1.0, -4)]
+
+
+def test_datetime_duration_algebra():
+    assert (
+        binary_result_dtype("-", dt.DATE_TIME_NAIVE, dt.DATE_TIME_NAIVE)
+        == dt.DURATION
+    )
+    assert (
+        binary_result_dtype("+", dt.DATE_TIME_UTC, dt.DURATION)
+        == dt.DATE_TIME_UTC
+    )
+    assert binary_result_dtype("/", dt.DURATION, dt.DURATION) == dt.FLOAT
+    assert binary_result_dtype("//", dt.DURATION, dt.DURATION) == dt.INT
+    assert binary_result_dtype("*", dt.DURATION, dt.INT) == dt.DURATION
+    with pytest.raises(TypeInterpreterError):
+        binary_result_dtype("+", dt.DATE_TIME_NAIVE, dt.DATE_TIME_NAIVE)
+    with pytest.raises(TypeInterpreterError):
+        binary_result_dtype("-", dt.DURATION, dt.INT)
+
+
+def test_optional_propagates_through_ops():
+    res = binary_result_dtype("+", dt.Optional(dt.INT), dt.INT)
+    assert res == dt.Optional(dt.INT)
+    assert binary_result_dtype("==", dt.Optional(dt.STR), dt.STR) == dt.Optional(
+        dt.BOOL
+    )
+    assert unary_result_dtype("-", dt.Optional(dt.FLOAT)) == dt.Optional(dt.FLOAT)
+
+
+def test_any_is_an_escape_hatch():
+    # untyped columns never raise, like the reference
+    assert binary_result_dtype("+", dt.ANY, dt.STR) == dt.ANY
+    assert binary_result_dtype("<", dt.ANY, dt.INT) == dt.BOOL
+    assert binary_result_dtype("*", dt.STR, dt.INT) == dt.STR
+
+
+def test_bitwise_rules():
+    assert binary_result_dtype("&", dt.BOOL, dt.BOOL) == dt.BOOL
+    assert binary_result_dtype("|", dt.INT, dt.INT) == dt.INT
+    with pytest.raises(TypeInterpreterError):
+        binary_result_dtype("&", dt.STR, dt.BOOL)
+
+
+# ---------------------------------------------------------------------------
+# lattice
+
+
+def test_is_subtype_basics():
+    assert dt.is_subtype(dt.INT, dt.FLOAT)
+    assert dt.is_subtype(dt.BOOL, dt.INT)
+    assert not dt.is_subtype(dt.FLOAT, dt.INT)
+    assert dt.is_subtype(dt.INT, dt.Optional(dt.INT))
+    assert dt.is_subtype(dt.NONE, dt.Optional(dt.STR))
+    assert not dt.is_subtype(dt.Optional(dt.INT), dt.INT)
+    assert dt.is_subtype(dt.STR, dt.ANY)
+    assert dt.is_subtype(
+        dt.Tuple(dt.INT, dt.BOOL), dt.Tuple(dt.FLOAT, dt.INT)
+    )
+    assert dt.is_subtype(dt.Tuple(dt.INT, dt.INT), dt.List(dt.FLOAT))
+    assert dt.is_subtype(dt.Array(2, dt.INT), dt.Array(None, dt.FLOAT))
+    assert not dt.is_subtype(dt.Array(2, dt.INT), dt.Array(3, dt.INT))
+
+
+def test_types_lca_structure_aware():
+    assert dt.types_lca(dt.INT, dt.FLOAT) == dt.FLOAT
+    assert dt.types_lca(dt.NONE, dt.INT) == dt.Optional(dt.INT)
+    assert dt.types_lca(dt.Optional(dt.INT), dt.FLOAT) == dt.Optional(dt.FLOAT)
+    assert dt.types_lca(
+        dt.Tuple(dt.INT, dt.STR), dt.Tuple(dt.FLOAT, dt.STR)
+    ) == dt.Tuple(dt.FLOAT, dt.STR)
+    assert dt.types_lca(dt.Tuple(dt.INT), dt.Tuple(dt.INT, dt.INT)) == dt.List(
+        dt.INT
+    )
+    assert dt.types_lca(dt.STR, dt.INT) == dt.ANY
+
+
+# ---------------------------------------------------------------------------
+# runtime typechecking
+
+
+def test_runtime_typechecking_catches_bad_udf(monkeypatch):
+    @pw.udf
+    def lies(x: int) -> int:
+        return f"not an int {x}"  # type: ignore[return-value]
+
+    t = T(
+        """
+        v
+        1
+        """
+    )
+    out = t.select(r=lies(t.v))
+    out._capture_node()
+    with pytest.raises(TypeError, match="declared INT|declared"):
+        pw.run(runtime_typechecking=True)
+
+
+def test_runtime_typechecking_off_contains_quietly():
+    @pw.udf
+    def lies(x: int) -> int:
+        return f"not an int {x}"  # type: ignore[return-value]
+
+    t = T(
+        """
+        v
+        1
+        """
+    )
+    out = t.select(r=lies(t.v))
+    cap = out._capture_node()
+    ctx = pw.run(runtime_typechecking=False)
+    (row,) = ctx.state(cap)["rows"].values()
+    assert row == ("not an int 1",)  # dynamic by default, like the reference
