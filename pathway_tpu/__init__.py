@@ -145,10 +145,10 @@ def __getattr__(name: str) -> Any:
         from pathway_tpu.internals.interactive import enable_interactive_mode
 
         return enable_interactive_mode
-    if name == "LiveTable":
-        from pathway_tpu.internals.interactive import LiveTable
+    if name in ("LiveTable", "live", "export_table", "import_table", "ExportedTable"):
+        from pathway_tpu.internals import interactive
 
-        return LiveTable
+        return getattr(interactive, name)
     if name == "viz":
         import pathway_tpu.stdlib.viz as viz
 

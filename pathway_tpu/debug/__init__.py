@@ -66,7 +66,9 @@ def table_from_markdown(
     rows: list[list[Any]] = []
     for line in lines[1:]:
         cells = [c for c in split_line(line)]
-        rows.append([_parse_cell(c) for c in cells[: len(header)]])
+        row = [_parse_cell(c) for c in cells[: len(header)]]
+        row.extend([None] * (len(header) - len(row)))  # trailing empty cells
+        rows.append(row)
 
     has_id = "id" in header
     special = [c for c in ("__time__", "__diff__") if c in header]
@@ -132,16 +134,42 @@ class _StreamSubject:
         events.commit()
 
 
+def _occurrence_key(tag: str, row: tuple, diff: int, occupancy: dict) -> K.Pointer:
+    """Value-derived stream keys with multiset semantics: the n-th
+    outstanding addition of equal row values gets a distinct key, and a
+    retraction targets the LATEST outstanding occurrence — so duplicates
+    stay distinct rows AND ``__diff__=-1`` lines retract the row their
+    matching ``+1`` line added (sequential per-line keys would miss)."""
+    from pathway_tpu.engine.stream import hashable_row
+
+    h = hashable_row(row)
+    outstanding = occupancy.setdefault(h, [0, []])
+    if diff >= 0:
+        occ = outstanding[0]
+        outstanding[0] += 1
+        key = K.ref_scalar(tag, occ, *row)
+        outstanding[1].append(key)
+        return key
+    if outstanding[1]:
+        return outstanding[1].pop()
+    return K.ref_scalar(tag, 0, *row)  # retract-before-add
+
+
 def _stream_table_from_rows(
     header: list[str], rows: list[list[Any]], data_cols: list[str], has_id: bool, schema: Any
 ) -> Table:
     timed: list[tuple[int, K.Pointer, tuple, int]] = []
+    occupancy: dict = {}
     for i, r in enumerate(rows):
         vals = dict(zip(header, r))
-        t = int(vals.get("__time__", 0))
-        diff = int(vals.get("__diff__", 1))
-        key = K.ref_scalar(vals["id"]) if has_id else K.sequential_key(i)
-        timed.append((t, key, tuple(vals[c] for c in data_cols), diff))
+        t = int(vals.get("__time__") or 0)  # `or`: a padded None cell
+        diff = int(vals.get("__diff__") or 1)
+        row = tuple(vals[c] for c in data_cols)
+        if has_id:
+            key = K.ref_scalar(vals["id"])
+        else:
+            key = _occurrence_key("__md_stream__", row, diff, occupancy)
+        timed.append((t, key, row, diff))
     dtypes = _infer_dtypes(data_cols, [v for _, _, v, _ in timed], schema)
     node = eg.InputNode(
         G.engine_graph,
@@ -166,6 +194,7 @@ def table_from_rows(
     pk = schema.primary_key_columns()
     out_rows: list[tuple[K.Pointer, tuple]] = []
     timed: list[tuple[int, K.Pointer, tuple, int]] = []
+    occupancy: dict = {}
     for i, r in enumerate(rows):
         if is_stream:
             *vals, time_, diff = r
@@ -174,6 +203,8 @@ def table_from_rows(
             time_, diff = 0, 1
         if pk:
             key = K.ref_scalar(*[vals[cols.index(c)] for c in pk])
+        elif is_stream:
+            key = _occurrence_key("__rows_stream__", tuple(vals), diff, occupancy)
         else:
             key = K.sequential_key(i)
         if is_stream:

@@ -1252,6 +1252,74 @@ class OutputNode(Node):
             self._on_end()
 
 
+class ExportNode(Node):
+    """Cross-graph table export (reference ``ExportedTable``:
+    ``src/engine/dataflow/export.rs``, ``src/engine/graph.rs:630``): a
+    thread-safe update log with a closed-epoch frontier, offset reads, and
+    replay-then-live subscriptions.  Another graph imports it through
+    ``internals.interactive.import_table`` and continues from the stream."""
+
+    def __init__(self, graph: EngineGraph, input: Node, name: str = "export"):
+        import threading
+
+        super().__init__(graph, [input], name)
+        self._lock = threading.Lock()
+        self._log: list[tuple[int, Pointer, tuple, int]] = []
+        self._frontier = -1
+        self._closed = False
+        self._subs: list[Callable] = []
+
+    def exchange_routes(self):
+        return [cl.route_to_zero]
+
+    def process(self, ctx, time, inbatches):
+        batch = [(time, u.key, u.values, u.diff) for u in inbatches[0]]
+        # callbacks run UNDER the lock so delivery order matches log order
+        # and subscribe()'s replay-then-live handoff has no gap; callbacks
+        # must not call back into this export (they'd deadlock)
+        with self._lock:
+            self._log.extend(batch)
+            self._frontier = time
+            for cb in self._subs:
+                cb(batch, time)
+        return []
+
+    def on_end(self, ctx):
+        with self._lock:
+            self._closed = True
+
+    # --- reader side (any thread) ------------------------------------
+    def frontier(self) -> int:
+        """Last closed epoch exported so far (reference
+        ``ExportedTable::frontier``)."""
+        with self._lock:
+            return self._frontier
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def data_from_offset(
+        self, offset: int
+    ) -> tuple[list[tuple[int, Pointer, tuple, int]], int, int, bool]:
+        """Updates from ``offset`` on: (batch, next_offset, frontier,
+        closed) — reference ``ExportedTable::data_from_offset``."""
+        with self._lock:
+            batch = self._log[offset:]
+            return batch, len(self._log), self._frontier, self._closed
+
+    def subscribe(self, cb: Callable, replay: bool = True) -> None:
+        """``cb(batch, frontier)``; with ``replay`` the full history is
+        delivered first, atomically with registration (the history call
+        and all live deliveries happen under one lock, so no epoch can
+        slip between or around them)."""
+        with self._lock:
+            if replay and self._log:
+                cb(list(self._log), self._frontier)
+            self._subs.append(cb)
+
+
 class CaptureNode(Node):
     """Collects the final table state + full update stream (test/debug
     support — reference captured-stream test utilities)."""

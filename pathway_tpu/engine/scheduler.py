@@ -356,6 +356,11 @@ class Scheduler:
         self.ctx.consumed = consumed  # type: ignore[attr-defined]
         if self.persistence is not None:
             self.persistence.check_topology(1)
+            # collect every node's committed epochs FIRST, so replay can
+            # interleave sources on the recorded global timeline instead of
+            # draining one source's whole span before the next
+            pending: list[tuple[float, int, int, list[Update]]] = []
+            seq = 0
             for node in live_inputs:
                 events = self.persistence.replay_events(node)
                 data = [e for e in events if e[0] != "commit"]
@@ -374,15 +379,37 @@ class Scheduler:
                     continue
                 consumed[node.id] = len(data)
                 epoch: list[Update] = []
+                node_wall = float("-inf")  # carry-forward for old records
                 for kind, key, values in events:
                     if kind == "add":
                         epoch.append(Update(key, values, 1))
                     elif kind == "remove":
                         epoch.append(Update(key, values, -1))
-                    elif kind == "commit" and epoch:
-                        self.run_epoch(t, {node.id: epoch})
-                        t += TIME_STEP
-                        epoch = []
+                    elif kind == "commit":
+                        if isinstance(values, float):
+                            node_wall = values
+                        if epoch:
+                            pending.append((node_wall, seq, node.id, epoch))
+                            seq += 1
+                            epoch = []
+            # merge across sources by recorded commit wall clock (stable on
+            # ties / legacy records without timestamps)
+            pending.sort(key=lambda p: (p[0], p[1]))
+            prev_wall: float | None = None
+            for wall, _seq, node_id, batch in pending:
+                if (
+                    self.persistence.realtime_replay
+                    and wall != float("-inf")
+                ):
+                    # REALTIME_REPLAY honours recorded inter-commit gaps
+                    # (reference RealtimeReplay); SPEEDRUN and resume run
+                    # flat out.  Gaps cap at 5 s so a long-idle recording
+                    # stays usable.
+                    if prev_wall is not None and wall > prev_wall:
+                        _time.sleep(min(wall - prev_wall, 5.0))
+                    prev_wall = wall
+                self.run_epoch(t, {node_id: batch})
+                t += TIME_STEP
             if self.persistence.replay_only:
                 self.ctx.time = t
                 self._finish()

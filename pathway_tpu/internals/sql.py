@@ -21,6 +21,7 @@ __all__ = ["sql"]
 _TOKEN = re.compile(
     r"\s*(?:(?P<num>\d+\.\d+|\d+)"
     r"|(?P<str>'[^']*')"
+    r'|(?P<qname>"[^"]*")'  # quoted identifier: never a keyword
     r"|(?P<name>[A-Za-z_][A-Za-z_0-9]*)"
     r"|(?P<op><=|>=|<>|!=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.))"
 )
@@ -28,7 +29,8 @@ _TOKEN = re.compile(
 _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "as", "join",
     "inner", "left", "right", "outer", "on", "and", "or", "not", "union",
-    "all", "distinct",
+    "all", "distinct", "with", "in", "between", "like", "is", "null",
+    "case", "when", "then", "else", "end", "true", "false",
 }
 
 _AGGS = {"sum", "count", "avg", "min", "max"}
@@ -44,10 +46,12 @@ def _tokenize(src: str) -> list[tuple[str, str]]:
                 break
             raise ValueError(f"SQL syntax error near: {src[pos:pos+30]!r}")
         pos = m.end()
-        for kind in ("num", "str", "name", "op"):
+        for kind in ("num", "str", "qname", "name", "op"):
             v = m.group(kind)
             if v is not None:
-                if kind == "name" and v.lower() in _KEYWORDS:
+                if kind == "qname":
+                    out.append(("name", v[1:-1]))  # "end" -> plain identifier
+                elif kind == "name" and v.lower() in _KEYWORDS:
                     out.append(("kw", v.lower()))
                 else:
                     out.append((kind, v))
@@ -105,6 +109,43 @@ class _Parser:
         if k == "op" and v in ("=", "!=", "<>", "<", "<=", ">", ">="):
             self.eat()
             return ("cmp", v, left, self._add())
+        negated = False
+        if self.at_kw("not"):
+            # NOT between a value and IN/BETWEEN/LIKE binds to the operator
+            self.eat()
+            negated = True
+        if self.at_kw("in"):
+            self.eat()
+            self.eat("op", "(")
+            vals = [self.expr()]
+            while self.peek() == ("op", ","):
+                self.eat()
+                vals.append(self.expr())
+            self.eat("op", ")")
+            node = ("in", left, vals)
+            return ("not", node) if negated else node
+        if self.at_kw("between"):
+            self.eat()
+            lo = self._add()
+            self.eat("kw", "and")
+            hi = self._add()
+            node = ("between", left, lo, hi)
+            return ("not", node) if negated else node
+        if self.at_kw("like"):
+            self.eat()
+            pat = self._add()
+            node = ("like", left, pat)
+            return ("not", node) if negated else node
+        if negated:
+            raise ValueError("NOT here must precede IN/BETWEEN/LIKE")
+        if self.at_kw("is"):
+            self.eat()
+            neg = False
+            if self.at_kw("not"):
+                self.eat()
+                neg = True
+            self.eat("kw", "null")
+            return ("isnull", left, neg)
         return left
 
     def _add(self):
@@ -128,6 +169,29 @@ class _Parser:
             e = self.expr()
             self.eat("op", ")")
             return e
+        if k == "op" and v == "-":
+            self.eat()
+            return ("neg", self._atom())
+        if k == "kw" and v == "null":
+            self.eat()
+            return ("lit", None)
+        if k == "kw" and v in ("true", "false"):
+            self.eat()
+            return ("lit", v == "true")
+        if k == "kw" and v == "case":
+            self.eat()
+            whens = []
+            while self.at_kw("when"):
+                self.eat()
+                cond = self.expr()
+                self.eat("kw", "then")
+                whens.append((cond, self.expr()))
+            default = ("lit", None)
+            if self.at_kw("else"):
+                self.eat()
+                default = self.expr()
+            self.eat("kw", "end")
+            return ("case", whens, default)
         if k == "num":
             self.eat()
             return ("lit", float(v) if "." in v else int(v))
@@ -162,10 +226,39 @@ class _Parser:
         raise ValueError(f"unexpected token {v!r} in expression")
 
     # ---- statement ----
+    def statement(self) -> dict:
+        """Full statement: [WITH ctes] select [UNION [ALL] select]..."""
+        ctes = []
+        if self.at_kw("with"):
+            self.eat()
+            while True:
+                name = self.eat("name")
+                self.eat("kw", "as")
+                self.eat("op", "(")
+                ctes.append((name, self.select()))
+                self.eat("op", ")")
+                if self.peek() == ("op", ","):
+                    self.eat()
+                    continue
+                break
+        first = self.select()
+        unions = []
+        while self.at_kw("union"):
+            self.eat()
+            all_ = False
+            if self.at_kw("all"):
+                self.eat()
+                all_ = True
+            unions.append((all_, self.select()))
+        self.eat("end")
+        return {"ctes": ctes, "select": first, "unions": unions}
+
     def select(self) -> dict:
         self.eat("kw", "select")
+        distinct = False
         if self.at_kw("distinct"):
             self.eat()
+            distinct = True
         items = []
         while True:
             e = self.expr()
@@ -181,7 +274,16 @@ class _Parser:
                 continue
             break
         self.eat("kw", "from")
-        table = self.eat("name")
+        if self.peek() == ("op", "("):
+            # derived table: FROM (SELECT ...) [AS] alias
+            self.eat()
+            sub = self.select()
+            self.eat("op", ")")
+            if self.at_kw("as"):
+                self.eat()
+            table = ("subquery", sub, self.eat("name"))
+        else:
+            table = self.eat("name")
         joins = []
         while self.at_kw("join", "inner", "left", "right", "outer"):
             how = "inner"
@@ -208,7 +310,6 @@ class _Parser:
         if self.at_kw("having"):
             self.eat()
             having = self.expr()
-        self.eat("end")
         return {
             "items": items,
             "table": table,
@@ -216,6 +317,7 @@ class _Parser:
             "where": where,
             "group_by": group_by,
             "having": having,
+            "distinct": distinct,
         }
 
 
@@ -263,6 +365,56 @@ class _Translator:
             return _wrap(self.to_expr(ast[1], scope)) | _wrap(self.to_expr(ast[2], scope))
         if kind == "not":
             return ~_wrap(self.to_expr(ast[1], scope))
+        if kind == "neg":
+            return -_wrap(self.to_expr(ast[1], scope))
+        if kind == "in":
+            e = _wrap(self.to_expr(ast[1], scope))
+            out = None
+            for v_ast in ast[2]:
+                test = e == _wrap(self.to_expr(v_ast, scope))
+                out = test if out is None else (out | test)
+            return out
+        if kind == "between":
+            e = _wrap(self.to_expr(ast[1], scope))
+            lo = _wrap(self.to_expr(ast[2], scope))
+            hi = _wrap(self.to_expr(ast[3], scope))
+            return (e >= lo) & (e <= hi)
+        if kind == "like":
+            import re as _re
+
+            pat_ast = ast[2]
+            if pat_ast[0] != "lit" or not isinstance(pat_ast[1], str):
+                raise ValueError("LIKE pattern must be a string literal")
+            # SQL wildcards: % -> .*, _ -> . (everything else literal)
+            rx = _re.compile(
+                "^"
+                + "".join(
+                    ".*" if ch == "%" else "." if ch == "_" else _re.escape(ch)
+                    for ch in pat_ast[1]
+                )
+                + "$"
+            )
+            from pathway_tpu.internals.expression import apply_with_type
+
+            return apply_with_type(
+                lambda s, rx=rx: s is not None and rx.match(s) is not None,
+                bool,
+                _wrap(self.to_expr(ast[1], scope)),
+            )
+        if kind == "isnull":
+            e = _wrap(self.to_expr(ast[1], scope))
+            return e.is_not_none() if ast[2] else e.is_none()
+        if kind == "case":
+            from pathway_tpu.internals.expression import if_else
+
+            out = self.to_expr(ast[2], scope)  # ELSE (default NULL)
+            for cond_ast, then_ast in reversed(ast[1]):
+                out = if_else(
+                    _wrap(self.to_expr(cond_ast, scope)),
+                    _wrap(self.to_expr(then_ast, scope)),
+                    _wrap(out),
+                )
+            return out
         if kind == "call":
             name, args = ast[1], ast[2]
             if name in _AGGS:
@@ -284,14 +436,54 @@ class _Translator:
         return "expr"
 
 
+def _distinct(table: Table) -> Table:
+    """SELECT DISTINCT: one row per distinct value tuple."""
+    cols = table._column_names
+    return table.groupby(*[table[c] for c in cols]).reduce(
+        *[table[c] for c in cols]
+    )
+
+
 def sql(query: str, **tables: Table) -> Table:
     """Run a SQL query against keyword-named tables::
 
         pw.sql("SELECT owner, SUM(pets) AS total FROM t GROUP BY owner", t=t)
+
+    Supported: SELECT [DISTINCT] expressions/aliases/*, FROM (incl.
+    derived-table subqueries), WITH ctes, INNER/LEFT/RIGHT/OUTER JOIN ON
+    equality, WHERE, GROUP BY, HAVING, UNION [ALL], IN / BETWEEN / LIKE /
+    IS [NOT] NULL / CASE WHEN, and SUM/COUNT/AVG/MIN/MAX.
     """
-    ast = _Parser(_tokenize(query)).select()
+    stmt = _Parser(_tokenize(query)).statement()
+    env = dict(tables)
+    for name, sub_ast in stmt["ctes"]:
+        env[name] = _translate_select(sub_ast, env)
+    result = _translate_select(stmt["select"], env)
+    for all_, sub_ast in stmt["unions"]:
+        other = _translate_select(sub_ast, env)
+        if len(other._column_names) != len(result._column_names):
+            raise ValueError("UNION arms must have the same column count")
+        # positional column matching, then key-disjoint concat
+        renames = {
+            ln: other[rn]
+            for ln, rn in zip(result._column_names, other._column_names)
+        }
+        result = result.concat_reindex(other.select(**renames))
+        if not all_:
+            result = _distinct(result)
+    return result
+
+
+def _translate_select(ast: dict, tables: dict[str, Table]) -> Table:
+    tables = dict(tables)
     tr = _Translator(tables)
-    base = tables.get(ast["table"])
+    if isinstance(ast["table"], tuple):  # ("subquery", sub_ast, alias)
+        _tag, sub_ast, alias = ast["table"]
+        base = _translate_select(sub_ast, tables)
+        tables[alias] = base
+        tr = _Translator(tables)
+    else:
+        base = tables.get(ast["table"])
     if base is None:
         raise KeyError(f"unknown table {ast['table']!r} (pass it as a kwarg)")
 
@@ -357,7 +549,7 @@ def sql(query: str, **tables: Table) -> Table:
             if hidden:
                 keep = [c for c in result._column_names if c not in hidden]
                 result = result.select(**{c: result[c] for c in keep})
-        return result
+        return _distinct(result) if ast["distinct"] else result
 
     if any(_has_agg(e) for e, _ in items):
         outs = {}
@@ -367,7 +559,7 @@ def sql(query: str, **tables: Table) -> Table:
         return scope.reduce(**outs)
 
     if len(items) == 1 and items[0][0] == ("star",):
-        return scope
+        return _distinct(scope) if ast["distinct"] else scope
     outs = {}
     for e_ast, alias in items:
         if e_ast == ("star",):
@@ -376,4 +568,5 @@ def sql(query: str, **tables: Table) -> Table:
             continue
         name = alias or tr.default_name(e_ast)
         outs[name] = tr.to_expr(e_ast, scope)
-    return scope.select(**outs)
+    result = scope.select(**outs)
+    return _distinct(result) if ast["distinct"] else result

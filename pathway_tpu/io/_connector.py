@@ -189,6 +189,7 @@ def input_table(
     name: str = "connector",
     upsert: bool = False,
     auxiliary: bool = False,
+    persistent_id: str | None = None,
 ) -> Table:
     cols = schema.column_names()
     node = eg.InputNode(
@@ -203,6 +204,10 @@ def input_table(
     # run alive on their own; the scheduler exits when primaries close
     # and auxiliaries report no pending work
     node.auxiliary = auxiliary
+    # explicit snapshot identity (reference persistent_id): names the
+    # snapshot stream stably across graph edits, and opts the source into
+    # SELECTIVE_PERSISTING
+    node.persistent_id = persistent_id
     dtypes = {c: schema.__columns__[c].dtype for c in cols}
     return Table(node, cols, dtypes, name=name)
 

@@ -57,6 +57,7 @@ def read(
     with_metadata: bool = False,
     autocommit_duration_ms: int | None = 1500,
     name: str = "csv",
+    persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
     settings = csv_settings or CsvParserSettings()
@@ -89,7 +90,7 @@ def read(
         with_metadata=with_metadata,
         tag=f"csv:{path}",
     )
-    return input_table(src, schema, name=name)
+    return input_table(src, schema, name=name, persistent_id=persistent_id)
 
 
 class _CsvWriter(LazyFileWriter):

@@ -234,6 +234,7 @@ def read(
     with_metadata: bool = False,
     autocommit_duration_ms: int | None = 1500,
     name: str = "fs",
+    persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
     if format in ("plaintext", "plaintext_by_file", "binary"):
@@ -248,7 +249,9 @@ def read(
             str(path), schema, parse_line=parse_plain, mode=mode,
             with_metadata=with_metadata, tag=f"fs:{path}",
         )
-        return input_table(src, schema, name=name)
+        return input_table(
+            src, schema, name=name, persistent_id=persistent_id
+        )
     if format == "json" or format == "jsonlines":
         from pathway_tpu.io import jsonlines
 

@@ -31,6 +31,7 @@ def read(
     with_metadata: bool = False,
     autocommit_duration_ms: int | None = 1500,
     name: str = "jsonlines",
+    persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
     if schema is None:
@@ -89,7 +90,7 @@ def read(
         str(path), schema, parse_line=parse_line, parse_block=parse_block, mode=mode,
         with_metadata=with_metadata, tag=f"jsonlines:{path}",
     )
-    return input_table(source, schema, name=name)
+    return input_table(source, schema, name=name, persistent_id=persistent_id)
 
 
 class _JsonLinesWriter(LazyFileWriter):

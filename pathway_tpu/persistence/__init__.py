@@ -27,6 +27,7 @@ import logging
 import os
 import pickle
 import threading
+import time as _time
 from typing import Any
 
 __all__ = [
@@ -489,7 +490,9 @@ class _RecordingEvents:
 
             self._impl.append(
                 self._stream,
-                pickle.dumps(("commit", _conn._autogen_counter.peek(), None)),
+                pickle.dumps(
+                    ("commit", _conn._autogen_counter.peek(), _time.time())
+                ),
             )
             self._dirty = False
 
@@ -504,7 +507,9 @@ class _RecordingEvents:
 
             self._impl.append(
                 self._stream,
-                pickle.dumps(("commit", _conn._autogen_counter.peek(), None)),
+                pickle.dumps(
+                    ("commit", _conn._autogen_counter.peek(), _time.time())
+                ),
             )
             self._dirty = False
         self._inner.commit()
@@ -523,11 +528,28 @@ class PersistenceHooks:
             PersistenceMode.REALTIME_REPLAY,
             PersistenceMode.SPEEDRUN_REPLAY,
         )
+        #: replay honours recorded inter-commit wall-clock gaps
+        #: (reference PersistenceMode::RealtimeReplay); SPEEDRUN replays
+        #: as fast as possible
+        self.realtime_replay = (
+            config.persistence_mode == PersistenceMode.REALTIME_REPLAY
+        )
+        #: only sources with an explicit persistent_id are recorded
+        #: (reference PersistenceMode::SelectivePersisting)
+        self.selective = (
+            config.persistence_mode == PersistenceMode.SELECTIVE_PERSISTING
+        )
         #: persist compacted operator state so restart skips recomputation
         #: (reference src/persistence/operator_snapshot.rs:21-337)
         self.operator_mode = (
             config.persistence_mode == PersistenceMode.OPERATOR_PERSISTING
         )
+
+    def persisted(self, node: Any) -> bool:
+        """Whether this source participates in persistence at all."""
+        if self.selective:
+            return getattr(node, "persistent_id", None) is not None
+        return True
 
     # -- operator snapshots -------------------------------------------
     def save_operator_snapshot(
@@ -584,10 +606,12 @@ class PersistenceHooks:
     def stream_name(self, node: Any, worker: int = 0) -> str:
         # one snapshot stream per (input, worker): partitioned readers
         # record and resume independently (reference per-worker snapshot
-        # writers, src/persistence/tracker.rs)
-        if worker:
-            return f"input_{node.name}_{node.id}_w{worker}"
-        return f"input_{node.name}_{node.id}"
+        # writers, src/persistence/tracker.rs).  An explicit persistent_id
+        # names the stream stably across graph edits (reference
+        # persistent-id management, src/persistence/tracker.rs:26-63)
+        pid = getattr(node, "persistent_id", None)
+        base = f"input_pid_{pid}" if pid else f"input_{node.name}_{node.id}"
+        return f"{base}_w{worker}" if worker else base
 
     @staticmethod
     def _replayable(node: Any) -> bool:
@@ -610,6 +634,8 @@ class PersistenceHooks:
         replaying a recorded copy as well would double-count them."""
         if getattr(node, "auxiliary", False):
             return []
+        if not self.persisted(node):
+            return []  # SELECTIVE_PERSISTING: no persistent_id, no snapshot
         stream = self.stream_name(node, worker)
         records = [pickle.loads(r) for r in self.impl.read_all(stream)]
         last_commit = -1
@@ -648,6 +674,8 @@ class PersistenceHooks:
             return events
         if getattr(node, "auxiliary", False):
             return events  # loopbacks are never recorded (see replay_events)
+        if not self.persisted(node):
+            return events  # SELECTIVE_PERSISTING: source opted out
         if replayed and not self._replayable(node):
             # Non-deterministic reader: it will NOT re-emit its history, so
             # nothing is skipped.  Readers that track their own positions

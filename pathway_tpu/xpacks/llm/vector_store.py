@@ -81,10 +81,53 @@ class VectorStoreServer:
     def from_llamaindex_components(
         cls, *docs: Table, transformations: list, **kwargs: Any
     ) -> "VectorStoreServer":
-        """reference ``vector_store.py:137``"""
-        raise NotImplementedError(
-            "llama_index is unavailable in this environment"
-        )
+        """Build from a llama_index transformation pipeline (reference
+        ``vector_store.py:137``).  Duck-typed like the langchain adapter —
+        no llama_index import: the embedding component is recognised by
+        ``get_text_embedding`` (BaseEmbedding protocol), text splitters by
+        ``split_text`` (NodeParser/TextSplitter protocol)."""
+        from pathway_tpu.internals.udfs import udf
+
+        embed_component = None
+        split_components = []
+        for tr in transformations:
+            if hasattr(tr, "get_text_embedding"):
+                if embed_component is not None:
+                    raise ValueError(
+                        "transformations contain more than one embedding "
+                        "component (get_text_embedding)"
+                    )
+                embed_component = tr
+            elif hasattr(tr, "split_text"):
+                split_components.append(tr)
+            else:
+                raise ValueError(
+                    f"unsupported llama_index transformation {tr!r}: expected "
+                    "an embedding (get_text_embedding) or a text splitter "
+                    "(split_text)"
+                )
+        if embed_component is None:
+            raise ValueError(
+                "transformations must include an embedding component "
+                "(get_text_embedding)"
+            )
+
+        @udf
+        def li_embed(text: str) -> Any:
+            return embed_component.get_text_embedding(text)
+
+        li_split = None
+        if split_components:
+
+            @udf
+            def li_split(text: str) -> list[tuple[str, dict]]:  # noqa: F811
+                chunks = [text]
+                for sp in split_components:  # chained splitters, in order
+                    chunks = [c for ch in chunks for c in sp.split_text(ch)]
+                return [(c, {}) for c in chunks]
+
+        factory = BruteForceKnnFactory(embedder=li_embed)
+        return cls(*docs, index_factory=factory, splitter=li_split, **kwargs)
 
     def run_server(
         self,

@@ -1,0 +1,91 @@
+"""Interactive mode: cross-graph export/import and LiveTable
+(reference ``internals/interactive.py:37-222``, engine export
+``src/engine/dataflow/export.rs``)."""
+
+import threading
+import time
+
+import pathway_tpu as pw
+from pathway_tpu.internals.parse_graph import G
+from tests.utils import T
+
+
+def test_export_snapshot_and_offsets():
+    t = T(
+        """
+        a | b
+        1 | x
+        2 | y
+        """
+    )
+    exp = pw.export_table(t.select(t.a, t.b))
+    pw.run()
+    assert exp.closed
+    snap = sorted(exp.snapshot().values())
+    assert snap == [(1, "x"), (2, "y")]
+    batch, off, frontier, closed = exp.data_from_offset(0)
+    assert len(batch) == 2 and closed and off == 2
+    assert all(d == 1 for _t, _k, _v, d in batch)
+    # incremental read from the end is empty
+    batch2, off2, _, _ = exp.data_from_offset(off)
+    assert batch2 == [] and off2 == off
+
+
+def test_import_into_second_graph_preserves_keys_and_dtypes():
+    t = T(
+        """
+        a | b
+        1 | x
+        2 | y
+        3 | z
+        """
+    )
+    exp = pw.export_table(t.select(t.a, t.b))
+    pw.run()
+    first_keys = set(exp.snapshot().keys())
+
+    # a brand-new graph continues from the exported stream
+    G.clear()
+    imported = pw.import_table(exp)
+    assert imported._dtypes["a"].name == "INT"
+    filtered = imported.filter(imported.a >= 2).select(imported.a, imported.b)
+    cap = filtered._capture_node()
+    ctx = pw.run()
+    rows = ctx.state(cap)["rows"]
+    assert sorted(rows.values()) == [(2, "y"), (3, "z")]
+    assert set(rows.keys()) <= first_keys  # row keys preserved across graphs
+
+
+def test_live_table_streams_and_waits():
+    pw.enable_interactive_mode()
+    t = pw.debug.table_from_markdown(
+        """
+        w | v | __time__ | __diff__
+        x | 1 | 2        | 1
+        y | 2 | 4        | 1
+        x | 1 | 6        | -1
+        """
+    )
+    agg = t.select(t.w, t.v)
+    lt = pw.LiveTable(agg)
+    done = {}
+
+    def runner():
+        done["ctx"] = pw.run()
+
+    th = threading.Thread(target=runner, daemon=True)
+    th.start()
+    th.join(30)
+    assert not th.is_alive()
+    assert lt.wait_closed(10)
+    snap = lt.snapshot()
+    assert sorted(snap.values()) == [("y", 2)]
+    hist = lt.update_history()
+    assert [(v, d) for _t, _k, v, d in hist] == [
+        (("x", 1), 1),
+        (("y", 2), 1),
+        (("x", 1), -1),
+    ]
+    assert len(lt) == 1
+    df = lt.to_pandas()
+    assert list(df.columns) == ["w", "v"] and len(df) == 1

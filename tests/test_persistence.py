@@ -99,6 +99,112 @@ def test_replay_mode_reproduces_without_source(tmp_path):
     assert results2 == {"x": 2, "y": 1}
 
 
+def test_selective_persisting_only_with_persistent_id(tmp_path):
+    """SELECTIVE_PERSISTING: sources without an explicit persistent_id are
+    neither recorded nor replayed (reference
+    PersistenceMode::SelectivePersisting); named sources keep the full
+    record/resume contract, under a stream named by the id."""
+    input_file = tmp_path / "words.jsonl"
+    input_file.write_text('{"word": "a"}\n{"word": "b"}')
+
+    def build(results):
+        named = pw.io.jsonlines.read(
+            str(input_file), schema=WordSchema, mode="static",
+            persistent_id="words_src",
+        )
+        anon = pw.io.jsonlines.read(
+            str(input_file), schema=WordSchema, mode="static"
+        )
+        both = named.concat_reindex(anon)
+        counts = both.groupby(both.word).reduce(
+            both.word, n=pw.reducers.count()
+        )
+
+        def on_change(key, row, time, is_addition):
+            if is_addition:
+                results[row["word"]] = row["n"]
+
+        pw.io.subscribe(counts, on_change=on_change)
+
+    def run():
+        sched = Scheduler(G.engine_graph, autocommit_ms=10)
+        attach_persistence(
+            sched,
+            Config.simple_config(
+                Backend.filesystem(tmp_path / "snapshots"),
+                persistence_mode=PersistenceMode.SELECTIVE_PERSISTING,
+            ),
+        )
+        sched.run()
+
+    results1: dict = {}
+    build(results1)
+    run()
+    assert results1 == {"a": 2, "b": 2}
+    # only the named source got a snapshot stream, keyed by its id
+    logs = [p.name for p in (tmp_path / "snapshots").iterdir()]
+    assert any("input_pid_words_src" in n for n in logs)
+    assert not any("jsonlines" in n for n in logs)
+
+    # restart: the named source resumes (no double-count), the anonymous
+    # one re-reads from scratch
+    G.clear()
+    results2: dict = {}
+    build(results2)
+    run()
+    assert results2 == {"a": 2, "b": 2}
+
+
+def test_realtime_replay_honours_recorded_gaps(tmp_path):
+    """REALTIME_REPLAY sleeps the recorded inter-commit wall gaps;
+    SPEEDRUN replays the same log flat out."""
+    import time as _t
+
+    from pathway_tpu.io.python import ConnectorSubject
+
+    class SlowSource(ConnectorSubject):
+        def run(self):
+            self.next(word="x")
+            self.commit()
+            _t.sleep(0.4)
+            self.next(word="y")
+            self.commit()
+
+    def record():
+        t = pw.io.python.read(SlowSource(), schema=WordSchema)
+        counts = t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+        counts._capture_node()
+        sched = Scheduler(G.engine_graph, autocommit_ms=10)
+        attach_persistence(
+            sched, Config.simple_config(Backend.filesystem(tmp_path / "snap"))
+        )
+        sched.run()
+
+    record()
+
+    def replay(mode):
+        G.clear()
+        t = pw.io.python.read(SlowSource(), schema=WordSchema)
+        counts = t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+        cap = counts._capture_node()
+        sched = Scheduler(G.engine_graph, autocommit_ms=10)
+        attach_persistence(
+            sched,
+            Config.simple_config(
+                Backend.filesystem(tmp_path / "snap"), persistence_mode=mode
+            ),
+        )
+        t0 = _t.monotonic()
+        ctx = sched.run()
+        return _t.monotonic() - t0, ctx.state(cap)["rows"]
+
+    fast_dt, fast_rows = replay(PersistenceMode.SPEEDRUN_REPLAY)
+    slow_dt, slow_rows = replay(PersistenceMode.REALTIME_REPLAY)
+    assert sorted(fast_rows.values()) == sorted(slow_rows.values())
+    assert sorted(v for v in fast_rows.values()) == [("x", 1), ("y", 1)]
+    assert slow_dt >= fast_dt + 0.25  # the recorded ~0.4 s gap was honoured
+
+
 def test_memory_backend_roundtrip():
     b = Backend.memory(namespace="test_roundtrip")
     b._impl.append("s1", b"one")

@@ -280,6 +280,81 @@ def test_sql_having_restated_aggregate():
     assert run_to_rows(res) == [("alice", 4)]
 
 
+def test_sql_distinct_union_subquery():
+    t = T(
+        """
+    a | b
+    1 | x
+    1 | x
+    2 | y
+    """
+    )
+    res = pw.sql("SELECT DISTINCT a, b FROM t", t=t)
+    assert sorted(run_to_rows(res)) == [(1, "x"), (2, "y")]
+
+    u = pw.sql(
+        "SELECT a FROM t WHERE b = 'x' UNION SELECT a FROM t WHERE a = 2",
+        t=t,
+    )
+    assert sorted(run_to_rows(u)) == [(1,), (2,)]
+
+    ua = pw.sql(
+        "SELECT a FROM t WHERE a = 2 UNION ALL SELECT a FROM t WHERE a = 2",
+        t=t,
+    )
+    assert sorted(run_to_rows(ua)) == [(2,), (2,)]
+
+    sub = pw.sql(
+        "SELECT big.a AS a FROM (SELECT a FROM t WHERE a > 1) AS big",
+        t=t,
+    )
+    assert sorted(run_to_rows(sub)) == [(2,)]
+
+
+def test_sql_cte_case_in_between_like_null():
+    t = T(
+        """
+    name  | score
+    ann   | 10
+    bob   | 25
+    carol | 40
+    """
+    )
+    res = pw.sql(
+        """
+        WITH ranked AS (
+            SELECT name,
+                   CASE WHEN score >= 30 THEN 'high'
+                        WHEN score BETWEEN 15 AND 30 THEN 'mid'
+                        ELSE 'low' END AS tier
+            FROM t
+        )
+        SELECT name, tier FROM ranked WHERE tier IN ('high', 'mid')
+        """,
+        t=t,
+    )
+    assert sorted(run_to_rows(res)) == [("bob", "mid"), ("carol", "high")]
+
+    like = pw.sql("SELECT name FROM t WHERE name LIKE 'c%l'", t=t)
+    assert run_to_rows(like) == [("carol",)]
+
+    notlike = pw.sql("SELECT name FROM t WHERE name NOT LIKE '%o%'", t=t)
+    assert run_to_rows(notlike) == [("ann",)]
+
+    # IS NULL over an optional column
+    t2 = T(
+        """
+    v | w
+    1 |
+    2 | x
+    """
+    )
+    isnull = pw.sql("SELECT v FROM t2 WHERE w IS NULL", t2=t2)
+    assert run_to_rows(isnull) == [(1,)]
+    notnull = pw.sql("SELECT v FROM t2 WHERE w IS NOT NULL", t2=t2)
+    assert run_to_rows(notnull) == [(2,)]
+
+
 def test_yaml_forward_reference():
     cfg = pw.load_yaml(
         """

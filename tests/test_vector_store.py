@@ -79,3 +79,51 @@ def test_vector_store_server_roundtrip():
 
     G.active_scheduler.stop()
     thread.join(timeout=5)
+
+
+def test_from_llamaindex_components_duck_typed():
+    """The llama_index adapter works against the protocol alone (no
+    llama_index import): get_text_embedding + split_text."""
+    import numpy as np
+
+    class FakeEmbedding:
+        def get_text_embedding(self, text):
+            rng = np.random.default_rng(abs(hash(text)) % 2**32)
+            v = rng.normal(size=16)
+            return (v / np.linalg.norm(v)).tolist()
+
+    class FakeSplitter:
+        def split_text(self, text):
+            mid = max(1, len(text) // 2)
+            return [text[:mid], text[mid:]]
+
+    docs = T(
+        """
+    data
+    bananas are yellow
+    apples are red
+    """
+    ).select(data=pw.this.data)
+    server = VectorStoreServer.from_llamaindex_components(
+        docs, transformations=[FakeSplitter(), FakeEmbedding()]
+    )
+    retrieved = server.document_store.retrieve_query(
+        T(
+            """
+    query | k
+    bananas are yellow | 2
+    """
+        ).select(query=pw.this.query, k=pw.this.k, metadata_filter=None, filepath_globpattern=None)
+    )
+    cap = retrieved._capture_node()
+    ctx = pw.run(monitoring_level=pw.internals.run.MonitoringLevel.NONE)
+    (row,) = ctx.state(cap)["rows"].values()
+    docs_out = row[-1]  # the `result` column
+    assert len(docs_out) == 2  # two split chunks retrieved
+    # unsupported transformation types are rejected loudly
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="unsupported"):
+        VectorStoreServer.from_llamaindex_components(
+            docs, transformations=[object()]
+        )
