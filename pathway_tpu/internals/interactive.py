@@ -153,37 +153,39 @@ class LiveTable:
     def __init__(self, table: Table):
         self._columns = table._column_names
         self.rows: dict[Any, tuple] = {}
-        self.history: list[tuple[int, Any, tuple, int]] = []
         self._lock = threading.Lock()
         self._changed = threading.Condition(self._lock)
+        #: mirrored under OUR lock so wait() never touches the ExportNode
+        #: lock (the engine thread holds that lock while delivering to
+        #: _on_batch, which takes ours — reading through would AB-BA)
+        self._frontier = -1
         self._exported = export_table(table)
         self._exported.subscribe(self._on_batch, replay=True)
 
     def _on_batch(self, batch: list, frontier: int) -> None:
         with self._changed:
-            for t, key, values, diff in batch:
-                self.history.append((t, key, values, diff))
+            for _t, key, values, diff in batch:
                 if diff > 0:
                     self.rows[key] = values
                 else:
                     self.rows.pop(key, None)
+            self._frontier = max(self._frontier, frontier)
             self._changed.notify_all()
 
     # -- synchronisation ------------------------------------------------
     def frontier(self) -> int:
-        return self._exported.frontier()
+        with self._lock:
+            return self._frontier
 
     def wait(self, epoch: int, timeout: float = 30.0) -> bool:
         """Block until the exported frontier reaches ``epoch``."""
         deadline = _time.monotonic() + timeout
         with self._changed:
-            while self._exported.frontier() < epoch:
+            while self._frontier < epoch:
                 left = deadline - _time.monotonic()
-                if left <= 0 or not self._changed.wait(min(left, 0.5)):
-                    if self._exported.frontier() >= epoch:
-                        return True
-                    if _time.monotonic() >= deadline:
-                        return False
+                if left <= 0:
+                    return False
+                self._changed.wait(min(left, 0.5))
         return True
 
     def wait_closed(self, timeout: float = 30.0) -> bool:
@@ -201,9 +203,9 @@ class LiveTable:
             return dict(self.rows)
 
     def update_history(self) -> list[tuple[int, Any, tuple, int]]:
-        """The full (time, key, values, diff) update stream so far."""
-        with self._lock:
-            return list(self.history)
+        """The full (time, key, values, diff) update stream so far (read
+        from the export log — not duplicated here)."""
+        return self._exported.data_from_offset(0)[0]
 
     def to_pandas(self):
         import pandas as pd

@@ -368,12 +368,16 @@ class _Translator:
         if kind == "neg":
             return -_wrap(self.to_expr(ast[1], scope))
         if kind == "in":
+            from pathway_tpu.internals.expression import if_else
+
             e = _wrap(self.to_expr(ast[1], scope))
             out = None
             for v_ast in ast[2]:
                 test = e == _wrap(self.to_expr(v_ast, scope))
                 out = test if out is None else (out | test)
-            return out
+            # SQL three-valued logic: NULL IN (...) is NULL, so NOT IN
+            # keeps excluding NULL rows (None drops in filters either way)
+            return if_else(e.is_none(), _wrap(None), _wrap(out))
         if kind == "between":
             e = _wrap(self.to_expr(ast[1], scope))
             lo = _wrap(self.to_expr(ast[2], scope))
@@ -396,8 +400,9 @@ class _Translator:
             )
             from pathway_tpu.internals.expression import apply_with_type
 
+            # NULL LIKE p is NULL (so NOT LIKE excludes NULL rows too)
             return apply_with_type(
-                lambda s, rx=rx: s is not None and rx.match(s) is not None,
+                lambda s, rx=rx: None if s is None else rx.match(s) is not None,
                 bool,
                 _wrap(self.to_expr(ast[1], scope)),
             )

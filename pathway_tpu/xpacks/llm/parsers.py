@@ -8,6 +8,7 @@ gated on their optional packages.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Callable
 
 from pathway_tpu.internals.udfs import UDF
@@ -75,21 +76,38 @@ class ParseUnstructured(_GatedParser):
 UnstructuredParser = ParseUnstructured
 
 
-class PypdfParser(_GatedParser):
-    """reference ``parsers.py:746`` (pypdf)"""
+class PypdfParser(UDF):
+    """PDF-to-text parser (reference ``parsers.py:746``).  Uses ``pypdf``
+    when installed; otherwise falls back to the built-in extractor
+    (``_pdf.extract_pdf_text``: FlateDecode streams + BT/ET text
+    operators), which covers ordinary text PDFs without any dependency."""
 
-    _pkg = "pypdf"
+    def __init__(self, apply_text_cleanup: bool = True, **kwargs: Any):
+        super().__init__()
+        self.apply_text_cleanup = apply_text_cleanup
+
+    @staticmethod
+    def _cleanup(text: str) -> str:
+        text = re.sub(r"[ \t]+", " ", text)
+        return "\n".join(ln.strip() for ln in text.splitlines()).strip()
 
     def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
-        import io
+        try:
+            import io
 
-        from pypdf import PdfReader
+            from pypdf import PdfReader
 
-        reader = PdfReader(io.BytesIO(contents))
-        return [
-            (page.extract_text() or "", {"page": i})
-            for i, page in enumerate(reader.pages)
-        ]
+            pages = [
+                page.extract_text() or ""
+                for page in PdfReader(io.BytesIO(contents)).pages
+            ]
+        except ImportError:
+            from pathway_tpu.xpacks.llm._pdf import extract_pdf_text
+
+            pages = extract_pdf_text(contents)
+        if self.apply_text_cleanup:
+            pages = [self._cleanup(p) for p in pages]
+        return [(p, {"page": i}) for i, p in enumerate(pages) if p]
 
 
 class ImageParser(UDF):

@@ -1,0 +1,74 @@
+"""LLM xpack parsers — incl. the built-in PDF text extractor
+(reference ``xpacks/llm/parsers.py``; pypdf-free fallback in
+``xpacks/llm/_pdf.py``)."""
+
+import zlib
+
+from pathway_tpu.xpacks.llm.parsers import ParseUtf8, PypdfParser
+from pathway_tpu.xpacks.llm._pdf import extract_pdf_text
+
+
+def _minimal_pdf(content: bytes, compress: bool) -> bytes:
+    """A structurally plausible one-page PDF around ``content``."""
+    if compress:
+        data = zlib.compress(content)
+        filt = b"/Filter /FlateDecode "
+    else:
+        data = content
+        filt = b""
+    stream = (
+        b"5 0 obj\n<< " + filt + b"/Length " + str(len(data)).encode()
+        + b" >>\nstream\n" + data + b"\nendstream\nendobj\n"
+    )
+    return (
+        b"%PDF-1.4\n"
+        b"1 0 obj\n<< /Type /Catalog /Pages 2 0 R >>\nendobj\n"
+        b"2 0 obj\n<< /Type /Pages /Kids [3 0 R] /Count 1 >>\nendobj\n"
+        b"3 0 obj\n<< /Type /Page /Parent 2 0 R /Contents 5 0 R >>\nendobj\n"
+        + stream
+        + b"trailer\n<< /Root 1 0 R >>\n%%EOF\n"
+    )
+
+
+CONTENT = (
+    b"BT /F1 12 Tf 72 720 Td (Hello PDF world) Tj "
+    b"0 -14 Td [(Numbers: ) -250 (1 and 2)] TJ "
+    b"T* (escaped \\(parens\\) and \\134backslash) Tj ET"
+)
+
+
+def test_extract_uncompressed_pdf():
+    pages = extract_pdf_text(_minimal_pdf(CONTENT, compress=False))
+    assert len(pages) == 1
+    text = pages[0]
+    assert "Hello PDF world" in text
+    assert "Numbers: 1 and 2" in text
+    assert "escaped (parens) and \\backslash" in text
+
+
+def test_extract_flate_pdf_and_hex_strings():
+    content = (
+        b"BT (plain) Tj 0 -14 Td <48692068657821> Tj ET"  # "Hi hex!"
+    )
+    pages = extract_pdf_text(_minimal_pdf(content, compress=True))
+    assert pages == ["plain\nHi hex!"]
+
+
+def test_extract_rejects_non_pdf():
+    import pytest
+
+    with pytest.raises(ValueError, match="PDF"):
+        extract_pdf_text(b"plain text, no header")
+
+
+def test_pypdf_parser_udf_fallback_path():
+    parser = PypdfParser()
+    out = parser.__wrapped__(_minimal_pdf(CONTENT, compress=True))
+    assert len(out) == 1
+    text, meta = out[0]
+    assert "Hello PDF world" in text and meta == {"page": 0}
+
+
+def test_parse_utf8():
+    out = ParseUtf8().__wrapped__("héllo".encode())
+    assert out[0][0] == "héllo"

@@ -353,6 +353,11 @@ def test_sql_cte_case_in_between_like_null():
     assert run_to_rows(isnull) == [(1,)]
     notnull = pw.sql("SELECT v FROM t2 WHERE w IS NOT NULL", t2=t2)
     assert run_to_rows(notnull) == [(2,)]
+    # three-valued logic: NULL NOT LIKE / NOT IN excludes the NULL row
+    nl = pw.sql("SELECT v FROM t2 WHERE w NOT LIKE 'z%'", t2=t2)
+    assert run_to_rows(nl) == [(2,)]
+    ni = pw.sql("SELECT v FROM t2 WHERE w NOT IN ('zzz')", t2=t2)
+    assert run_to_rows(ni) == [(2,)]
 
 
 def test_yaml_forward_reference():
@@ -612,3 +617,21 @@ def test_operator_probes_and_connector_counters():
     text = _metrics_text(sched)
     assert "pathway_tpu_connector_rows_total" in text
     assert 'pathway_tpu_operator_latency_ms_total{operator="groupby' in text
+
+
+def test_viz_live_plot_svg():
+    t = T(
+        """
+    x | y  | z
+    1 | 10 | a
+    2 | 40 | b
+    3 | 25 | c
+    """
+    )
+    view = pw.viz.plot(t, sorting_col="x")
+    pw.run(monitoring_level=pw.internals.run.MonitoringLevel.NONE)
+    svg = view.to_svg()
+    assert svg.startswith("<svg") and "polyline" in svg
+    assert ">y<" in svg  # numeric series labelled
+    html = view._repr_html_()
+    assert html == svg
