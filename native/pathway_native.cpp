@@ -34,6 +34,8 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <string>
+#include <unordered_map>
 #include <vector>
 
 #include "blake2b.h"
@@ -1148,6 +1150,193 @@ fail:
     return nullptr;
 }
 
+// --------------------------------------------------------------------------
+// WordPiece tokenization (ASCII fast path)
+//
+// The BERT tokenize pipeline (models/wordpiece.py) is the host-side
+// bottleneck of the embedding path.  This implements the exact pipeline
+// for ASCII text — clean/control/whitespace handling, lowercasing,
+// punctuation splitting, greedy longest-match-first WordPiece — in one C
+// pass per text; non-ASCII texts return None so the caller falls back to
+// the Python implementation per text (identical output either way: on
+// ASCII input NFD accent-stripping and CJK spacing are no-ops).
+
+struct WpVocab {
+    std::unordered_map<std::string, int> map;
+    int unk;
+    int max_chars;
+    size_t max_token_len = 0;  // longest vocab entry, bounds the scan
+};
+
+void wp_free(PyObject* cap) {
+    delete static_cast<WpVocab*>(PyCapsule_GetPointer(cap, "pw.wordpiece"));
+}
+
+bool wp_is_punct(unsigned char c) {
+    return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+           (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+PyObject* py_wp_build(PyObject*, PyObject* args) {
+    PyObject* vocab;
+    int unk, max_chars;
+    if (!PyArg_ParseTuple(args, "Oii", &vocab, &unk, &max_chars))
+        return nullptr;
+    if (!PyDict_Check(vocab)) {
+        PyErr_SetString(PyExc_TypeError, "vocab must be a dict");
+        return nullptr;
+    }
+    auto* wv = new WpVocab{{}, unk, max_chars};
+    wv->map.reserve((size_t)PyDict_Size(vocab) * 2);
+    Py_ssize_t pos = 0;
+    PyObject *k, *v;
+    while (PyDict_Next(vocab, &pos, &k, &v)) {
+        Py_ssize_t n;
+        const char* s = PyUnicode_AsUTF8AndSize(k, &n);
+        if (s == nullptr) {
+            delete wv;
+            return nullptr;
+        }
+        long id = PyLong_AsLong(v);
+        if (id == -1 && PyErr_Occurred()) {
+            delete wv;
+            return nullptr;
+        }
+        wv->map.emplace(std::string(s, (size_t)n), (int)id);
+        if ((size_t)n > wv->max_token_len) wv->max_token_len = (size_t)n;
+    }
+    return PyCapsule_New(wv, "pw.wordpiece", wp_free);
+}
+
+// greedy longest-match-first over one word; appends ids or a single unk
+void wp_word(const WpVocab& wv, const std::string& word,
+             std::vector<int>& out) {
+    if ((int)word.size() > wv.max_chars) {
+        out.push_back(wv.unk);
+        return;
+    }
+    size_t start = 0;
+    size_t base = out.size();
+    std::string piece;
+    while (start < word.size()) {
+        size_t end = word.size();
+        // longest vocab entry bounds the window ("##" adds 2 bytes)
+        size_t limit = start + wv.max_token_len;
+        if (end > limit) end = limit;
+        int cur = -1;
+        size_t cur_end = 0;
+        while (end > start) {
+            piece.clear();
+            if (start > 0) piece = "##";
+            piece.append(word, start, end - start);
+            auto it = wv.map.find(piece);
+            if (it != wv.map.end()) {
+                cur = it->second;
+                cur_end = end;
+                break;
+            }
+            end--;
+        }
+        if (cur < 0) {
+            out.resize(base);
+            out.push_back(wv.unk);
+            return;
+        }
+        out.push_back(cur);
+        start = cur_end;
+    }
+}
+
+PyObject* py_wp_encode(PyObject*, PyObject* args) {
+    PyObject *cap, *texts;
+    int lower;
+    if (!PyArg_ParseTuple(args, "OOp", &cap, &texts, &lower)) return nullptr;
+    auto* wv =
+        static_cast<WpVocab*>(PyCapsule_GetPointer(cap, "pw.wordpiece"));
+    if (wv == nullptr) return nullptr;
+    PyObject* seq = PySequence_Fast(texts, "texts must be a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(n);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    std::vector<int> ids;
+    std::string word;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* text = PySequence_Fast_GET_ITEM(seq, i);
+        Py_ssize_t len;
+        const char* s =
+            PyUnicode_Check(text) ? PyUnicode_AsUTF8AndSize(text, &len)
+                                  : nullptr;
+        if (s == nullptr) {
+            PyErr_Clear();
+            Py_INCREF(Py_None);  // non-string: python path decides
+            PyList_SET_ITEM(out, i, Py_None);
+            continue;
+        }
+        bool ascii = true;
+        for (Py_ssize_t j = 0; j < len; j++) {
+            if ((unsigned char)s[j] >= 0x80) {
+                ascii = false;
+                break;
+            }
+        }
+        if (!ascii) {
+            Py_INCREF(Py_None);  // python fallback handles unicode rules
+            PyList_SET_ITEM(out, i, Py_None);
+            continue;
+        }
+        ids.clear();
+        word.clear();
+        for (Py_ssize_t j = 0; j <= len; j++) {
+            unsigned char c = j < len ? (unsigned char)s[j] : ' ';
+            if (c == 0 || (c < 0x20 && c != '\t' && c != '\n' && c != '\r') ||
+                c == 0x7f)
+                continue;  // _clean drops controls
+            if (c == ' ' || c == '\t' || c == '\n' || c == '\r') {
+                if (!word.empty()) {
+                    wp_word(*wv, word, ids);
+                    word.clear();
+                }
+                continue;
+            }
+            if (lower && c >= 'A' && c <= 'Z') c = (unsigned char)(c + 32);
+            if (wp_is_punct(c)) {
+                if (!word.empty()) {
+                    wp_word(*wv, word, ids);
+                    word.clear();
+                }
+                word.push_back((char)c);
+                wp_word(*wv, word, ids);
+                word.clear();
+                continue;
+            }
+            word.push_back((char)c);
+        }
+        PyObject* row = PyList_New((Py_ssize_t)ids.size());
+        if (row == nullptr) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        for (size_t j = 0; j < ids.size(); j++) {
+            PyObject* v = PyLong_FromLong(ids[j]);
+            if (v == nullptr) {
+                Py_DECREF(row);
+                Py_DECREF(seq);
+                Py_DECREF(out);
+                return nullptr;
+            }
+            PyList_SET_ITEM(row, (Py_ssize_t)j, v);
+        }
+        PyList_SET_ITEM(out, i, row);
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
 PyObject* py_set_pointer_type(PyObject*, PyObject* cls) {
     Py_XDECREF(g_pointer_type);
     Py_INCREF(cls);
@@ -1178,6 +1367,10 @@ PyMethodDef kMethods[] = {
      "True iff every element is a dict"},
     {"rowwise_map", py_rowwise_map, METH_VARARGS,
      "apply a row function across a batch, containing row errors"},
+    {"wp_build", py_wp_build, METH_VARARGS,
+     "build a WordPiece vocab handle from a token->id dict"},
+    {"wp_encode", py_wp_encode, METH_VARARGS,
+     "BERT-tokenize a batch of ASCII texts (None marks python fallback)"},
     {"filter_batch", py_filter_batch, METH_VARARGS,
      "keep updates whose (key, values) satisfy the predicate"},
     {"set_pointer_type", py_set_pointer_type, METH_O,

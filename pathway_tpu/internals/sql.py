@@ -398,12 +398,14 @@ class _Translator:
                 )
                 + "$"
             )
+            from pathway_tpu.internals import dtype as dt
             from pathway_tpu.internals.expression import apply_with_type
 
-            # NULL LIKE p is NULL (so NOT LIKE excludes NULL rows too)
+            # NULL LIKE p is NULL (so NOT LIKE excludes NULL rows too);
+            # declared Optional(BOOL) to match
             return apply_with_type(
                 lambda s, rx=rx: None if s is None else rx.match(s) is not None,
-                bool,
+                dt.Optional(dt.BOOL),
                 _wrap(self.to_expr(ast[1], scope)),
             )
         if kind == "isnull":

@@ -16,7 +16,7 @@ checkpoint as ``vocab.txt``).
 from __future__ import annotations
 
 import unicodedata
-from typing import Sequence
+from typing import Any, Sequence
 
 import numpy as np
 
@@ -91,6 +91,35 @@ class WordPieceTokenizer(Tokenizer):
         self.pad_id = self.vocab[pad_token]
         self.max_input_chars_per_word = max_input_chars_per_word
         self.vocab_size = len(self.vocab)
+        self._native_vocab: Any = None  # built lazily (wp_build capsule)
+
+    def _native_handle(self):
+        """C++ WordPiece handle, or None.  ASCII texts tokenize in one C
+        pass (native/pathway_native.cpp wp_encode); others fall back."""
+        if self._native_vocab is None:
+            from pathway_tpu.internals import native as _native
+
+            mod = _native.load()
+            if mod is None or not hasattr(mod, "wp_build"):
+                self._native_vocab = (None, None)
+            else:
+                self._native_vocab = (
+                    mod,
+                    mod.wp_build(
+                        self.vocab, self.unk_id, self.max_input_chars_per_word
+                    ),
+                )
+        return self._native_vocab
+
+    def tokenize_ids_batch(self, texts: Sequence[str]) -> list[list[int]]:
+        mod, cap = self._native_handle()
+        if cap is None:
+            return [self.tokenize_ids(t) for t in texts]
+        rows = mod.wp_encode(cap, list(texts), self.do_lower_case)
+        for i, r in enumerate(rows):
+            if r is None:  # non-ASCII text: exact unicode pipeline
+                rows[i] = self.tokenize_ids(texts[i])
+        return rows
 
     # -- basic tokenization -------------------------------------------
     def _clean(self, text: str) -> str:
@@ -185,12 +214,14 @@ class WordPieceTokenizer(Tokenizer):
         pair: Sequence[str] | None = None,
         bucket_len: bool = True,
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        firsts = self.tokenize_ids_batch(texts)
+        seconds = self.tokenize_ids_batch(pair) if pair is not None else None
         rows: list[list[int]] = []
         types: list[list[int]] = []
         for i, text in enumerate(texts):
-            first = self.tokenize_ids(text)
+            first = firsts[i]
             if pair is not None:
-                second = self.tokenize_ids(pair[i])
+                second = seconds[i]
                 # HF "longest_first" pair truncation: trim the longer side
                 budget = max(0, max_len - 3)
                 while len(first) + len(second) > budget and (first or second):

@@ -24,15 +24,23 @@ import zlib
 
 _STREAM = re.compile(rb"stream\r?\n(.*?)\r?\nendstream", re.DOTALL)
 _BT_ET = re.compile(rb"BT(.*?)ET", re.DOTALL)
+#: literal string body: escapes, plain chars, or ONE level of balanced
+#: unescaped parentheses (legal per the PDF spec; deeper nesting is rare)
+_LIT = rb"(?:\\.|[^\\()]|\((?:\\.|[^\\()])*\))*"
+#: TJ array body: literal strings, hex strings, or non-bracket chars —
+#: so a ']' inside a string does not end the array early
+_ARR = rb"(?:\(" + _LIT + rb"\)|<[0-9A-Fa-f\s]*>|[^\]()<>])*"
 #: one text-showing or line-moving operator inside a BT block
 _TEXT_OP = re.compile(
-    rb"\((?P<lit>(?:\\.|[^\\()])*)\)\s*(?P<op>Tj|'|\")"  # (s) Tj / ' / "
-    rb"|\[(?P<arr>(?:\\.|[^\]])*)\]\s*TJ"  # [(a) -250 (b)] TJ
-    rb"|<(?P<hex>[0-9A-Fa-f\s]*)>\s*Tj"
+    rb"\((?P<lit>" + _LIT + rb")\)\s*(?P<op>Tj|'|\")"  # (s) Tj / ' / "
+    rb"|\[(?P<arr>" + _ARR + rb")\]\s*TJ"  # [(a) -250 (b)] TJ
+    rb"|<(?P<hex>[0-9A-Fa-f\s]*)>\s*(?P<hop>Tj|'|\")"
     rb"|(?P<nl>T\*|Td|TD|Tm)",
     re.DOTALL,
 )
-_ARR_STR = re.compile(rb"\((?P<lit>(?:\\.|[^\\()])*)\)|<(?P<hex>[0-9A-Fa-f\s]*)>")
+_ARR_STR = re.compile(
+    rb"\((?P<lit>" + _LIT + rb")\)|<(?P<hex>[0-9A-Fa-f\s]*)>"
+)
 
 _ESCAPES = {
     b"n": b"\n",
@@ -102,6 +110,8 @@ def _block_text(block: bytes) -> str:
                     parts.append(_hex_text(s.group("hex")))
         elif m.group("hex") is not None:
             parts.append(_hex_text(m.group("hex")))
+            if m.group("hop") in (b"'", b'"'):
+                parts.append("\n")
     return "".join(parts)
 
 

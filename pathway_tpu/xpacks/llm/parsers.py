@@ -93,15 +93,17 @@ class PypdfParser(UDF):
 
     def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
         try:
+            from pypdf import PdfReader  # only the import probes: errors
+        except ImportError:  # raised INSIDE pypdf later must surface
+            PdfReader = None
+        if PdfReader is not None:
             import io
-
-            from pypdf import PdfReader
 
             pages = [
                 page.extract_text() or ""
                 for page in PdfReader(io.BytesIO(contents)).pages
             ]
-        except ImportError:
+        else:
             from pathway_tpu.xpacks.llm._pdf import extract_pdf_text
 
             pages = extract_pdf_text(contents)

@@ -61,12 +61,37 @@ def test_extract_rejects_non_pdf():
         extract_pdf_text(b"plain text, no header")
 
 
-def test_pypdf_parser_udf_fallback_path():
+def test_pypdf_parser_udf_fallback_path(monkeypatch):
+    # force the built-in path even when pypdf is installed
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_pypdf(name, *a, **kw):
+        if name.startswith("pypdf"):
+            raise ImportError("forced for fallback test")
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", no_pypdf)
     parser = PypdfParser()
     out = parser.__wrapped__(_minimal_pdf(CONTENT, compress=True))
     assert len(out) == 1
     text, meta = out[0]
     assert "Hello PDF world" in text and meta == {"page": 0}
+
+
+def test_extract_nested_parens_tj_brackets_hex_quote():
+    content = (
+        b"BT (see (figure 1) here) Tj "
+        b"[(a]b) -100 (c)] TJ "
+        b"<4869> ' ET"
+    )
+    pages = extract_pdf_text(_minimal_pdf(content, compress=False))
+    assert len(pages) == 1
+    text = pages[0]
+    assert "see (figure 1) here" in text
+    assert "a]bc" in text  # ']' inside a TJ string doesn't end the array
+    assert "Hi" in text  # hex string shown with the ' operator
 
 
 def test_parse_utf8():
