@@ -1151,6 +1151,120 @@ fail:
 }
 
 // --------------------------------------------------------------------------
+// worker routing
+
+// route_split(batch, idx_tuple, n_workers) -> [outbox_0, ..., outbox_W-1]
+// One C pass splitting an update batch by the 128-bit hash of positional
+// route cells (idx >= 0 -> values[idx], -1 -> row key) — byte-identical
+// to cluster.stable_shard / keys.ref_scalar, including the repr fallback
+// for unhashable cell types.
+PyObject* py_route_split(PyObject*, PyObject* args) {
+    PyObject *batch, *idxs;
+    long W;
+    if (!PyArg_ParseTuple(args, "OOl", &batch, &idxs, &W)) return nullptr;
+    if (W <= 0 || !PyTuple_Check(idxs)) {
+        PyErr_SetString(PyExc_ValueError, "bad route_split arguments");
+        return nullptr;
+    }
+    Py_ssize_t nidx = PyTuple_GET_SIZE(idxs);
+    std::vector<Py_ssize_t> pos((size_t)nidx);
+    for (Py_ssize_t i = 0; i < nidx; i++) {
+        pos[(size_t)i] = PyLong_AsSsize_t(PyTuple_GET_ITEM(idxs, i));
+        if (pos[(size_t)i] == -1 && PyErr_Occurred()) return nullptr;
+    }
+    PyObject* seq = PySequence_Fast(batch, "route_split expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(W);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    for (long w = 0; w < W; w++) {
+        PyObject* lst = PyList_New(0);
+        if (lst == nullptr) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, w, lst);
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            goto fail;
+        }
+        {
+            PyObject* key = PyTuple_GET_ITEM(u, 0);
+            PyObject* values = PyTuple_GET_ITEM(u, 1);
+            if (!PyTuple_Check(values)) {
+                PyErr_SetString(PyExc_TypeError, "values must be tuples");
+                goto fail;
+            }
+            Py_ssize_t nvals = PyTuple_GET_SIZE(values);
+            if (nidx == 0) {
+                // empty idx tuple = key-value routing (route_by_key):
+                // dest = int(key) % W, NOT a re-hash — matches the Python
+                // route_by_key closure exactly
+                PyObject* wobj = PyLong_FromLong(W);
+                if (wobj == nullptr) goto fail;
+                PyObject* m = PyNumber_Remainder(key, wobj);
+                Py_DECREF(wobj);
+                if (m == nullptr) goto fail;
+                long dest = PyLong_AsLong(m);
+                Py_DECREF(m);
+                if (dest == -1 && PyErr_Occurred()) goto fail;
+                if (PyList_Append(PyList_GET_ITEM(out, dest), u) < 0)
+                    goto fail;
+                continue;
+            }
+            Hasher h;
+            bool ok = true;
+            for (Py_ssize_t j = 0; j < nidx && ok; j++) {
+                Py_ssize_t ix = pos[(size_t)j];
+                PyObject* cell;
+                if (ix < 0) {
+                    cell = key;
+                } else if (ix < nvals) {
+                    cell = PyTuple_GET_ITEM(values, ix);
+                } else {
+                    PyErr_SetString(PyExc_IndexError,
+                                    "route column out of range");
+                    goto fail;
+                }
+                ok = feed(h, cell);
+            }
+            if (!ok) {
+                // cell type outside the native feed set (datetime,
+                // ndarray, ...): the PYTHON hasher supports more tags, so
+                // punt the WHOLE batch to the per-row stable_shard path —
+                // a divergent native fallback hash would route rows of
+                // the same group to different workers
+                if (!PyErr_Occurred())
+                    PyErr_SetString(g_unsupported, "unroutable cell type");
+                goto fail;
+            }
+            uint8_t dg[16];
+            pwnative::blake2b_final(&h.S, dg);
+            uint64_t lo, hi;
+            std::memcpy(&lo, dg, 8);
+            std::memcpy(&hi, dg + 8, 8);
+            unsigned __int128 v =
+                ((unsigned __int128)hi << 64) | (unsigned __int128)lo;
+            long dest = (long)(unsigned long long)(v % (unsigned long long)W);
+            if (PyList_Append(PyList_GET_ITEM(out, dest), u) < 0) goto fail;
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(seq);
+    Py_DECREF(out);
+    return nullptr;
+}
+
+// --------------------------------------------------------------------------
 // WordPiece tokenization (ASCII fast path)
 //
 // The BERT tokenize pipeline (models/wordpiece.py) is the host-side
@@ -1367,6 +1481,8 @@ PyMethodDef kMethods[] = {
      "True iff every element is a dict"},
     {"rowwise_map", py_rowwise_map, METH_VARARGS,
      "apply a row function across a batch, containing row errors"},
+    {"route_split", py_route_split, METH_VARARGS,
+     "split an update batch into per-worker outboxes by route-cell hash"},
     {"wp_build", py_wp_build, METH_VARARGS,
      "build a WordPiece vocab handle from a token->id dict"},
     {"wp_encode", py_wp_encode, METH_VARARGS,

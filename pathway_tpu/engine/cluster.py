@@ -307,11 +307,20 @@ def route_by_key(u: Any) -> int:
     return int(u.key)
 
 
+#: native route_split spec: empty tuple = key-value routing (see
+#: native/pathway_native.cpp py_route_split)
+route_by_key.positional = ()  # type: ignore[attr-defined]
+
+
 def route_to_zero(_u: Any) -> int:
     """Centralized operators (temporal buffers, external indexes, outputs):
     the reference shards these to a single worker too
     (``TimeKey::shard() -> 1``, ``src/engine/dataflow/operators/time_column.rs:44-52``)."""
     return 0
+
+
+#: scheduler fast path: everything to worker 0 without a per-row call
+route_to_zero.const_zero = True  # type: ignore[attr-defined]
 
 
 def route_all_to_zero(node: Any) -> list:

@@ -590,7 +590,12 @@ class GroupByNode(Node):
         self.fast_spec = fast_spec
 
     def exchange_routes(self):
-        return [cl.route_by(self.group_fn)]
+        route = cl.route_by(self.group_fn)
+        if self.fast_spec is not None:
+            # native route_split hashes the same positional group cells
+            # stable_shard would (one C pass instead of per-row closures)
+            route.positional = self.fast_spec[0]
+        return [route]
 
     def make_state(self):
         # group_hash -> {gvals, accs: [...], count, last_out: tuple|None}

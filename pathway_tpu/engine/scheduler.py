@@ -196,6 +196,33 @@ class Scheduler:
                     frontier.append(consumer.id)
         return active
 
+    @staticmethod
+    def _route_outboxes(route: Any, batch: list, W: int) -> list[list]:
+        """Split a batch into per-worker outboxes.  Fast paths: const-zero
+        routes copy without any per-row work; routes with a positional
+        cell spec split in one native C pass (``route_split``); everything
+        else runs the per-row Python closure."""
+        if getattr(route, "const_zero", False):
+            outboxes: list[list] = [[] for _ in range(W)]
+            outboxes[0] = batch
+            return outboxes
+        positional = getattr(route, "positional", None)
+        if positional is not None:
+            native = _native.load()
+            if native is not None:
+                try:
+                    return native.route_split(batch, tuple(positional), W)
+                except Exception:
+                    pass  # any failure: the per-row path decides row by row
+        outboxes = [[] for _ in range(W)]
+        for u in batch:
+            try:
+                dest = route(u) % W
+            except Exception:
+                dest = 0
+            outboxes[dest].append(u)
+        return outboxes
+
     def run_epoch(
         self,
         time: int,
@@ -229,13 +256,10 @@ class Scheduler:
                     route = routes[port] if port < len(routes) else None
                     if route is None:
                         continue
-                    outboxes: list[list] = [[] for _ in range(W)]
-                    for u in ins.get(port, ()):
-                        try:
-                            dest = route(u) % W
-                        except Exception:
-                            dest = 0
-                        outboxes[dest].append(u)
+                    batch = ins.get(port, ())
+                    if not isinstance(batch, list):
+                        batch = list(batch)
+                    outboxes = self._route_outboxes(route, batch, W)
                     ins[port] = cluster.exchange(  # type: ignore[union-attr]
                         ("x", node.id, port, time), tid, outboxes
                     )

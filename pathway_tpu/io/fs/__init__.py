@@ -63,7 +63,11 @@ class _FilesSource(RowSource):
         #: the per-line parser for that block (e.g. malformed rows)
         self.parse_block = parse_block
         # parser_factory(fp) -> line parser with per-file state (CSV headers);
-        # plain parse_line is wrapped as a stateless factory
+        # plain parse_line is wrapped as a stateless factory.  Stateless
+        # parsers allow the pre-parse line partition (each worker parses
+        # only its share); stateful ones must see every line (headers), so
+        # partitioned workers filter at emit instead
+        self._stateless_parser = parser_factory is None
         if parser_factory is None:
             assert parse_line is not None
             parser_factory = lambda fp, p=parse_line: p
@@ -78,9 +82,12 @@ class _FilesSource(RowSource):
         self._part = (0, 1)
 
     def partition(self, worker: int, n_workers: int) -> "_FilesSource | None":
-        """Every worker scans the files but emits a disjoint key-hash share;
-        row keys are identical to a single-worker run, so persistence
-        resume and N-vs-1-worker outputs stay exact."""
+        """Disjoint LINE-INDEX share per worker: with a stateless parser
+        each worker parses only its 1/n of the lines (stateful parsers see
+        every line and filter at emit).  Row keys are identical to a
+        single-worker run, so persistence resume and N-vs-1-worker outputs
+        stay exact.  Downstream placement is the consumers' business —
+        every routed operator re-exchanges its input."""
         import copy
 
         sub = copy.copy(self)
@@ -91,7 +98,7 @@ class _FilesSource(RowSource):
         self, events: Any, fp: str, start_offset: int, seq_start: int, parser: Callable
     ) -> tuple[int, int]:
         pk = self.schema.primary_key_columns()
-        seq = seq_start
+        seq = seq_start  # non-empty LINE counter (keys + partitioning)
         add_many = getattr(events, "add_many", None)
         chunk: list = []  # (key, row) additions flushed per _CHUNK rows
         _CHUNK = 4096
@@ -104,8 +111,8 @@ class _FilesSource(RowSource):
         )
         w, n = self._part
 
-        def emit_rows(rows: list) -> None:
-            nonlocal seq, chunk
+        def emit_rows(rows: list, line_seqs: list[int]) -> None:
+            nonlocal chunk
             if not rows:
                 return
             if meta is not None:
@@ -115,16 +122,10 @@ class _FilesSource(RowSource):
             if pk:
                 key_args = [tuple(v[c] for c in pk) for v in rows]
             else:
-                base = seq
                 key_args = [
-                    ("__fs__", self.tag, fp, base + i + 1) for i in range(len(rows))
+                    ("__fs__", self.tag, fp, s + 1) for s in line_seqs
                 ]
-                seq = base + len(rows)
             keys = keys_for_values(key_args)
-            if n > 1:  # keep only this worker's key-hash share
-                kept = [(v, k) for v, k in zip(rows, keys) if int(k) % n == w]
-                rows = [v for v, _ in kept]
-                keys = [k for _, k in kept]
             coerced = coerce_rows(rows, schema)
             if add_many is None:
                 for key, row in zip(keys, coerced):
@@ -135,6 +136,51 @@ class _FilesSource(RowSource):
                     # one queue item / snapshot record per _CHUNK rows
                     add_many(chunk[:_CHUNK])
                     chunk = chunk[_CHUNK:]
+
+        def parse_and_emit(complete: bytes) -> None:
+            """Split once, keep only this worker's line share (disjoint
+            line-index partition: each worker PARSES only 1/n of the
+            input, unlike a post-parse key filter), parse, emit."""
+            nonlocal seq
+            lines = [ln for ln in complete.split(b"\n") if ln]
+            base = seq
+            seq = base + len(lines)
+            emit_filter = False
+            if n > 1 and self._stateless_parser:
+                owned = [
+                    (base + i, ln)
+                    for i, ln in enumerate(lines)
+                    if (base + i) % n == w
+                ]
+            else:
+                owned = list(enumerate(lines, base))
+                emit_filter = n > 1  # stateful parser: filter after parse
+            if not owned:
+                return
+            rows = None
+            if self.parse_block is not None and not emit_filter:
+                # (emit_filter set = stateful parser under n>1: only the
+                # per-line loop below applies the share filter)
+                joined = b"\n".join(ln for _s, ln in owned)
+                rows = self.parse_block(joined)
+                if rows is not None and len(rows) != len(owned):
+                    # parser dropped lines: per-line path keeps the
+                    # line-seq <-> row alignment exact
+                    rows = None
+            if rows is not None:
+                emit_rows(rows, [s for s, _ln in owned])
+                return
+            out_rows: list = []
+            out_seqs: list[int] = []
+            for s, raw in owned:
+                try:
+                    values = parser(raw.decode(errors="replace"))
+                except Exception:
+                    values = None  # unparseable line: skip
+                if isinstance(values, dict) and not (emit_filter and s % n != w):
+                    out_rows.append(values)
+                    out_seqs.append(s)
+            emit_rows(out_rows, out_seqs)
 
         # binary mode: byte-accurate offsets (text-mode tell() is unusable
         # with block reads), splitting on b"\n"; only COMPLETE lines are
@@ -176,19 +222,7 @@ class _FilesSource(RowSource):
                         complete = data[: nl + 1]
                         if nl + 1 < len(data):
                             f.seek(offset + len(complete))
-                rows = self.parse_block(complete) if self.parse_block else None
-                if rows is None:
-                    rows = []
-                    for raw in complete.split(b"\n"):
-                        if not raw:
-                            continue
-                        try:
-                            values = parser(raw.decode(errors="replace"))
-                        except Exception:
-                            values = None  # unparseable line: skip
-                        if isinstance(values, dict):
-                            rows.append(values)
-                emit_rows(rows)
+                parse_and_emit(complete)
                 offset += len(complete)
                 if at_eof:
                     break
