@@ -60,9 +60,14 @@ def _run_inner(
     persistence_config: Any,
 ):
     from pathway_tpu.internals import config as cfg
+    from pathway_tpu.internals.license import effective_workers
 
     threads = max(1, pc.threads)
     processes = max(1, pc.processes)
+    # free tier caps total workers (reference MAX_WORKERS, config.rs:7-11)
+    total = effective_workers(threads * processes)
+    if total < threads * processes:
+        threads = max(1, total // processes)
     sched = Scheduler(
         G.engine_graph,
         autocommit_ms=autocommit_duration_ms or 50,

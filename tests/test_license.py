@@ -1,0 +1,95 @@
+"""License keys / entitlements / worker cap (reference
+``src/engine/license.rs``)."""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import license as lic
+
+
+def _keypair():
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives import serialization
+
+    sk = Ed25519PrivateKey.generate()
+    sk_pem = sk.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    pk_pem = sk.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo,
+    )
+    return sk_pem, pk_pem.decode()
+
+
+def test_free_tier_defaults():
+    l = lic.parse_license(None)
+    assert l.tier == "free" and l.worker_cap() == lic.MAX_WORKERS_FREE
+    with pytest.raises(lic.LicenseError, match="missing entitlement"):
+        l.check_entitlements("scale")
+
+
+def test_demo_key():
+    l = lic.parse_license("demo-license-key-with-telemetry")
+    assert l.tier == "demo" and l.telemetry
+
+
+def test_signed_key_roundtrip(monkeypatch):
+    sk_pem, pk_pem = _keypair()
+    monkeypatch.setenv("PATHWAY_LICENSE_PUBLIC_KEY", pk_pem)
+    key = lic.generate_license_key(
+        {"tier": "scale", "entitlements": ["scale", "xpack-sharepoint"]},
+        sk_pem,
+    )
+    l = lic.parse_license(key)
+    assert l.tier == "scale" and l.scale_unlimited
+    assert l.worker_cap() is None
+    l.check_entitlements("xpack-sharepoint")  # no raise
+
+    # tampered payload must fail
+    corrupted = "x" + key[1:]
+    with pytest.raises(lic.LicenseError):
+        lic.parse_license(corrupted)
+    # signature from the WRONG key must fail
+    other_sk, _ = _keypair()
+    forged = lic.generate_license_key({"tier": "scale"}, other_sk)
+    with pytest.raises(lic.LicenseError, match="signature"):
+        lic.parse_license(forged)
+
+
+def test_malformed_key():
+    with pytest.raises(lic.LicenseError, match="malformed"):
+        lic.parse_license("no-dot-separator-and-not-demo")
+
+
+def test_worker_cap_clamps(monkeypatch, caplog):
+    monkeypatch.setattr(
+        "pathway_tpu.internals.config.pathway_config.license_key", None
+    )
+    lic._cache.clear()
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="pathway_tpu.license"):
+        assert lic.effective_workers(32) == lic.MAX_WORKERS_FREE
+    assert any("free tier" in r.message for r in caplog.records)
+    assert lic.effective_workers(4) == 4
+
+
+def test_set_license_key_lifts_cap(monkeypatch):
+    sk_pem, pk_pem = _keypair()
+    monkeypatch.setenv("PATHWAY_LICENSE_PUBLIC_KEY", pk_pem)
+    key = lic.generate_license_key(
+        {"tier": "scale", "entitlements": ["scale"]}, sk_pem
+    )
+    old = pw.internals.config.pathway_config.license_key
+    lic._cache.clear()
+    try:
+        pw.set_license_key(key)
+        assert lic.effective_workers(32) == 32
+    finally:
+        pw.set_license_key(old)
+        lic._cache.clear()
