@@ -79,10 +79,19 @@ class License:
 
 
 def _public_key():
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
     from cryptography.hazmat.primitives.serialization import load_pem_public_key
 
     pem = os.environ.get("PATHWAY_LICENSE_PUBLIC_KEY", _DEFAULT_PUBLIC_KEY_PEM)
-    return load_pem_public_key(pem.encode())
+    try:
+        pk = load_pem_public_key(pem.encode())
+    except Exception as e:  # malformed PEM -> the documented error type
+        raise LicenseError(f"invalid license public key: {e}") from None
+    if not isinstance(pk, Ed25519PublicKey):
+        raise LicenseError("license public key must be Ed25519")
+    return pk
 
 
 def parse_license(key: str | None) -> License:
@@ -91,7 +100,11 @@ def parse_license(key: str | None) -> License:
         return License()
     key = key.strip()
     if key.lower() in _DEMO_KEYS:
-        return License(tier="demo", telemetry=True)
+        # demo keys unlock licensed xpacks for offline evaluation (but not
+        # the worker cap), like the reference's telemetry demo keys
+        return License(
+            tier="demo", telemetry=True, entitlements=("xpack-sharepoint",)
+        )
     try:
         payload_b64, sig_b64 = key.split(".", 1)
         payload_bytes = base64.urlsafe_b64decode(payload_b64 + "===")
@@ -135,24 +148,28 @@ def generate_license_key(payload: dict, private_key_pem: bytes | str) -> str:
     )
 
 
-_cache: dict[str, License] = {}
+_cache: dict[tuple[str, str], License] = {}
 
 
 def get_license() -> License:
-    """The validated license for the current config key (cached)."""
+    """The validated license for the current config key (cached per
+    (key, public key), so rotating PATHWAY_LICENSE_PUBLIC_KEY
+    re-verifies)."""
     from pathway_tpu.internals.config import pathway_config
 
     key = pathway_config.license_key or ""
-    lic = _cache.get(key)
+    pub = os.environ.get("PATHWAY_LICENSE_PUBLIC_KEY", "")
+    lic = _cache.get((key, pub))
     if lic is None:
         lic = parse_license(key)
-        _cache[key] = lic
+        _cache[(key, pub)] = lic
     return lic
 
 
 def check_entitlements(*required: str) -> None:
-    """Module-level convenience (reference ``check_entitlements`` called
-    from ``internals/config.py:105``)."""
+    """Entitlement gate for licensed features (reference
+    ``license.rs`` entitlement checks; wired into e.g. the SharePoint
+    xpack connector)."""
     get_license().check_entitlements(*required)
 
 

@@ -60,14 +60,34 @@ def _run_inner(
     persistence_config: Any,
 ):
     from pathway_tpu.internals import config as cfg
-    from pathway_tpu.internals.license import effective_workers
+    from pathway_tpu.internals.license import LicenseError, get_license
 
     threads = max(1, pc.threads)
     processes = max(1, pc.processes)
-    # free tier caps total workers (reference MAX_WORKERS, config.rs:7-11)
-    total = effective_workers(threads * processes)
-    if total < threads * processes:
-        threads = max(1, total // processes)
+    # free tier caps total workers (reference MAX_WORKERS, config.rs:7-11).
+    # Thread counts clamp locally; a process topology over the cap cannot
+    # be shrunk from inside one process, so it is refused outright (every
+    # process raises the same error).
+    cap = get_license().worker_cap()
+    if cap is not None and threads * processes > cap:
+        if processes > cap:
+            raise LicenseError(
+                f"free tier allows at most {cap} workers but "
+                f"PATHWAY_PROCESSES={processes}; set a license key with "
+                "the 'scale' entitlement"
+            )
+        threads = max(1, cap // processes)
+        import logging
+
+        logging.getLogger("pathway_tpu.license").warning(
+            "free tier caps workers at %d: running %d threads x %d "
+            "processes = %d workers; set a license key with the 'scale' "
+            "entitlement to lift the cap",
+            cap,
+            threads,
+            processes,
+            threads * processes,
+        )
     sched = Scheduler(
         G.engine_graph,
         autocommit_ms=autocommit_duration_ms or 50,

@@ -86,6 +86,7 @@ class Telemetry:
                         "attributes": [
                             _kv("service.name", self.service_name),
                             _kv("run.id", self.run_id),
+                            _kv("license.tier", _license_tier()),
                         ]
                     },
                     "scopeSpans": [
@@ -127,6 +128,7 @@ class Telemetry:
                         "attributes": [
                             _kv("service.name", self.service_name),
                             _kv("run.id", self.run_id),
+                            _kv("license.tier", _license_tier()),
                         ]
                     },
                     "scopeMetrics": [
@@ -166,6 +168,17 @@ class Telemetry:
             urllib.request.urlopen(req, timeout=5).read()
         except Exception as e:  # noqa: BLE001 — telemetry must never break runs
             _logger.debug("telemetry export failed: %r", e)
+
+
+def _license_tier() -> str:
+    """Resource attribute like the reference's license-aware telemetry
+    (``src/engine/telemetry.rs:62-143`` run_id/license attrs)."""
+    try:
+        from pathway_tpu.internals.license import get_license
+
+        return get_license().tier
+    except Exception:  # noqa: BLE001 — invalid license must not kill export
+        return "unknown"
 
 
 def _kv(key: str, value: Any) -> dict:

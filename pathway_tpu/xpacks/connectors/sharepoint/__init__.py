@@ -173,6 +173,11 @@ def read(
     **kwargs: Any,
 ) -> Table:
     """One row per SharePoint file under ``root_path``."""
+    # licensed xpack (reference gates SharePoint behind the license too);
+    # demo keys carry the entitlement so evaluation works offline
+    from pathway_tpu.internals.license import check_entitlements
+
+    check_entitlements("xpack-sharepoint")
     if connection is None:
         connection = _Office365Connection(url, tenant, client_id, cert_path, thumbprint)
     if with_metadata:

@@ -178,9 +178,26 @@ def test_lsh_banding_recall_and_removal():
     assert res and res[0][0] == 5
 
 
-def test_sharepoint_fake_connection():
+def test_sharepoint_requires_entitlement():
+    import pytest
+
+    from pathway_tpu.internals.license import LicenseError
+    from pathway_tpu.xpacks.connectors import sharepoint
+
+    with pytest.raises(LicenseError, match="xpack-sharepoint"):
+        sharepoint.read(connection=object(), root_path="/x", mode="static")
+
+
+def test_sharepoint_fake_connection(monkeypatch):
+    from pathway_tpu.internals import license as _lic
     from pathway_tpu.xpacks.connectors.sharepoint import FileEntry
     from pathway_tpu.xpacks.connectors import sharepoint
+
+    # licensed xpack: the demo key unlocks it for offline evaluation
+    monkeypatch.setattr(
+        "pathway_tpu.internals.config.pathway_config.license_key", "demo"
+    )
+    _lic._cache.clear()
 
     class FakeConn:
         def __init__(self):
