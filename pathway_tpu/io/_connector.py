@@ -344,7 +344,9 @@ def fmt_value(v: Any) -> Any:
     from pathway_tpu.internals.json import Json
 
     if isinstance(v, K.Pointer):
-        return repr(v)
+        # full 128-bit key, NOT repr (repr truncates to 12 chars — two
+        # distinct keys could serialize identically in sink output)
+        return f"^{int(v):032X}"
     if isinstance(v, Json):
         return v.value
     if isinstance(v, np.ndarray):

@@ -59,7 +59,10 @@ class _ElasticWriter(Writer):
         return self._client
 
     def write(self, row: dict[str, Any], time: int, diff: int) -> None:
-        doc_id = str(row.get("id"))
+        rid = row.get("id")
+        # full key digits, NOT str(Pointer) — its repr truncates to 12
+        # chars and truncated ids collide across documents
+        doc_id = str(int(rid)) if isinstance(rid, int) else str(rid)
         if diff > 0:
             doc = {k: fmt_value(v) for k, v in row.items() if k != "id"}
             doc["time"] = time

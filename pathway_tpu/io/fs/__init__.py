@@ -142,6 +142,22 @@ class _FilesSource(RowSource):
             line-index partition: each worker PARSES only 1/n of the
             input, unlike a post-parse key filter), parse, emit."""
             nonlocal seq
+            if n == 1 and self.parse_block is not None:
+                # single worker: hand the whole block to the C-level block
+                # parser without a pre-split; guarded by a cheap C-level
+                # line count so row index == line index exactly (a parser
+                # that silently drops lines falls back to the per-line
+                # numbering the partitioned path uses — keys must not
+                # depend on worker count)
+                rows = self.parse_block(complete)
+                if rows is not None:
+                    parts = complete.split(b"\n")
+                    n_lines = len(parts) - parts.count(b"")
+                    if len(rows) == n_lines:
+                        base = seq
+                        seq = base + n_lines
+                        emit_rows(rows, range(base, base + n_lines))
+                        return
             lines = [ln for ln in complete.split(b"\n") if ln]
             base = seq
             seq = base + len(lines)

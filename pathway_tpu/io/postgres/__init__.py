@@ -30,7 +30,11 @@ __all__ = ["write", "write_snapshot"]
 
 def _connect(postgres_settings: dict | None, connection: Any) -> Any:
     if connection is not None:
-        return connection() if callable(connection) else connection
+        # factory vs live connection: sqlite3.Connection is itself
+        # callable (executes a statement), so presence of .cursor decides
+        if callable(connection) and not hasattr(connection, "cursor"):
+            return connection()
+        return connection
     try:
         import psycopg2  # type: ignore[import-not-found]
     except ImportError as e:

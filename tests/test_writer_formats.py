@@ -1,0 +1,110 @@
+"""Direct unit coverage of service-writer wire formats: the SQL the
+postgres writer generates (update-stream inserts, snapshot upserts,
+deletes — reference ``data_format.rs`` PsqlUpdates/PsqlSnapshot
+formatters, :1625-1684) and the elasticsearch bulk bodies."""
+
+from pathway_tpu.io.postgres import _PsqlWriter
+
+
+class FakeCursor:
+    def __init__(self, log):
+        self.log = log
+
+    def execute(self, sql, params=None):
+        self.log.append((sql, list(params or [])))
+
+
+class FakeConn:
+    """module name starts with 'tests' -> %s placeholders (non-sqlite)."""
+
+    def __init__(self):
+        self.executed: list = []
+        self.commits = 0
+
+    def cursor(self):
+        return FakeCursor(self.executed)
+
+    def commit(self):
+        self.commits += 1
+
+    def close(self):
+        pass
+
+
+def _writer(**kwargs):
+    conn = FakeConn()
+    w = _PsqlWriter(None, conn, "tbl", **kwargs)
+    return w, conn
+
+
+def test_update_stream_insert_carries_time_and_diff():
+    w, conn = _writer()
+    w.write({"a": 1, "b": "x"}, time=4, diff=-1)
+    sql, params = conn.executed[0]
+    assert sql == "INSERT INTO tbl (a, b, time, diff) VALUES (%s, %s, %s, %s)"
+    assert params == [1, "x", 4, -1]
+
+
+def test_snapshot_upsert_on_conflict_updates_non_key_columns():
+    w, conn = _writer(snapshot_keys=["k"])
+    w.write({"k": 7, "v": "new", "n": 2}, time=2, diff=1)
+    sql, params = conn.executed[0]
+    assert sql == (
+        "INSERT INTO tbl (k, v, n) VALUES (%s, %s, %s) "
+        "ON CONFLICT (k) DO UPDATE SET v = excluded.v, n = excluded.n"
+    )
+    assert params == [7, "new", 2]
+
+
+def test_snapshot_delete_by_keys_only():
+    w, conn = _writer(snapshot_keys=["k1", "k2"])
+    w.write({"k1": 1, "k2": 2, "v": "gone"}, time=2, diff=-1)
+    sql, params = conn.executed[0]
+    assert sql == "DELETE FROM tbl WHERE k1 = %s AND k2 = %s"
+    assert params == [1, 2]
+
+
+def test_sqlite_connections_use_question_placeholders():
+    import sqlite3
+
+    conn = sqlite3.connect(":memory:")
+    conn.execute("CREATE TABLE tbl (a, b, time, diff)")
+    w = _PsqlWriter(None, conn, "tbl")
+    w.write({"a": 1, "b": "x"}, time=0, diff=1)
+    w.flush()
+    assert list(conn.execute("SELECT * FROM tbl")) == [(1, "x", 0, 1)]
+
+
+def test_batch_commit_cadence():
+    w, conn = _writer(max_batch_size=2)
+    w.write({"a": 1}, 0, 1)
+    assert conn.commits == 0
+    w.write({"a": 2}, 0, 1)
+    assert conn.commits == 1  # committed at the batch boundary
+    w.flush()
+    assert conn.commits == 2
+
+
+def test_elasticsearch_bulk_bodies():
+    from pathway_tpu.io import elasticsearch as es
+    from pathway_tpu.internals.keys import ref_scalar
+
+    class FakeClient:
+        def __init__(self):
+            self.calls = []
+
+        def bulk(self, operations):
+            self.calls.append(list(operations))
+
+    client = FakeClient()
+    w = es._ElasticWriter("http://fake:9200", None, "idx", client)
+    k = ref_scalar(1)
+    w.write({"id": k, "text": "hello"}, time=0, diff=1)
+    w.write({"id": k, "text": "hello"}, time=2, diff=-1)
+    w.flush()
+    (ops,) = client.calls
+    assert ops[0] == {"index": {"_index": "idx", "_id": str(int(k))}}
+    assert ops[1] == {"text": "hello", "time": 0}
+    assert ops[2] == {"delete": {"_index": "idx", "_id": str(int(k))}}
+    # the _id carries the FULL key digits (str(Pointer) truncates)
+    assert "…" not in ops[0]["index"]["_id"]
