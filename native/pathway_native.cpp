@@ -87,7 +87,27 @@ bool feed(Hasher& h, PyObject* v) {
     if (PyLong_Check(v)) {
         int overflow = 0;
         long long val = PyLong_AsLongLongAndOverflow(v, &overflow);
-        if (overflow != 0) return false;  // big int: fall back
+        if (overflow != 0) {
+            // big int (e.g. 128-bit join/derive key material): replicate
+            // value.to_bytes((bit_length + 8)//8 + 1, "little", signed)
+            size_t bits = _PyLong_NumBits(v);
+            if (bits == (size_t)-1) {
+                PyErr_Clear();
+                return false;
+            }
+            size_t nb = (bits + 8) / 8 + 1;
+            uint8_t buf[64];
+            if (nb > sizeof(buf)) return false;  // >~500 bits: fall back
+            if (_PyLong_AsByteArray(reinterpret_cast<PyLongObject*>(v), buf,
+                                    nb, /*little_endian=*/1,
+                                    /*is_signed=*/1) < 0) {
+                PyErr_Clear();
+                return false;
+            }
+            h.tag(0x02);
+            h.bytes(buf, nb);
+            return true;
+        }
         // python: n = (bit_length + 8) // 8 + 1 bytes, signed little
         unsigned long long mag =
             val < 0 ? (unsigned long long)(-(val + 1)) + 1ULL

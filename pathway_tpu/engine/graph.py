@@ -803,41 +803,47 @@ class JoinNode(Node):
         return out
 
     @staticmethod
-    def _apply_side(side: dict, batch: Batch, jk_fn) -> set:
-        dirty = set()
+    def _side_jks(batch: Batch, jk_fn) -> list:
+        """Hashable join key per update (None = null key, never matches);
+        computed ONCE per row and reused by the dirty scan + state apply."""
         from pathway_tpu.engine.stream import hashable_row
 
+        out = []
         for u in batch:
-            jk = hashable_row(jk_fn(u.key, u.values))
+            jk = jk_fn(u.key, u.values)
+            try:
+                hash(jk)  # plain-scalar tuples: use as-is (common case)
+            except TypeError:
+                jk = hashable_row(jk)
             if jk is None or any(v is None for v in jk):
+                jk = None
+            out.append(jk)
+        return out
+
+    @staticmethod
+    def _apply_side(side: dict, batch: Batch, jks: list) -> None:
+        for u, jk in zip(batch, jks):
+            if jk is None:
                 continue  # null join keys never match
             rows = side.setdefault(jk, {})
             if u.diff > 0:
                 rows[u.key] = u.values
             else:
                 rows.pop(u.key, None)
-            dirty.add(jk)
-        return dirty
 
     def process(self, ctx, time, inbatches):
         st = ctx.state(self)
-        from pathway_tpu.engine.stream import hashable_row
-
+        ljks = self._side_jks(inbatches[0], self.left_jk_fn)
+        rjks = self._side_jks(inbatches[1], self.right_jk_fn)
         dirty_keys: set = set()
-        for u in inbatches[0]:
-            jk = hashable_row(self.left_jk_fn(u.key, u.values))
-            if not (jk is None or any(v is None for v in jk)):
-                dirty_keys.add(jk)
-        for u in inbatches[1]:
-            jk = hashable_row(self.right_jk_fn(u.key, u.values))
-            if not (jk is None or any(v is None for v in jk)):
-                dirty_keys.add(jk)
+        dirty_keys.update(jk for jk in ljks if jk is not None)
+        dirty_keys.update(jk for jk in rjks if jk is not None)
         old_blocks = {
             jk: self._block(st["left"].get(jk, {}), st["right"].get(jk, {}))
             for jk in dirty_keys
         }
-        self._apply_side(st["left"], inbatches[0], self.left_jk_fn)
-        self._apply_side(st["right"], inbatches[1], self.right_jk_fn)
+        self._apply_side(st["left"], inbatches[0], ljks)
+        self._apply_side(st["right"], inbatches[1], rjks)
         out: list[Update] = []
         for jk in dirty_keys:
             new_block = self._block(st["left"].get(jk, {}), st["right"].get(jk, {}))

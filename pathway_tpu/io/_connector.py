@@ -335,6 +335,15 @@ def format_change_row(row: dict[str, Any], time: int, diff: int) -> dict[str, An
     return doc
 
 
+def fmt_key(v: Any) -> str:
+    """Canonical sink serialization of a row key: the full 128-bit value,
+    NOT repr (repr truncates to 12 chars — two distinct keys could print
+    identically).  One format across every sink, so ids correlate."""
+    if isinstance(v, int):
+        return f"^{int(v):032X}"
+    return str(v)
+
+
 def fmt_value(v: Any) -> Any:
     import datetime
 
@@ -344,9 +353,7 @@ def fmt_value(v: Any) -> Any:
     from pathway_tpu.internals.json import Json
 
     if isinstance(v, K.Pointer):
-        # full 128-bit key, NOT repr (repr truncates to 12 chars — two
-        # distinct keys could serialize identically in sink output)
-        return f"^{int(v):032X}"
+        return fmt_key(v)
     if isinstance(v, Json):
         return v.value
     if isinstance(v, np.ndarray):

@@ -14,7 +14,7 @@ from __future__ import annotations
 from typing import Any
 
 from pathway_tpu.internals.table import Table
-from pathway_tpu.io._connector import Writer, attach_writer, fmt_value
+from pathway_tpu.io._connector import Writer, attach_writer, fmt_key, fmt_value
 from pathway_tpu.io._gated import MissingDependency
 
 __all__ = ["write", "ElasticSearchAuth"]
@@ -59,10 +59,9 @@ class _ElasticWriter(Writer):
         return self._client
 
     def write(self, row: dict[str, Any], time: int, diff: int) -> None:
-        rid = row.get("id")
-        # full key digits, NOT str(Pointer) — its repr truncates to 12
-        # chars and truncated ids collide across documents
-        doc_id = str(int(rid)) if isinstance(rid, int) else str(rid)
+        # canonical key form shared with every other sink (fmt_key), so
+        # _ids correlate with pointer columns in any output
+        doc_id = fmt_key(row.get("id"))
         if diff > 0:
             doc = {k: fmt_value(v) for k, v in row.items() if k != "id"}
             doc["time"] = time

@@ -142,53 +142,45 @@ class _FilesSource(RowSource):
             line-index partition: each worker PARSES only 1/n of the
             input, unlike a post-parse key filter), parse, emit."""
             nonlocal seq
-            if n == 1 and self.parse_block is not None:
-                # single worker: hand the whole block to the C-level block
-                # parser without a pre-split; guarded by a cheap C-level
-                # line count so row index == line index exactly (a parser
-                # that silently drops lines falls back to the per-line
-                # numbering the partitioned path uses — keys must not
-                # depend on worker count)
-                rows = self.parse_block(complete)
-                if rows is not None:
-                    parts = complete.split(b"\n")
-                    n_lines = len(parts) - parts.count(b"")
-                    if len(rows) == n_lines:
-                        base = seq
-                        seq = base + n_lines
-                        emit_rows(rows, range(base, base + n_lines))
-                        return
             lines = [ln for ln in complete.split(b"\n") if ln]
             base = seq
             seq = base + len(lines)
+            if not lines:
+                return
             emit_filter = False
             if n > 1 and self._stateless_parser:
-                owned = [
-                    (base + i, ln)
-                    for i, ln in enumerate(lines)
-                    if (base + i) % n == w
+                owned_seqs: "list[int] | range" = [
+                    base + i for i in range(len(lines)) if (base + i) % n == w
                 ]
+                owned_lines = [lines[s - base] for s in owned_seqs]
             else:
-                owned = list(enumerate(lines, base))
+                owned_seqs = range(base, base + len(lines))
+                owned_lines = lines
                 emit_filter = n > 1  # stateful parser: filter after parse
-            if not owned:
+            if not owned_lines:
                 return
             rows = None
             if self.parse_block is not None and not emit_filter:
                 # (emit_filter set = stateful parser under n>1: only the
-                # per-line loop below applies the share filter)
-                joined = b"\n".join(ln for _s, ln in owned)
+                # per-line loop below applies the share filter).  Full
+                # ownership passes the original block — no re-join.
+                joined = (
+                    complete
+                    if owned_lines is lines
+                    else b"\n".join(owned_lines)
+                )
                 rows = self.parse_block(joined)
-                if rows is not None and len(rows) != len(owned):
+                if rows is not None and len(rows) != len(owned_lines):
                     # parser dropped lines: per-line path keeps the
-                    # line-seq <-> row alignment exact
+                    # line-seq <-> row alignment exact, so row keys never
+                    # depend on worker count
                     rows = None
             if rows is not None:
-                emit_rows(rows, [s for s, _ln in owned])
+                emit_rows(rows, list(owned_seqs))
                 return
             out_rows: list = []
             out_seqs: list[int] = []
-            for s, raw in owned:
+            for s, raw in zip(owned_seqs, owned_lines):
                 try:
                     values = parser(raw.decode(errors="replace"))
                 except Exception:

@@ -102,9 +102,11 @@ def test_elasticsearch_bulk_bodies():
     w.write({"id": k, "text": "hello"}, time=0, diff=1)
     w.write({"id": k, "text": "hello"}, time=2, diff=-1)
     w.flush()
+    from pathway_tpu.io._connector import fmt_key
+
     (ops,) = client.calls
-    assert ops[0] == {"index": {"_index": "idx", "_id": str(int(k))}}
+    kid = fmt_key(k)  # canonical full-key form shared with every sink
+    assert kid == f"^{int(k):032X}" and "…" not in kid
+    assert ops[0] == {"index": {"_index": "idx", "_id": kid}}
     assert ops[1] == {"text": "hello", "time": 0}
-    assert ops[2] == {"delete": {"_index": "idx", "_id": str(int(k))}}
-    # the _id carries the FULL key digits (str(Pointer) truncates)
-    assert "…" not in ops[0]["index"]["_id"]
+    assert ops[2] == {"delete": {"_index": "idx", "_id": kid}}
