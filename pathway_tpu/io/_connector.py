@@ -338,8 +338,9 @@ def format_change_row(row: dict[str, Any], time: int, diff: int) -> dict[str, An
 def fmt_key(v: Any) -> str:
     """Canonical sink serialization of a row key: the full 128-bit value,
     NOT repr (repr truncates to 12 chars — two distinct keys could print
-    identically).  One format across every sink, so ids correlate."""
-    if isinstance(v, int):
+    identically).  One format across every sink, so ids correlate.
+    Non-Pointer ids pass through as plain strings."""
+    if isinstance(v, K.Pointer):
         return f"^{int(v):032X}"
     return str(v)
 

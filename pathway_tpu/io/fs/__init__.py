@@ -149,10 +149,13 @@ class _FilesSource(RowSource):
                 return
             emit_filter = False
             if n > 1 and self._stateless_parser:
-                owned_seqs: "list[int] | range" = [
-                    base + i for i in range(len(lines)) if (base + i) % n == w
-                ]
-                owned_lines = [lines[s - base] for s in owned_seqs]
+                # owned line indices form an arithmetic progression:
+                # first index i with (base + i) % n == w, then every n-th
+                first = (w - base) % n
+                owned_seqs: "list[int] | range" = range(
+                    base + first, base + len(lines), n
+                )
+                owned_lines = lines[first::n]
             else:
                 owned_seqs = range(base, base + len(lines))
                 owned_lines = lines

@@ -52,7 +52,13 @@ class JittedEncoder:
         seed: int = 0,
         params: Any = None,
         checkpoint_dir: str | None = None,
+        pipeline_depth: int = 2,
     ):
+        #: chunks kept in flight before collecting a readback.  2 keeps
+        #: the historical device-memory footprint (one computing + one
+        #: draining); raise on high-RTT links to hide the round trip at
+        #: the cost of one more resident batch per extra slot.
+        self.pipeline_depth = max(1, pipeline_depth)
         if checkpoint_dir is not None:
             # real pretrained weights: config/params/vocab all from the
             # local HF checkpoint directory (models/convert.py).  Pass
@@ -179,19 +185,24 @@ class JittedEncoder:
     def _run_pipelined(
         self, texts: list, pair: "list | None"
     ) -> list[np.ndarray]:
-        """Tokenize/dispatch chunk i+1 before collecting chunk i."""
+        """Tokenize/dispatch up to ``_PIPELINE_DEPTH`` chunks ahead of the
+        oldest uncollected readback, so tokenize + device compute + host
+        transfer of different chunks all overlap."""
+        from collections import deque
+
         outs: list[np.ndarray] = []
-        prev = None
+        inflight: deque = deque()
         for chunk, pchunk in self._chunks(texts, pair):
             ids, mask, tps = self.tokenizer.encode_batch(
                 chunk, pair=pchunk, max_len=self.max_len
             )
-            cur = self._dispatch(ids, mask, tps)
-            if prev is not None:
-                outs.append(np.asarray(prev[0])[: prev[1]])
-            prev = cur
-        if prev is not None:
-            outs.append(np.asarray(prev[0])[: prev[1]])
+            inflight.append(self._dispatch(ids, mask, tps))
+            if len(inflight) > self.pipeline_depth:
+                out, nrows = inflight.popleft()
+                outs.append(np.asarray(out)[:nrows])
+        while inflight:
+            out, nrows = inflight.popleft()
+            outs.append(np.asarray(out)[:nrows])
         return outs
 
     # ------------------------------------------------------------------
