@@ -133,6 +133,32 @@ def __getattr__(name: str) -> Any:
         from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
 
         return AsyncTransformer
+    if name == "asynchronous":
+        # deprecated alias kept for parity (reference pathway.asynchronous
+        # -> pw.udfs, python/pathway/asynchronous.py:1-6)
+        from pathway_tpu.internals import udfs as asynchronous
+
+        return asynchronous
+    if name == "PersistenceMode":
+        from pathway_tpu.persistence import PersistenceMode
+
+        return PersistenceMode
+    if name in ("DateTimeNaive", "DateTimeUtc", "Duration"):
+        from pathway_tpu.internals import dtype as _dt
+
+        return {
+            "DateTimeNaive": _dt.DateTimeNaive,
+            "DateTimeUtc": _dt.DateTimeUtc,
+            "Duration": _dt.Duration,
+        }[name]
+    if name == "declare_type":
+        from pathway_tpu.internals.expression import declare_type
+
+        return declare_type
+    if name == "attach_prober":
+        from pathway_tpu.internals.run import attach_prober
+
+        return attach_prober
     if name == "iterate":
         from pathway_tpu.internals.iterate import iterate
 

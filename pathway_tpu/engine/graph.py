@@ -162,6 +162,10 @@ class EngineGraph:
 
     def __init__(self) -> None:
         self.nodes: list[Node] = []
+        #: per-epoch stats callbacks (reference attach_prober/probe_table,
+        #: src/engine/graph.rs:988-995); invoked by the scheduler on
+        #: worker 0 after every epoch
+        self.probers: list[Callable[[dict], None]] = []
 
     def register(self, node: Node) -> int:
         self.nodes.append(node)

@@ -318,6 +318,29 @@ class Scheduler:
                     pending[consumer.id][port].extend(out)
         for node in self.graph.nodes:
             node.on_time_end(ctx, time)
+        if tid == 0 and self.graph.probers:
+            # copied per epoch: the live probe dicts mutate in place, so
+            # handing out references would make every stored snapshot
+            # show the final cumulative totals
+            snapshot = {
+                "time": time,
+                "operators": {
+                    nid: dict(p)
+                    for nid, p in ctx.stats.get("operators", {}).items()
+                },
+                "connectors": {
+                    name: dict(s) for name, s in self.connector_stats.items()
+                },
+            }
+            for cb in self.graph.probers:
+                try:
+                    cb(snapshot)
+                except Exception:  # probers must never break the run
+                    import logging
+
+                    logging.getLogger("pathway_tpu").warning(
+                        "prober callback failed", exc_info=True
+                    )
 
     def _finish(
         self,

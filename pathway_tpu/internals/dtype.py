@@ -156,7 +156,24 @@ class Future(DType):
         return value is api.PENDING or self.wrapped.is_value_compatible(value)
 
 
+class DateTimeNaive(datetime.datetime):
+    """Schema annotation for timezone-naive datetimes (reference
+    ``pw.DateTimeNaive``)."""
+
+
+class DateTimeUtc(datetime.datetime):
+    """Schema annotation for timezone-aware datetimes (reference
+    ``pw.DateTimeUtc``)."""
+
+
+class Duration(datetime.timedelta):
+    """Schema annotation for durations (reference ``pw.Duration``)."""
+
+
 _FROM_PY: dict[_Any, DType] = {
+    DateTimeNaive: DATE_TIME_NAIVE,
+    DateTimeUtc: DATE_TIME_UTC,
+    Duration: DURATION,
     int: INT,
     float: FLOAT,
     bool: BOOL,

@@ -886,6 +886,32 @@ def cast(target_type: Any, expr: Any) -> ColumnExpression:
     return CastExpression(dt.wrap(target_type), _wrap(expr))
 
 
+class DeclareTypeExpression(ColumnExpression):
+    """Static type assertion WITHOUT runtime conversion (reference
+    ``pw.declare_type``): the value passes through untouched, only the
+    declared dtype changes."""
+
+    def __init__(self, target: dt.DType, expr: ColumnExpression):
+        self._dtype = target
+        self._expr = expr
+
+    def __repr__(self) -> str:
+        return f"declare_type({self._dtype!r}, {self._expr!r})"
+
+    def _children(self):
+        return (self._expr,)
+
+    def _rebuild(self, children):
+        return DeclareTypeExpression(self._dtype, children[0])
+
+    def _compile(self, resolver):
+        return self._expr._compile(resolver)
+
+
+def declare_type(target_type: Any, expr: Any) -> ColumnExpression:
+    return DeclareTypeExpression(dt.wrap(target_type), _wrap(expr))
+
+
 def unwrap(expr: Any) -> ColumnExpression:
     return UnwrapExpression(_wrap(expr))
 

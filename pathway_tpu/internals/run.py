@@ -155,3 +155,10 @@ def _run_inner(
 
 def run_all(**kwargs: Any):
     return run(**kwargs)
+
+
+def attach_prober(callback: Any) -> None:
+    """Register a per-epoch stats callback (reference ``attach_prober`` /
+    ``probe_table``, ``src/engine/graph.rs:988-995``): invoked on worker 0
+    after every epoch with ``{"time", "operators", "connectors"}``."""
+    G.engine_graph.probers.append(callback)

@@ -185,9 +185,9 @@ class JittedEncoder:
     def _run_pipelined(
         self, texts: list, pair: "list | None"
     ) -> list[np.ndarray]:
-        """Tokenize/dispatch up to ``_PIPELINE_DEPTH`` chunks ahead of the
-        oldest uncollected readback, so tokenize + device compute + host
-        transfer of different chunks all overlap."""
+        """Tokenize/dispatch up to ``self.pipeline_depth`` chunks before
+        collecting the oldest readback, so tokenize + device compute +
+        host transfer of different chunks all overlap."""
         from collections import deque
 
         outs: list[np.ndarray] = []
@@ -197,7 +197,7 @@ class JittedEncoder:
                 chunk, pair=pchunk, max_len=self.max_len
             )
             inflight.append(self._dispatch(ids, mask, tps))
-            if len(inflight) > self.pipeline_depth:
+            if len(inflight) >= self.pipeline_depth:
                 out, nrows = inflight.popleft()
                 outs.append(np.asarray(out)[:nrows])
         while inflight:

@@ -663,3 +663,44 @@ def test_concat_same_universe_raises_or_works():
     from tests.utils import _rows_of
 
     assert sorted(_rows_of(res).values()) == [(1,), (2,)]
+
+
+def test_api_surface_parity_names():
+    """Reference top-level exports resolve (pw.asynchronous alias,
+    declare_type, datetime annotation types, attach_prober,
+    PersistenceMode re-export)."""
+    from pathway_tpu.internals import dtype as dt
+
+    for name in (
+        "asynchronous", "declare_type", "DateTimeNaive", "DateTimeUtc",
+        "Duration", "attach_prober", "PersistenceMode",
+    ):
+        assert getattr(pw, name) is not None, name
+    S = pw.schema_from_types(a=pw.DateTimeNaive, b=pw.DateTimeUtc, c=pw.Duration)
+    assert S.__columns__["a"].dtype == dt.DATE_TIME_NAIVE
+    assert S.__columns__["b"].dtype == dt.DATE_TIME_UTC
+    assert S.__columns__["c"].dtype == dt.DURATION
+
+
+def test_declare_type_and_prober():
+    from pathway_tpu.internals import dtype as dt
+
+    t = T(
+        """
+    v
+    3
+    """
+    )
+    out = t.select(f=pw.declare_type(float, t.v))
+    assert out._dtypes["f"] == dt.FLOAT  # declared only, value untouched
+    cap = out._capture_node()
+    seen = []
+    pw.attach_prober(seen.append)  # whole per-epoch snapshots
+    ctx = pw.run(monitoring_level="none")
+    (row,) = ctx.state(cap)["rows"].values()
+    assert row == (3,)
+    assert seen  # fired at least once per epoch
+    # the SNAPSHOTS carry operator stats (not just the live ctx dicts)
+    assert any(
+        p["rows_in"] for s in seen for p in s["operators"].values()
+    )

