@@ -318,7 +318,13 @@ class Scheduler:
                     pending[consumer.id][port].extend(out)
         for node in self.graph.nodes:
             node.on_time_end(ctx, time)
-        if tid == 0 and self.graph.probers:
+        # GLOBAL worker 0 only (process 0, thread 0): a cluster must report
+        # each epoch once, not once per process
+        if (
+            tid == 0
+            and self.graph.probers
+            and (cluster is None or cluster.worker_index(0) == 0)
+        ):
             # copied per epoch: the live probe dicts mutate in place, so
             # handing out references would make every stored snapshot
             # show the final cumulative totals
