@@ -318,18 +318,16 @@ class Scheduler:
                     pending[consumer.id][port].extend(out)
         for node in self.graph.nodes:
             node.on_time_end(ctx, time)
-        # GLOBAL worker 0 only (process 0, thread 0): a cluster must report
-        # each epoch once, not once per process
-        if (
-            tid == 0
-            and self.graph.probers
-            and (cluster is None or cluster.worker_index(0) == 0)
-        ):
-            # copied per epoch: the live probe dicts mutate in place, so
-            # handing out references would make every stored snapshot
-            # show the final cumulative totals
+        if self.graph.probers:
+            # per-WORKER stats, like the reference's ProberStats (each
+            # worker probes its own partition; a fleet-wide view is the
+            # consumer's aggregation over the "worker" field).  Copied per
+            # epoch: the live probe dicts mutate in place, so handing out
+            # references would make every stored snapshot show the final
+            # cumulative totals.
             snapshot = {
                 "time": time,
+                "worker": cluster.worker_index(tid) if cluster else 0,
                 "operators": {
                     nid: dict(p)
                     for nid, p in ctx.stats.get("operators", {}).items()

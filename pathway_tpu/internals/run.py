@@ -159,6 +159,8 @@ def run_all(**kwargs: Any):
 
 def attach_prober(callback: Any) -> None:
     """Register a per-epoch stats callback (reference ``attach_prober`` /
-    ``probe_table``, ``src/engine/graph.rs:988-995``): invoked on worker 0
-    after every epoch with ``{"time", "operators", "connectors"}``."""
+    ``probe_table``, ``src/engine/graph.rs:988-995``): invoked by EVERY
+    worker after each of its epochs with ``{"time", "worker",
+    "operators", "connectors"}`` — per-worker partition stats like the
+    reference's ProberStats; aggregate over ``worker`` for a fleet view."""
     G.engine_graph.probers.append(callback)

@@ -133,6 +133,83 @@ def compile_exprs(
     return row_fn
 
 
+class TableSlice:
+    """An ordered {output name -> column reference} view of a table
+    (reference ``internals/table_slice.py``).  Iterating yields the
+    references; passing the slice to ``select``/``with_columns`` keeps
+    its renames."""
+
+    def __init__(self, table: Any, mapping: "dict[str, ColumnReference]"):
+        self._table = table
+        self._mapping = dict(mapping)
+
+    def __iter__(self):
+        return iter(self._mapping.values())
+
+    def keys(self) -> list[str]:
+        return list(self._mapping)
+
+    def __repr__(self) -> str:
+        return f"TableSlice({list(self._mapping)})"
+
+    def _name_of(self, col: Any) -> str:
+        if isinstance(col, ColumnReference):
+            if col._table is not self._table:
+                raise ValueError(
+                    f"column reference {col!r} belongs to a different table "
+                    "than this slice"
+                )
+            name = col._name
+        else:
+            name = col
+        if name not in self._mapping:
+            raise KeyError(
+                f"slice has no column {name!r}; available: {list(self._mapping)}"
+            )
+        return name
+
+    def __getitem__(self, arg: Any):
+        if isinstance(arg, (list, tuple)):
+            return TableSlice(
+                self._table,
+                {self._name_of(c): self._mapping[self._name_of(c)] for c in arg},
+            )
+        return self._mapping[self._name_of(arg)]
+
+    def without(self, *cols: Any) -> "TableSlice":
+        drop = {self._name_of(c) for c in cols}
+        return TableSlice(
+            self._table,
+            {n: r for n, r in self._mapping.items() if n not in drop},
+        )
+
+    def rename(self, mapping: "dict[Any, str]") -> "TableSlice":
+        renames = {self._name_of(k): v for k, v in mapping.items()}
+        out: dict[str, ColumnReference] = {}
+        for n, r in self._mapping.items():
+            target = renames.get(n, n)
+            if target in out or (
+                target != n and target in self._mapping and target not in renames
+            ):
+                # a collision would silently drop a column's data
+                raise ValueError(
+                    f"rename target {target!r} collides with an existing "
+                    "column; rename or drop the other column first"
+                )
+            out[target] = r
+        return TableSlice(self._table, out)
+
+    def with_prefix(self, prefix: str) -> "TableSlice":
+        return TableSlice(
+            self._table, {prefix + n: r for n, r in self._mapping.items()}
+        )
+
+    def with_suffix(self, suffix: str) -> "TableSlice":
+        return TableSlice(
+            self._table, {n + suffix: r for n, r in self._mapping.items()}
+        )
+
+
 def _contains_async(expr: ColumnExpression) -> bool:
     stack = [expr]
     while stack:
@@ -216,6 +293,15 @@ class Table:
             return self.select(*[self[c] for c in arg])
         raise TypeError(f"Cannot index Table with {arg!r}")
 
+    @property
+    def slice(self) -> "TableSlice":
+        """Lazy column-set helper (reference ``TableSlice``,
+        ``internals/table_slice.py``): ``t.select(t.slice.without("a"))``,
+        ``t.slice.with_prefix("l_")`` etc."""
+        return TableSlice(
+            self, {c: ColumnReference(self, c) for c in self._column_names}
+        )
+
     def __iter__(self) -> Iterable[ColumnReference]:
         return iter([self[c] for c in self._column_names])
 
@@ -276,6 +362,13 @@ class Table:
                 for c in self._column_names:
                     names.append(c)
                     exprs.append(ColumnReference(self, c))
+                continue
+            if isinstance(a, TableSlice):
+                # t.select(*...) also works, but passing the slice itself
+                # keeps its renames: select(t.slice.with_prefix("l_"))
+                for n, ref in a._mapping.items():
+                    names.append(n)
+                    exprs.append(ref)
                 continue
             e = self._subst(a)
             n = smart_name(e)

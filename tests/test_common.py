@@ -704,3 +704,42 @@ def test_declare_type_and_prober():
     assert any(
         p["rows_in"] for s in seen for p in s["operators"].values()
     )
+
+
+def test_table_slice_api():
+    """TableSlice (reference internals/table_slice.py): without /
+    rename / with_prefix / with_suffix / subsetting, usable in select."""
+    t = T(
+        """
+    a | b | c
+    1 | 2 | 3
+    """
+    )
+    s = t.slice
+    assert s.keys() == ["a", "b", "c"]
+    out = t.select(s.without("b"))
+    assert out._column_names == ["a", "c"]
+    pre = t.select(t.slice.with_prefix("l_"))
+    assert pre._column_names == ["l_a", "l_b", "l_c"]
+    ren = t.select(t.slice.rename({"a": "x"})[["x", "c"]])
+    assert ren._column_names == ["x", "c"]
+    from tests.utils import run_to_rows as _rows
+
+    (row,) = _rows(t.select(t.slice.with_suffix("_r").without("b_r")))
+    assert row == (1, 3)
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError, match="zz"):
+        t.slice.without("zz")
+    with _pytest.raises(ValueError, match="collides"):
+        t.slice.rename({"a": "b"})  # would silently drop a column
+    # swaps are legal
+    assert t.slice.rename({"a": "b", "b": "a"}).keys() == ["b", "a", "c"]
+    other = T(
+        """
+    a
+    9
+    """
+    )
+    with _pytest.raises(ValueError, match="different table"):
+        t.slice.without(other.a)
