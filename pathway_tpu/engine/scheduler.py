@@ -125,6 +125,9 @@ class Scheduler:
         self._last_snapshot_at: dict[int, float] = {}
         #: per-connector counters keyed by input name (monitoring)
         self.connector_stats: dict[str, dict] = {}
+        #: guards connector_stats registration + prober snapshotting, and
+        #: serializes prober callbacks (they may not be thread-safe)
+        self._prober_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def _snapshot_interval(self) -> float:
@@ -324,27 +327,38 @@ class Scheduler:
             # consumer's aggregation over the "worker" field).  Copied per
             # epoch: the live probe dicts mutate in place, so handing out
             # references would make every stored snapshot show the final
-            # cumulative totals.
-            snapshot = {
-                "time": time,
-                "worker": cluster.worker_index(tid) if cluster else 0,
-                "operators": {
-                    nid: dict(p)
-                    for nid, p in ctx.stats.get("operators", {}).items()
-                },
-                "connectors": {
-                    name: dict(s) for name, s in self.connector_stats.items()
-                },
-            }
-            for cb in self.graph.probers:
-                try:
-                    cb(snapshot)
-                except Exception:  # probers must never break the run
-                    import logging
+            # cumulative totals.  Connector counters are PROCESS-global,
+            # so only thread 0's snapshot carries them (summing across
+            # worker snapshots must not multiply them), and the lock both
+            # keeps the registry iteration safe against sibling threads
+            # registering connectors and serializes the callbacks (they
+            # need not be thread-safe).
+            with self._prober_lock:
+                snapshot = {
+                    "time": time,
+                    "worker": cluster.worker_index(tid) if cluster else 0,
+                    "operators": {
+                        nid: dict(p)
+                        for nid, p in ctx.stats.get("operators", {}).items()
+                    },
+                    "connectors": (
+                        {
+                            name: dict(s)
+                            for name, s in self.connector_stats.items()
+                        }
+                        if tid == 0
+                        else {}
+                    ),
+                }
+                for cb in self.graph.probers:
+                    try:
+                        cb(snapshot)
+                    except Exception:  # probers must never break the run
+                        import logging
 
-                    logging.getLogger("pathway_tpu").warning(
-                        "prober callback failed", exc_info=True
-                    )
+                        logging.getLogger("pathway_tpu").warning(
+                            "prober callback failed", exc_info=True
+                        )
 
     def _finish(
         self,
@@ -470,7 +484,8 @@ class Scheduler:
         threads: list[threading.Thread] = []
         wrappers: dict[int, Any] = {}
         for node in live_inputs:
-            cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
+            with self._prober_lock:
+                cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
             events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
             if self.persistence is not None:
                 events = self.persistence.wrap_events(
@@ -639,7 +654,8 @@ class Scheduler:
         q: "queue.Queue" = queue.Queue()
         wrappers: dict[int, Any] = {}
         for node, subject in my_inputs:
-            cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
+            with self._prober_lock:
+                cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
             events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
             if self.persistence is not None:
                 events = self.persistence.wrap_events(
