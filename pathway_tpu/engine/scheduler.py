@@ -130,6 +130,14 @@ class Scheduler:
         self._prober_lock = threading.Lock()
 
     # ------------------------------------------------------------------
+    def snapshot_connector_stats(self) -> dict[str, dict]:
+        """Race-free copy of the per-connector counters — the ONLY safe
+        way to read them from another thread (dashboard, /metrics,
+        probers): registration mutates the registry under the same
+        lock."""
+        with self._prober_lock:
+            return {name: dict(s) for name, s in self.connector_stats.items()}
+
     def _snapshot_interval(self) -> float:
         """Snapshot rate limit in ms — ONE policy for single-worker and
         cluster paths (they must snapshot at the same cadence)."""
@@ -485,8 +493,11 @@ class Scheduler:
         wrappers: dict[int, Any] = {}
         for node in live_inputs:
             with self._prober_lock:
+                # counter-key setdefaults inside ConnectorEvents must also
+                # happen under the lock: a concurrent snapshot's dict(s)
+                # copy would otherwise hit a resizing dict
                 cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
-            events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
+                events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
             if self.persistence is not None:
                 events = self.persistence.wrap_events(
                     node, events, replayed_counts.get(node.id, 0)
@@ -655,8 +666,11 @@ class Scheduler:
         wrappers: dict[int, Any] = {}
         for node, subject in my_inputs:
             with self._prober_lock:
+                # counter-key setdefaults inside ConnectorEvents must also
+                # happen under the lock: a concurrent snapshot's dict(s)
+                # copy would otherwise hit a resizing dict
                 cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
-            events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
+                events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
             if self.persistence is not None:
                 events = self.persistence.wrap_events(
                     node, events, replayed_counts.get(node.id, 0), worker=w

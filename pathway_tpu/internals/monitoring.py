@@ -36,7 +36,8 @@ class ProberStats:
 
 def collect_stats(sched: Any) -> ProberStats:
     ctx = sched.ctx
-    connectors = {k: dict(v) for k, v in sched.connector_stats.items()}
+    # race-free copy: worker threads register connectors concurrently
+    connectors = sched.snapshot_connector_stats()
     probes = {k: dict(v) for k, v in ctx.stats.get("operators", {}).items()}
     return ProberStats(
         epoch=ctx.time,

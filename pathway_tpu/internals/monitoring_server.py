@@ -24,11 +24,13 @@ def _metrics_text(sched: Any) -> str:
         "# TYPE pathway_tpu_operator_count gauge",
         f"pathway_tpu_operator_count {len(sched.graph.nodes)}",
     ]
-    # per-connector counters (reference src/connectors/monitoring.rs)
-    if sched.connector_stats:
+    # per-connector counters (reference src/connectors/monitoring.rs);
+    # copied under the scheduler's lock (registration races iteration)
+    connector_stats = sched.snapshot_connector_stats()
+    if connector_stats:
         lines.append("# TYPE pathway_tpu_connector_rows_total counter")
         lines.append("# TYPE pathway_tpu_connector_commits_total counter")
-        for name, c in sorted(sched.connector_stats.items()):
+        for name, c in sorted(connector_stats.items()):
             label = name.replace('"', "'")
             lines.append(
                 f'pathway_tpu_connector_rows_total{{input="{label}"}} '

@@ -249,3 +249,125 @@ def test_window_join():
     ).select(va=pw.left.va, vb=pw.right.vb)
     rows = sorted(run_to_rows(res))
     assert rows == [("a1", "b2"), ("a11", "b12")]
+
+
+def test_intervals_over_window():
+    """intervals_over: one output row per `at` probe, aggregating source
+    rows within [at+lower, at+upper] (reference _window.py:595+)."""
+    data = T(
+        """
+    t  | v
+    1  | 10
+    3  | 30
+    5  | 50
+    9  | 90
+    """
+    )
+    probes = T(
+        """
+    at
+    2
+    6
+    """
+    )
+    res = data.windowby(
+        data.t,
+        window=pw.temporal.intervals_over(
+            at=probes.at, lower_bound=-2, upper_bound=1
+        ),
+    ).reduce(
+        total=pw.reducers.sum(pw.this.v),
+        n=pw.reducers.count(),
+    )
+    res = res.select(at=pw.this._pw_window_start, total=pw.this.total, n=pw.this.n)
+    rows = sorted(run_to_rows(res))
+    # at=2: t in [0,3] -> 10+30; at=6: t in [4,7] -> 50
+    assert rows == [(2, 40, 2), (6, 50, 1)]
+
+
+def test_sliding_window_ratio():
+    t = T(
+        """
+    t | v
+    0 | 1
+    2 | 1
+    4 | 1
+    """
+    )
+    res = t.windowby(
+        t.t, window=pw.temporal.sliding(hop=2, ratio=2)
+    ).reduce(
+        n=pw.reducers.count(),
+    )
+    res = res.select(s=pw.this._pw_window_start, n=pw.this.n)
+    rows = sorted(run_to_rows(res))
+    # duration = hop * ratio = 4; windows [-2,2),[0,4),[2,6),[4,8)
+    assert rows == [(-2, 1), (0, 2), (2, 2), (4, 1)]
+
+
+def test_session_window_predicate():
+    t = T(
+        """
+    t  | v
+    1  | 1
+    2  | 1
+    10 | 1
+    """
+    )
+    res = t.windowby(
+        t.t,
+        window=pw.temporal.session(predicate=lambda a, b: abs(a - b) <= 3),
+    ).reduce(n=pw.reducers.count())
+    res = res.select(n=pw.this.n)
+    assert sorted(run_to_rows(res)) == [(1,), (2,)]
+
+
+def test_interval_join_left_and_right():
+    left = T(
+        """
+    t | a
+    1 | l1
+    5 | l2
+    """
+    )
+    right = T(
+        """
+    t | b
+    2 | r1
+    9 | r2
+    """
+    )
+    lres = left.interval_join_left(
+        right, left.t, right.t, pw.temporal.interval(-1, 1)
+    ).select(a=left.a, b=right.b)
+    assert sorted(run_to_rows(lres), key=repr) == [("l1", "r1"), ("l2", None)]
+    rres = left.interval_join_right(
+        right, left.t, right.t, pw.temporal.interval(-1, 1)
+    ).select(a=left.a, b=right.b)
+    assert sorted(run_to_rows(rres), key=repr) == [("l1", "r1"), (None, "r2")]
+
+
+def test_asof_join_directions():
+    left = T(
+        """
+    t | a
+    3 | x
+    7 | y
+    """
+    )
+    right = T(
+        """
+    t | p
+    2 | 20
+    5 | 50
+    8 | 80
+    """
+    )
+    fwd = left.asof_join(
+        right, left.t, right.t, how=pw.JoinMode.LEFT, direction="forward"
+    ).select(a=left.a, p=right.p)
+    assert sorted(run_to_rows(fwd)) == [("x", 50), ("y", 80)]
+    nearest = left.asof_join(
+        right, left.t, right.t, how=pw.JoinMode.LEFT, direction="nearest"
+    ).select(a=left.a, p=right.p)
+    assert sorted(run_to_rows(nearest)) == [("x", 20), ("y", 80)]
