@@ -252,6 +252,21 @@ def table_from_pandas(df: Any, id_from: list[str] | None = None, schema: Any = N
     return table_from_rows(schema, rows)
 
 
+def table_from_parquet(
+    path: Any, id_from: list[str] | None = None, schema: Any = None
+) -> Table:
+    """Static table from a parquet file (reference
+    ``debug/__init__.py:312-481`` table_from_parquet)."""
+    import pandas as pd
+
+    return table_from_pandas(pd.read_parquet(path), id_from=id_from, schema=schema)
+
+
+def table_to_parquet(table: Table, filename: Any) -> None:
+    """Run the graph and write the table's final rows to parquet."""
+    table_to_pandas(table, include_id=False).to_parquet(filename)
+
+
 def _run_capture(*tables: Table) -> list[tuple[dict, list]]:
     captures = [t._capture_node() for t in tables]
     sched = Scheduler(G.engine_graph)

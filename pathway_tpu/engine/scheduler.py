@@ -138,6 +138,16 @@ class Scheduler:
         with self._prober_lock:
             return {name: dict(s) for name, s in self.connector_stats.items()}
 
+    def snapshot_operator_probes(self, ctx: Any = None) -> dict[int, dict]:
+        """Race-free copy of the per-operator probes (same contract as
+        :meth:`snapshot_connector_stats`)."""
+        ctx = ctx or self.ctx
+        with self._prober_lock:
+            return {
+                nid: dict(p)
+                for nid, p in ctx.stats.get("operators", {}).items()
+            }
+
     def _snapshot_interval(self) -> float:
         """Snapshot rate limit in ms — ONE policy for single-worker and
         cluster paths (they must snapshot at the same cadence)."""
@@ -309,16 +319,21 @@ class Scheduler:
             dt_ms = (_time.perf_counter() - t0) * 1000.0
             probe = ctx.stats.setdefault("operators", {}).get(node.id)
             if probe is None:
-                probe = {
-                    "name": f"{node.name}#{node.id}",
-                    "kind": type(node).__name__,
-                    "rows_in": 0,
-                    "rows_out": 0,
-                    "total_ms": 0.0,
-                    "max_ms": 0.0,
-                    "epochs": 0,
-                }
-                ctx.stats["operators"][node.id] = probe
+                # registration under the lock: monitoring threads copy this
+                # dict concurrently (see snapshot_operator_probes)
+                with self._prober_lock:
+                    probe = ctx.stats["operators"].setdefault(
+                        node.id,
+                        {
+                            "name": f"{node.name}#{node.id}",
+                            "kind": type(node).__name__,
+                            "rows_in": 0,
+                            "rows_out": 0,
+                            "total_ms": 0.0,
+                            "max_ms": 0.0,
+                            "epochs": 0,
+                        },
+                    )
             probe["rows_in"] += sum(len(b) for b in inbatches)
             probe["rows_out"] += len(out)
             probe["total_ms"] += dt_ms

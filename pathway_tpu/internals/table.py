@@ -664,6 +664,18 @@ class Table:
             family=self._family,
         )
 
+    def await_futures(self) -> "Table":
+        """Reference ``Table.await_futures``: make async-UDF results
+        concrete.  This engine resolves async UDFs WITHIN the epoch
+        (AsyncMapNode batches the whole epoch through the event loop), so
+        values are already concrete — only the Future dtypes unwrap."""
+        out = self.copy()
+        out._dtypes = {
+            c: (d.wrapped if isinstance(d, dt.Future) else d)
+            for c, d in self._dtypes.items()
+        }
+        return out
+
     # -- keys / pointers ----------------------------------------------------
     def pointer_from(self, *args: Any, optional: bool = False, instance: Any = None) -> ColumnExpression:
         # NOTE: `pw.this` in args stays unresolved — it refers to the table

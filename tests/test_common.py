@@ -743,3 +743,23 @@ def test_table_slice_api():
     )
     with _pytest.raises(ValueError, match="different table"):
         t.slice.without(other.a)
+
+
+def test_await_futures_unwraps_dtypes():
+    """Table.await_futures (reference parity): async results are already
+    concrete in this engine, so only Future dtypes unwrap."""
+    from pathway_tpu.internals import dtype as dt
+
+    t = T(
+        """
+    a
+    1
+    """
+    )
+    t2 = t.copy()
+    t2._dtypes = {"a": dt.Future(dt.INT)}
+    out = t2.await_futures()
+    assert out._dtypes["a"] == dt.INT
+    from tests.utils import run_to_rows as _rows
+
+    assert _rows(out.select(out.a)) == [(1,)]

@@ -635,3 +635,18 @@ def test_viz_live_plot_svg():
     assert ">y<" in svg  # numeric series labelled
     html = view._repr_html_()
     assert html == svg
+
+
+def test_debug_parquet_roundtrip(tmp_path):
+    import pandas as pd
+
+    src = tmp_path / "t.parquet"
+    pd.DataFrame({"a": [1, 2], "b": ["x", "y"]}).to_parquet(src)
+    t = pw.debug.table_from_parquet(str(src))
+    out = tmp_path / "o.parquet"
+    pw.debug.table_to_parquet(t.select(t.a, t.b), str(out))
+    back = pd.read_parquet(out)
+    assert back.to_dict("records") == [
+        {"a": 1, "b": "x"},
+        {"a": 2, "b": "y"},
+    ]
