@@ -45,6 +45,60 @@ namespace {
 PyObject* g_unsupported = nullptr;  // exception type for fallback
 PyObject* g_pointer_type = nullptr;  // pathway_tpu Pointer class
 
+// ---------------------------------------------------------------------------
+// CPython 3.13 removed _PyLong_NumBits / _PyLong_AsByteArray /
+// _PyLong_FromByteArray from the public headers (and changed the
+// _PyLong_AsByteArray signature), which would make this whole extension
+// silently fail to compile and every fast path degrade to Python.  Wrap
+// the int<->bytes conversions so 3.13+ uses the new stable
+// PyLong_AsNativeBytes / PyLong_FromNativeBytes API instead.
+// All helpers return 0 / non-NULL on success; on failure the caller is
+// expected to PyErr_Clear() and fall back.
+#if PY_VERSION_HEX >= 0x030D0000
+inline int pt_long_as_bytes_unsigned(PyObject* v, uint8_t* out, size_t n) {
+    Py_ssize_t r = PyLong_AsNativeBytes(
+        v, out, (Py_ssize_t)n,
+        Py_ASNATIVEBYTES_LITTLE_ENDIAN | Py_ASNATIVEBYTES_UNSIGNED_BUFFER |
+            Py_ASNATIVEBYTES_REJECT_NEGATIVE);
+    return (r < 0 || (size_t)r > n) ? -1 : 0;
+}
+inline int pt_long_as_bytes_signed(PyObject* v, uint8_t* out, size_t n) {
+    // sign-extends into the full n-byte buffer, matching
+    // int.to_bytes(n, "little", signed=True)
+    Py_ssize_t r = PyLong_AsNativeBytes(v, out, (Py_ssize_t)n,
+                                        Py_ASNATIVEBYTES_LITTLE_ENDIAN);
+    return (r < 0 || (size_t)r > n) ? -1 : 0;
+}
+inline size_t pt_long_numbits(PyObject* v) {
+    // no public C equivalent of _PyLong_NumBits; the object-protocol call
+    // is acceptable because this only runs on the rare >64-bit path
+    PyObject* bl = PyObject_CallMethod(v, "bit_length", nullptr);
+    if (bl == nullptr) return (size_t)-1;
+    size_t bits = PyLong_AsSize_t(bl);
+    Py_DECREF(bl);
+    if (bits == (size_t)-1 && PyErr_Occurred()) return (size_t)-1;
+    return bits;
+}
+inline PyObject* pt_long_from_bytes_unsigned(const uint8_t* buf, size_t n) {
+    return PyLong_FromNativeBytes(
+        buf, (Py_ssize_t)n,
+        Py_ASNATIVEBYTES_LITTLE_ENDIAN | Py_ASNATIVEBYTES_UNSIGNED_BUFFER);
+}
+#else
+inline int pt_long_as_bytes_unsigned(PyObject* v, uint8_t* out, size_t n) {
+    return _PyLong_AsByteArray(reinterpret_cast<PyLongObject*>(v), out, n,
+                               /*little_endian=*/1, /*is_signed=*/0);
+}
+inline int pt_long_as_bytes_signed(PyObject* v, uint8_t* out, size_t n) {
+    return _PyLong_AsByteArray(reinterpret_cast<PyLongObject*>(v), out, n,
+                               /*little_endian=*/1, /*is_signed=*/1);
+}
+inline size_t pt_long_numbits(PyObject* v) { return _PyLong_NumBits(v); }
+inline PyObject* pt_long_from_bytes_unsigned(const uint8_t* buf, size_t n) {
+    return _PyLong_FromByteArray(buf, n, /*little_endian=*/1, /*signed=*/0);
+}
+#endif
+
 const char kSalt[] = "pathway_tpu.key.v1";
 
 struct Hasher {
@@ -75,8 +129,7 @@ bool feed(Hasher& h, PyObject* v) {
     if (g_pointer_type != nullptr &&
         PyObject_TypeCheck(v, reinterpret_cast<PyTypeObject*>(g_pointer_type))) {
         uint8_t out[16];
-        if (_PyLong_AsByteArray(reinterpret_cast<PyLongObject*>(v), out, 16,
-                                /*little_endian=*/1, /*is_signed=*/0) < 0) {
+        if (pt_long_as_bytes_unsigned(v, out, 16) < 0) {
             PyErr_Clear();
             return false;  // >128-bit pointer: fall back
         }
@@ -90,7 +143,7 @@ bool feed(Hasher& h, PyObject* v) {
         if (overflow != 0) {
             // big int (e.g. 128-bit join/derive key material): replicate
             // value.to_bytes((bit_length + 8)//8 + 1, "little", signed)
-            size_t bits = _PyLong_NumBits(v);
+            size_t bits = pt_long_numbits(v);
             if (bits == (size_t)-1) {
                 PyErr_Clear();
                 return false;
@@ -98,9 +151,7 @@ bool feed(Hasher& h, PyObject* v) {
             size_t nb = (bits + 8) / 8 + 1;
             uint8_t buf[64];
             if (nb > sizeof(buf)) return false;  // >~500 bits: fall back
-            if (_PyLong_AsByteArray(reinterpret_cast<PyLongObject*>(v), buf,
-                                    nb, /*little_endian=*/1,
-                                    /*is_signed=*/1) < 0) {
+            if (pt_long_as_bytes_signed(v, buf, nb) < 0) {
                 PyErr_Clear();
                 return false;
             }
@@ -162,7 +213,7 @@ bool feed(Hasher& h, PyObject* v) {
 PyObject* digest_to_long(Hasher& h) {
     uint8_t out[16];
     pwnative::blake2b_final(&h.S, out);
-    return _PyLong_FromByteArray(out, 16, /*little_endian=*/1, /*signed=*/0);
+    return pt_long_from_bytes_unsigned(out, 16);
 }
 
 PyObject* py_ref_scalar(PyObject*, PyObject* args_tuple) {

@@ -141,27 +141,37 @@ class _MultisetReducer(ReducerImpl):
     native_code = 2
 
     def update(self, acc, args, diff):
+        # Signed accumulation: counts may go transiently negative (a
+        # retraction arriving before its matching addition inside one
+        # batch) and are clamped only at extract time via _items.  This
+        # matches the native groupby_partials netting semantics — the
+        # native path nets per-batch deltas before applying them, so
+        # clamping per-event here would diverge on inconsistent streams.
         h = hashable(args)
-        acc["counter"][h] += diff
-        if acc["counter"][h] <= 0:
+        c = acc["counter"][h] + diff
+        if c == 0:
             del acc["counter"][h]
             acc["orig"].pop(h, None)
         else:
+            acc["counter"][h] = c
             acc["orig"].setdefault(h, args)
 
     def merge_partial(self, acc, partial):
         counter = acc["counter"]
         orig = acc["orig"]
         for h, (delta, args) in partial.items():
-            counter[h] += delta
-            if counter[h] <= 0:
+            c = counter[h] + delta
+            if c == 0:
                 del counter[h]
                 orig.pop(h, None)
             else:
+                counter[h] = c
                 orig.setdefault(h, args)
 
     def _items(self, acc):
-        return [(acc["orig"][h], c) for h, c in acc["counter"].items()]
+        # only positive multiplicities are visible; negatives are pending
+        # retractions awaiting their additions
+        return [(acc["orig"][h], c) for h, c in acc["counter"].items() if c > 0]
 
 
 class MinReducer(_MultisetReducer):

@@ -125,9 +125,13 @@ class Scheduler:
         self._last_snapshot_at: dict[int, float] = {}
         #: per-connector counters keyed by input name (monitoring)
         self.connector_stats: dict[str, dict] = {}
-        #: guards connector_stats registration + prober snapshotting, and
-        #: serializes prober callbacks (they may not be thread-safe)
+        #: guards connector_stats registration + prober snapshotting
         self._prober_lock = threading.Lock()
+        #: serializes prober callbacks (they may not be thread-safe).
+        #: Separate from _prober_lock so a callback may itself call
+        #: snapshot_connector_stats()/snapshot_operator_probes() without
+        #: deadlocking; lock order is always cb_lock -> prober_lock.
+        self._prober_cb_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def snapshot_connector_stats(self) -> dict[str, dict]:
@@ -352,28 +356,32 @@ class Scheduler:
             # references would make every stored snapshot show the final
             # cumulative totals.  Connector counters are PROCESS-global,
             # so only thread 0's snapshot carries them (summing across
-            # worker snapshots must not multiply them), and the lock both
-            # keeps the registry iteration safe against sibling threads
-            # registering connectors and serializes the callbacks (they
-            # need not be thread-safe).
-            with self._prober_lock:
-                snapshot = {
-                    "time": time,
-                    "worker": cluster.worker_index(tid) if cluster else 0,
-                    "operators": {
-                        nid: dict(p)
-                        for nid, p in ctx.stats.get("operators", {}).items()
-                    },
-                    "connectors": (
-                        {
-                            name: dict(s)
-                            for name, s in self.connector_stats.items()
-                        }
-                        if tid == 0
-                        else {}
-                    ),
-                }
-                for cb in self.graph.probers:
+            # worker snapshots must not multiply them).  The snapshot is
+            # built under _prober_lock (registry-iteration safety) but the
+            # callbacks run under _prober_cb_lock only, so a prober may
+            # itself call snapshot_connector_stats()/snapshot_operator_probes()
+            # — the documented "only safe way" to read live stats — without
+            # deadlocking on the non-reentrant prober lock.
+            with self._prober_cb_lock:
+                with self._prober_lock:
+                    snapshot = {
+                        "time": time,
+                        "worker": cluster.worker_index(tid) if cluster else 0,
+                        "operators": {
+                            nid: dict(p)
+                            for nid, p in ctx.stats.get("operators", {}).items()
+                        },
+                        "connectors": (
+                            {
+                                name: dict(s)
+                                for name, s in self.connector_stats.items()
+                            }
+                            if tid == 0
+                            else {}
+                        ),
+                    }
+                    probers = list(self.graph.probers)
+                for cb in probers:
                     try:
                         cb(snapshot)
                     except Exception:  # probers must never break the run
@@ -480,6 +488,21 @@ class Scheduler:
                             pending.append((node_wall, seq, node.id, epoch))
                             seq += 1
                             epoch = []
+            # Legacy commit records (written before wall timestamps were
+            # recorded) carry wall == -inf.  Backfill each with the next
+            # timestamped wall of the SAME source: those epochs happened
+            # before that commit, and the seq tiebreak keeps per-source
+            # order, so they interleave just ahead of it instead of all
+            # legacy epochs of one source draining before any timestamped
+            # epoch of another.  An all-legacy log degenerates to pure
+            # arrival (seq) order, which is the pre-timestamp behaviour.
+            next_wall: dict[int, float] = {}
+            for i in range(len(pending) - 1, -1, -1):
+                wall, sq, nid, batch = pending[i]
+                if wall == float("-inf") and nid in next_wall:
+                    pending[i] = (next_wall[nid], sq, nid, batch)
+                elif wall != float("-inf"):
+                    next_wall[nid] = wall
             # merge across sources by recorded commit wall clock (stable on
             # ties / legacy records without timestamps)
             pending.sort(key=lambda p: (p[0], p[1]))

@@ -285,11 +285,24 @@ def _retry_flaky(fn):
     import shutil
     import tempfile
 
+    import traceback
+
     @functools.wraps(fn)
     def run(tmp_path):
         try:
             fn(tmp_path)
         except (AssertionError, OSError, subprocess.SubprocessError):
+            # keep flake frequency visible in CI output — a silent first
+            # failure would mask genuinely intermittent regressions.
+            # sys.__stderr__ bypasses pytest capture, which would otherwise
+            # swallow the message when the retry succeeds.
+            import sys
+
+            sys.__stderr__.write(
+                f"\n[flaky] {fn.__name__} failed once, retrying:\n"
+                + traceback.format_exc()
+            )
+            sys.__stderr__.flush()
             fresh = pathlib.Path(tempfile.mkdtemp(prefix="retry_"))
             try:
                 fn(fresh)

@@ -211,6 +211,30 @@ def test_groupby_partials_sum_does_not_alias_ndarray(mod):
     assert (row[1] == np.array([1.0, 2.0])).all()
 
 
+def test_multiset_reducer_nets_retraction_before_addition():
+    """A retraction preceding an addition of equal args inside one batch
+    must net to zero on the per-update Python path exactly as the native
+    merge_partial netting does (advisor r3: per-event clamping diverged)."""
+    from pathway_tpu.engine.reducers import MaxReducer
+
+    r = MaxReducer()
+    # Python per-update path: -1 then +1 of the same args nets to nothing
+    acc = r.make_acc()
+    r.update(acc, (5,), -1)
+    r.update(acc, (5,), 1)
+    assert r.extract(acc) is None
+    # native-partials path: same batch netted before merge
+    acc2 = r.make_acc()
+    from pathway_tpu.engine.stream import hashable
+
+    h = hashable((5,))
+    r.merge_partial(acc2, {h: (0, (5,))})
+    assert r.extract(acc2) is None
+    # and a genuinely present value still extracts on both paths
+    r.update(acc, (7,), 1)
+    assert r.extract(acc) == 7
+
+
 def test_engine_parity_native_vs_python_subprocess(mod):
     """The same pipeline, native enabled vs PATHWAY_DISABLE_NATIVE=1,
     must print byte-identical results."""
