@@ -559,7 +559,21 @@ class Scheduler:
         while True:
             timeout = self.autocommit_ms / 1000.0
             try:
-                nid, kind, key, values = q.get(timeout=timeout)
+                item = q.get(timeout=timeout)
+            except queue.Empty:
+                item = None
+            # Greedy drain: pull everything already queued into the buffers
+            # in one pass, so epoch size tracks the actual backlog instead
+            # of one queue item per loop iteration (an epoch that takes
+            # longer than autocommit_ms would otherwise degenerate to one
+            # reader chunk per epoch).  A commit item ends the drain — rows
+            # enqueued after a commit belong to the next transaction.  The
+            # item cap bounds buffer growth and guarantees the cut/stop
+            # checks below run even against a producer that enqueues as
+            # fast as we drain.
+            drained = 0
+            while item is not None:
+                nid, kind, key, values = item
                 if kind == "add":
                     buffers[nid].append(Update(key, values, 1))
                 elif kind == "batch":
@@ -568,10 +582,16 @@ class Scheduler:
                     buffers[nid].append(Update(key, values, -1))
                 elif kind == "commit":
                     commit_requested = True
+                    break
                 elif kind == "close":
                     open_subjects.discard(nid)
-            except queue.Empty:
-                pass
+                drained += 1
+                if drained >= 8192:
+                    break  # bounded pass: cut/stop checks must run
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    item = None
             now = _time.monotonic()
             have_data = any(buffers.values())
             should_cut = have_data and (
@@ -585,7 +605,9 @@ class Scheduler:
                     consumed[nid] = consumed.get(nid, 0) + len(b)
                 self.run_epoch(t, inject)
                 t += TIME_STEP
-                last_cut = now
+                # post-epoch timestamp: the cut timer measures idle/buffer
+                # time, not epoch processing time
+                last_cut = _time.monotonic()
                 if (
                     self.persistence is not None
                     and self.persistence.operator_mode

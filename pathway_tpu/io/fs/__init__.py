@@ -8,6 +8,7 @@ import os
 import time as _time
 from typing import Any, Callable
 
+from pathway_tpu.internals import native as _native_mod
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.keys import keys_for_values, ref_scalar
 from pathway_tpu.internals.table import Table
@@ -101,7 +102,7 @@ class _FilesSource(RowSource):
         seq = seq_start  # non-empty LINE counter (keys + partitioning)
         add_many = getattr(events, "add_many", None)
         chunk: list = []  # (key, row) additions flushed per _CHUNK rows
-        _CHUNK = 4096
+        _CHUNK = 16384
         _BLOCK = 8 << 20
         schema = self.schema
         meta = (
@@ -121,11 +122,23 @@ class _FilesSource(RowSource):
             # keys for the whole block in ONE native hash call
             if pk:
                 key_args = [tuple(v[c] for c in pk) for v in rows]
+                keys = keys_for_values(key_args)
             else:
-                key_args = [
-                    ("__fs__", self.tag, fp, s + 1) for s in line_seqs
-                ]
-            keys = keys_for_values(key_args)
+                keys = None
+                native = _native_mod.load()
+                if native is not None:
+                    try:
+                        # prefix hash state computed once, per-row seq int
+                        # appended in C — no per-row Python key tuples
+                        keys = native.hash_prefix_ints(
+                            ("__fs__", self.tag, fp), line_seqs, 1
+                        )
+                    except native.Unsupported:
+                        keys = None
+                if keys is None:
+                    keys = keys_for_values(
+                        ("__fs__", self.tag, fp, s + 1) for s in line_seqs
+                    )
             coerced = coerce_rows(rows, schema)
             if add_many is None:
                 for key, row in zip(keys, coerced):

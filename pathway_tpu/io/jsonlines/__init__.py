@@ -68,7 +68,11 @@ def read(
         parser which skips bad rows individually."""
         if json_field_paths:
             return None
-        lines = [ln for ln in data.split(b"\n") if ln.strip()]
+        # plain `if ln` instead of `if ln.strip()`: a per-line strip costs
+        # ~10% of the whole parse; whitespace-only lines are rare enough
+        # that letting them fail the block parse (-> per-line fallback)
+        # is the better trade
+        lines = [ln for ln in data.split(b"\n") if ln]
         if not lines:
             return []
         try:

@@ -52,7 +52,12 @@ class PersistenceMode(enum.Enum):
 
 
 class _BackendImpl:
-    def append(self, stream: str, record: bytes) -> None:
+    def append(self, stream: str, record: bytes, durable: bool = True) -> None:
+        """Append one record.  ``durable=False`` lets the backend defer
+        physical sync: commits are the durability points of the log —
+        replay trusts only the committed prefix (snapshot consumed-counts
+        are always within it), so data records between commits may ride
+        the OS page cache.  Backends without a sync concept ignore it."""
         raise NotImplementedError
 
     def read_all(self, stream: str) -> list[bytes]:
@@ -90,7 +95,7 @@ class _MemoryBackend(_BackendImpl):
         self._blobs = store.setdefault("blobs", {})
         self._lock = threading.Lock()
 
-    def append(self, stream, record):
+    def append(self, stream, record, durable=True):
         with self._lock:
             self._streams.setdefault(stream, []).append(record)
 
@@ -126,22 +131,42 @@ class _FsBackend(_BackendImpl):
         #: per-stream end offset of each complete record, filled by the
         #: read_all scan so truncate() need not rescan multi-GB logs
         self._offsets: dict[str, list[int]] = {}
+        #: cached append handles — an open()+fsync per record would bound
+        #: ingest throughput (measured ~30% of the wordcount benchmark)
+        self._handles: dict[str, Any] = {}
 
     def _stream_path(self, stream: str) -> str:
         safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in stream)
         return os.path.join(self.path, f"{safe}.log")
 
-    def append(self, stream, record):
+    def _handle(self, stream: str):
+        f = self._handles.get(stream)
+        if f is None or f.closed:
+            f = open(self._stream_path(stream), "ab")
+            self._handles[stream] = f
+        return f
+
+    def _drop_handle(self, stream: str) -> None:
+        f = self._handles.pop(stream, None)
+        if f is not None and not f.closed:
+            f.close()
+
+    def append(self, stream, record, durable=True):
         with self._lock:
             self._offsets.pop(stream, None)  # offset cache is now stale
-            with open(self._stream_path(stream), "ab") as f:
-                f.write(len(record).to_bytes(8, "little"))
-                f.write(record)
-                f.flush()
+            f = self._handle(stream)
+            f.write(len(record).to_bytes(8, "little"))
+            f.write(record)
+            f.flush()  # always reaches the OS page cache
+            if durable:  # commits/snapshots survive power loss
                 os.fsync(f.fileno())
 
     def read_all(self, stream):
         path = self._stream_path(stream)
+        with self._lock:
+            f_open = self._handles.get(stream)
+            if f_open is not None and not f_open.closed:
+                f_open.flush()
         if not os.path.exists(path):
             return []
         out = []
@@ -166,6 +191,7 @@ class _FsBackend(_BackendImpl):
         if not os.path.exists(path):
             return
         with self._lock:
+            self._drop_handle(stream)  # the append handle's position is stale
             offsets = self._offsets.get(stream)
             if offsets is None:  # no prior scan: find record boundaries now
                 keep = 0
@@ -276,7 +302,8 @@ class _S3Backend(_BackendImpl):
     def _stream_keys(self, stream: str) -> list[str]:
         return self._list(self._key("streams", stream) + "/")
 
-    def append(self, stream, record):
+    def append(self, stream, record, durable=True):
+        # S3 puts are atomic and durable on success; the flag is moot
         with self._lock:
             n = self._counters.get(stream)
             if n is None:
@@ -451,7 +478,11 @@ class _RecordingEvents:
             return
         # keys log as plain ints: pickling the Pointer int-subclass goes
         # through per-object copyreg and is ~2.4x slower; replay rewraps
-        self._impl.append(self._stream, pickle.dumps((kind, int(key), values)))
+        # durable=False: commits are the log's durability points (replay
+        # trusts only the committed prefix), so data records may defer sync
+        self._impl.append(
+            self._stream, pickle.dumps((kind, int(key), values)), durable=False
+        )
         self._dirty = True
         forward(key, values)
 
@@ -473,6 +504,7 @@ class _RecordingEvents:
             pickle.dumps(
                 ("addmany", [(int(k), v) for k, v in rows], None)
             ),
+            durable=False,
         )
         self._dirty = True
         self._inner.add_many(rows)
