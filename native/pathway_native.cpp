@@ -31,9 +31,12 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
+#include <climits>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -271,6 +274,124 @@ PyObject* py_hash_rows(PyObject*, PyObject* rows) {
     return out;
 }
 
+// Feed a small (64-bit) signed int exactly like the PyLong branch of
+// feed(): n = (bit_length + 8)//8 + 1 bytes, signed little-endian.
+inline void feed_small_int(Hasher& h, long long val) {
+    unsigned long long mag =
+        val < 0 ? (unsigned long long)(-(val + 1)) + 1ULL
+                : (unsigned long long)val;
+    int bl = mag ? 64 - __builtin_clzll(mag) : 0;
+    int n = (bl + 8) / 8 + 1;
+    uint8_t buf[16];
+    long long x = val;
+    for (int i = 0; i < n; i++) {
+        buf[i] = (uint8_t)(x & 0xff);
+        x >>= 8;
+    }
+    h.tag(0x02);
+    h.bytes(buf, n);
+}
+
+// Feed any PyLong (including a Pointer) as a PLAIN int — tag 0x02 signed
+// little-endian, matching ref_scalar(int(v)).  Returns false (no
+// exception or cleared) when the value exceeds the big-int window.
+bool feed_pylong_plain(Hasher& h, PyObject* v) {
+    int overflow = 0;
+    long long val = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (overflow == 0) {
+        if (val == -1 && PyErr_Occurred()) {
+            PyErr_Clear();
+            return false;
+        }
+        feed_small_int(h, val);
+        return true;
+    }
+    size_t bits = pt_long_numbits(v);
+    if (bits == (size_t)-1) {
+        PyErr_Clear();
+        return false;
+    }
+    size_t nb = (bits + 8) / 8 + 1;
+    uint8_t buf[64];
+    if (nb > sizeof(buf)) return false;
+    if (pt_long_as_bytes_signed(v, buf, nb) < 0) {
+        PyErr_Clear();
+        return false;
+    }
+    h.tag(0x02);
+    h.bytes(buf, nb);
+    return true;
+}
+
+PyObject* py_hash_prefix_ints(PyObject*, PyObject* args) {
+    // (prefix_tuple, seq_ints, offset=0) -> list of Pointer
+    //
+    // Bulk key generation for sequentially numbered connector rows
+    // (io/fs emit_rows): the prefix ("__fs__", tag, path) hash state is
+    // computed ONCE and copied per row, so neither the per-row Python
+    // key tuple nor the re-hash of the constant prefix exists.  Rows
+    // become Pointer objects here (one C call) instead of a Python
+    // listcomp over hash_rows output.  Byte-identical to
+    // ref_scalar(*prefix, seq + offset).
+    PyObject* prefix;
+    PyObject* seqs;
+    long long offset = 0;
+    if (!PyArg_ParseTuple(args, "O!O|L", &PyTuple_Type, &prefix, &seqs,
+                          &offset))
+        return nullptr;
+    if (g_pointer_type == nullptr) {
+        PyErr_SetString(g_unsupported, "Pointer type not registered");
+        return nullptr;
+    }
+    Hasher base;
+    Py_ssize_t m = PyTuple_GET_SIZE(prefix);
+    for (Py_ssize_t j = 0; j < m; j++) {
+        if (!feed(base, PyTuple_GET_ITEM(prefix, j))) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(g_unsupported, "unsupported value type");
+            return nullptr;
+        }
+    }
+    PyObject* seq = PySequence_Fast(seqs, "hash_prefix_ints expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(n);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* s = PySequence_Fast_GET_ITEM(seq, i);
+        int overflow = 0;
+        long long v = PyLong_AsLongLongAndOverflow(s, &overflow);
+        if (overflow != 0 || (v == -1 && PyErr_Occurred())) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            if (!PyErr_Occurred())
+                PyErr_SetString(g_unsupported, "seq out of int64 range");
+            return nullptr;
+        }
+        Hasher h = base;  // copy of the prefix hash state
+        feed_small_int(h, v + offset);
+        PyObject* num = digest_to_long(h);
+        if (num == nullptr) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyObject* ptr = PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
+        Py_DECREF(num);
+        if (ptr == nullptr) {
+            Py_DECREF(seq);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, i, ptr);
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
 PyObject* py_scan_lines(PyObject*, PyObject* arg) {
     // bytes -> list of (start, end) offsets of non-empty lines
     char* data;
@@ -305,12 +426,9 @@ PyObject* py_scan_lines(PyObject*, PyObject* arg) {
 // Update is a Python NamedTuple (engine/stream.py); instances are plain
 // tuple subclass objects, so tuple's own tp_new builds them without going
 // through the Python-level __new__ (same trick as namedtuple._make).
-PyObject* make_update(PyObject* cls, PyObject* key, PyObject* values,
-                      long long diff) {
-    PyObject* d = PyLong_FromLongLong(diff);
-    if (d == nullptr) return nullptr;
-    PyObject* inner = PyTuple_Pack(3, key, values, d);
-    Py_DECREF(d);
+PyObject* make_update_obj(PyObject* cls, PyObject* key, PyObject* values,
+                          PyObject* diff) {
+    PyObject* inner = PyTuple_Pack(3, key, values, diff);
     if (inner == nullptr) return nullptr;
     PyObject* args = PyTuple_Pack(1, inner);
     Py_DECREF(inner);
@@ -318,6 +436,15 @@ PyObject* make_update(PyObject* cls, PyObject* key, PyObject* values,
     PyObject* u = PyTuple_Type.tp_new(reinterpret_cast<PyTypeObject*>(cls),
                                       args, nullptr);
     Py_DECREF(args);
+    return u;
+}
+
+PyObject* make_update(PyObject* cls, PyObject* key, PyObject* values,
+                      long long diff) {
+    PyObject* d = PyLong_FromLongLong(diff);
+    if (d == nullptr) return nullptr;
+    PyObject* u = make_update_obj(cls, key, values, d);
+    Py_DECREF(d);
     return u;
 }
 
@@ -622,15 +749,8 @@ PyObject* py_rowwise_map(PyObject*, PyObject* args) {
                 vals = PyTuple_Pack(1, error_obj);
                 if (vals == nullptr) goto fail;
             }
-            PyObject* inner = PyTuple_Pack(3, key, vals, diff);
+            PyObject* nu = make_update_obj(update_cls, key, vals, diff);
             Py_DECREF(vals);
-            if (inner == nullptr) goto fail;
-            PyObject* wrap = PyTuple_Pack(1, inner);
-            Py_DECREF(inner);
-            if (wrap == nullptr) goto fail;
-            PyObject* nu = PyTuple_Type.tp_new(
-                reinterpret_cast<PyTypeObject*>(update_cls), wrap, nullptr);
-            Py_DECREF(wrap);
             if (nu == nullptr) goto fail;
             PyList_SET_ITEM(out, i, nu);
         }
@@ -1529,11 +1649,1435 @@ PyObject* py_set_pointer_type(PyObject*, PyObject* cls) {
     Py_RETURN_NONE;
 }
 
+// ===========================================================================
+// Expression stack VM
+//
+// The reference evaluates typed expression trees entirely in Rust
+// (src/engine/expression.rs:26-491): no Python enters the per-row hot
+// loop of select/filter.  The TPU build's equivalent is this bytecode VM:
+// internals/expr_vm.py lowers each (already build-time-typed) expression
+// AST to a flat postfix program with jump-based lazy constructs
+// (if_else/coalesce/fill_error evaluate only the taken branch, exactly
+// like the Python closures), and the whole select/filter batch runs in
+// one C call.  Subtrees the lowerer cannot express (UDF apply, namespace
+// methods) compile to their ordinary Python closure and appear as one
+// CALL_PY instruction — mixed rows still avoid the per-node closure
+// dispatch for everything else.
+//
+// Error semantics are byte-compatible with the Python closures in
+// internals/expression.py:
+//   - ERROR operands propagate (checked by identity before every op)
+//   - TypeError with a None operand: `== -> a is b`, `!= -> a is not b`,
+//     any other op -> None
+//   - TypeError otherwise, ZeroDivisionError, ValueError, OverflowError
+//     -> ERROR
+//   - any other exception aborts the ROW (containment + error-log happen
+//     in the batch loop, mirroring rowwise_map: the row becomes (ERROR,))
+
+PyObject* g_json_type = nullptr;  // pathway_tpu Json class (VM convert/get)
+
+PyObject* py_set_json_type(PyObject*, PyObject* cls) {
+    Py_XDECREF(g_json_type);
+    Py_INCREF(cls);
+    g_json_type = cls;
+    Py_RETURN_NONE;
+}
+
+enum VmOp : int64_t {
+    VM_LOAD_COL = 1,    // (pos)            push values[pos]
+    VM_LOAD_KEY = 2,    //                  push key
+    VM_LOAD_CONST = 3,  // (idx)            push consts[idx]
+    VM_CALL_PY = 4,     // (idx)            push pyfuncs[idx]((key, values))
+    VM_BIN = 5,         // (binop)
+    VM_NEG = 6,
+    VM_INV = 7,
+    VM_IS_NONE = 8,
+    VM_BRANCH = 9,      // (else_t, end_t)  pop cond
+    VM_JUMP = 10,       // (t)
+    VM_JUMP_NOT_NONE = 11,  // (t)          peek
+    VM_POP = 12,
+    VM_REQUIRE = 13,    // (end_t)          pop; None -> push None, jump
+    VM_UNWRAP = 14,     //                  pop; None -> ERROR
+    VM_FILL_JUMP = 15,  // (t)              peek; not ERROR -> jump
+    VM_CAST = 16,       // (tid)            0 int 1 float 2 bool 3 str
+    VM_CONVERT = 17,    // (tid, unwrap)    Json-aware strict conversion
+    VM_MAKE_TUPLE = 18, // (n)
+    VM_GET = 19,        // (strict, end_t)  pop idx, obj
+    VM_POINTER = 20,    // (n, opt, rs_idx) pop n args -> Pointer key
+};
+
+enum VmBin : int64_t {
+    B_ADD = 0, B_SUB, B_MUL, B_TRUEDIV, B_FLOORDIV, B_MOD, B_POW,
+    B_MATMUL, B_EQ, B_NE, B_LT, B_LE, B_GT, B_GE, B_AND, B_OR, B_XOR,
+};
+
+struct VmProgram {
+    std::vector<int64_t> code;
+    std::vector<PyObject*> consts;   // owned
+    std::vector<PyObject*> pyfuncs;  // owned
+    size_t max_stack = 0;
+    ~VmProgram() {
+        for (auto* o : consts) Py_XDECREF(o);
+        for (auto* o : pyfuncs) Py_XDECREF(o);
+    }
+};
+
+void vm_capsule_free(PyObject* cap) {
+    delete static_cast<VmProgram*>(
+        PyCapsule_GetPointer(cap, "pathway_tpu.vm"));
+}
+
+// operand count per opcode; -1 = invalid
+inline int vm_n_operands(int64_t op) {
+    switch (op) {
+        case VM_LOAD_KEY: case VM_NEG: case VM_INV: case VM_IS_NONE:
+        case VM_POP: case VM_UNWRAP:
+            return 0;
+        case VM_LOAD_COL: case VM_LOAD_CONST: case VM_CALL_PY: case VM_BIN:
+        case VM_JUMP: case VM_JUMP_NOT_NONE: case VM_REQUIRE:
+        case VM_FILL_JUMP: case VM_CAST: case VM_MAKE_TUPLE:
+            return 1;
+        case VM_BRANCH: case VM_CONVERT: case VM_GET:
+            return 2;
+        case VM_POINTER:
+            return 3;
+        default:
+            return -1;
+    }
+}
+
+// "simple" builtin scalar: known-sane __eq__, so the None shortcut in
+// binary ops cannot diverge from Python (e.g. ndarray == None is
+// elementwise and must go through the generic object path)
+inline bool vm_is_simple(PyObject* v) {
+    return v == Py_None || PyLong_Check(v) || PyFloat_Check(v) ||
+           PyUnicode_Check(v) || PyBytes_Check(v) || PyTuple_Check(v);
+}
+
+// generic binary op with the Python-closure exception mapping.
+// Returns a new reference; nullptr = row-level error (exception set).
+PyObject* vm_bin_generic(int64_t op, PyObject* a, PyObject* b,
+                         PyObject* error_obj) {
+    if ((a == Py_None && vm_is_simple(b)) ||
+        (b == Py_None && vm_is_simple(a))) {
+        // TypeError-with-None outcome, without paying for the exception
+        if (op == B_EQ) return PyBool_FromLong(a == b);
+        if (op == B_NE) return PyBool_FromLong(a != b);
+        Py_RETURN_NONE;
+    }
+    PyObject* r = nullptr;
+    switch (op) {
+        case B_ADD: r = PyNumber_Add(a, b); break;
+        case B_SUB: r = PyNumber_Subtract(a, b); break;
+        case B_MUL: r = PyNumber_Multiply(a, b); break;
+        case B_TRUEDIV: r = PyNumber_TrueDivide(a, b); break;
+        case B_FLOORDIV: r = PyNumber_FloorDivide(a, b); break;
+        case B_MOD: r = PyNumber_Remainder(a, b); break;
+        case B_POW: r = PyNumber_Power(a, b, Py_None); break;
+        case B_MATMUL: r = PyNumber_MatrixMultiply(a, b); break;
+        case B_EQ: r = PyObject_RichCompare(a, b, Py_EQ); break;
+        case B_NE: r = PyObject_RichCompare(a, b, Py_NE); break;
+        case B_LT: r = PyObject_RichCompare(a, b, Py_LT); break;
+        case B_LE: r = PyObject_RichCompare(a, b, Py_LE); break;
+        case B_GT: r = PyObject_RichCompare(a, b, Py_GT); break;
+        case B_GE: r = PyObject_RichCompare(a, b, Py_GE); break;
+        case B_AND: r = PyNumber_And(a, b); break;
+        case B_OR: r = PyNumber_Or(a, b); break;
+        case B_XOR: r = PyNumber_Xor(a, b); break;
+        default:
+            PyErr_SetString(PyExc_SystemError, "bad binop");
+            return nullptr;
+    }
+    if (r != nullptr) return r;
+    if (PyErr_ExceptionMatches(PyExc_TypeError)) {
+        PyErr_Clear();
+        if (a == Py_None || b == Py_None) {
+            if (op == B_EQ) return PyBool_FromLong(a == b);
+            if (op == B_NE) return PyBool_FromLong(a != b);
+            Py_RETURN_NONE;
+        }
+        Py_INCREF(error_obj);
+        return error_obj;
+    }
+    if (PyErr_ExceptionMatches(PyExc_ZeroDivisionError) ||
+        PyErr_ExceptionMatches(PyExc_ValueError) ||
+        PyErr_ExceptionMatches(PyExc_OverflowError)) {
+        PyErr_Clear();
+        Py_INCREF(error_obj);
+        return error_obj;
+    }
+    return nullptr;  // row-level error
+}
+
+// fast paths for exact int/float/bool operands; nullptr with NO exception
+// set means "no fast path, use generic"
+PyObject* vm_bin_fast(int64_t op, PyObject* a, PyObject* b,
+                      PyObject* error_obj) {
+    if (PyLong_CheckExact(a) && PyLong_CheckExact(b)) {
+        int oa = 0, ob = 0;
+        long long av = PyLong_AsLongLongAndOverflow(a, &oa);
+        long long bv = PyLong_AsLongLongAndOverflow(b, &ob);
+        if (oa != 0 || ob != 0) return nullptr;  // big ints: generic
+        long long res;
+        switch (op) {
+            case B_ADD:
+                if (!__builtin_add_overflow(av, bv, &res))
+                    return PyLong_FromLongLong(res);
+                return nullptr;
+            case B_SUB:
+                if (!__builtin_sub_overflow(av, bv, &res))
+                    return PyLong_FromLongLong(res);
+                return nullptr;
+            case B_MUL:
+                if (!__builtin_mul_overflow(av, bv, &res))
+                    return PyLong_FromLongLong(res);
+                return nullptr;
+            case B_FLOORDIV:
+            case B_MOD: {
+                if (bv == 0) {  // ZeroDivisionError -> ERROR
+                    Py_INCREF(error_obj);
+                    return error_obj;
+                }
+                if (av == LLONG_MIN && bv == -1) return nullptr;
+                long long q = av / bv, m = av % bv;
+                if (m != 0 && ((m < 0) != (bv < 0))) {  // Python floor rules
+                    q -= 1;
+                    m += bv;
+                }
+                return PyLong_FromLongLong(op == B_FLOORDIV ? q : m);
+            }
+            case B_EQ: return PyBool_FromLong(av == bv);
+            case B_NE: return PyBool_FromLong(av != bv);
+            case B_LT: return PyBool_FromLong(av < bv);
+            case B_LE: return PyBool_FromLong(av <= bv);
+            case B_GT: return PyBool_FromLong(av > bv);
+            case B_GE: return PyBool_FromLong(av >= bv);
+            case B_AND: return PyLong_FromLongLong(av & bv);
+            case B_OR: return PyLong_FromLongLong(av | bv);
+            case B_XOR: return PyLong_FromLongLong(av ^ bv);
+            default: return nullptr;  // truediv/pow/matmul: generic
+        }
+    }
+    if (PyFloat_CheckExact(a) && PyFloat_CheckExact(b)) {
+        double av = PyFloat_AS_DOUBLE(a), bv = PyFloat_AS_DOUBLE(b);
+        switch (op) {
+            case B_ADD: return PyFloat_FromDouble(av + bv);
+            case B_SUB: return PyFloat_FromDouble(av - bv);
+            case B_MUL: return PyFloat_FromDouble(av * bv);
+            case B_TRUEDIV:
+                if (bv == 0.0) {  // Python float/0.0 raises -> ERROR
+                    Py_INCREF(error_obj);
+                    return error_obj;
+                }
+                return PyFloat_FromDouble(av / bv);
+            case B_EQ: return PyBool_FromLong(av == bv);
+            case B_NE: return PyBool_FromLong(av != bv);
+            case B_LT: return PyBool_FromLong(av < bv);
+            case B_LE: return PyBool_FromLong(av <= bv);
+            case B_GT: return PyBool_FromLong(av > bv);
+            case B_GE: return PyBool_FromLong(av >= bv);
+            default: return nullptr;  // //,%: sign rules differ -> generic
+        }
+    }
+    if (PyBool_Check(a) && PyBool_Check(b)) {
+        switch (op) {
+            case B_AND: return PyBool_FromLong(a == Py_True && b == Py_True);
+            case B_OR: return PyBool_FromLong(a == Py_True || b == Py_True);
+            case B_XOR: return PyBool_FromLong((a == Py_True) != (b == Py_True));
+            case B_EQ: return PyBool_FromLong(a == b);
+            case B_NE: return PyBool_FromLong(a != b);
+            default: return nullptr;
+        }
+    }
+    return nullptr;
+}
+
+// Evaluate one program over one row.  Returns a new reference, or
+// nullptr with a Python exception set (row-level error; batch loop
+// contains it).  kv_cache: lazily built (key, values) tuple shared by
+// every CALL_PY of this row across programs.
+PyObject* vm_eval(VmProgram* P, PyObject* key, PyObject* values,
+                  PyObject* error_obj, PyObject** kv_cache,
+                  std::vector<PyObject*>& stack) {
+    const int64_t* code = P->code.data();
+    const size_t nc = P->code.size();
+    size_t sp = 0, ip = 0;
+    while (ip < nc) {
+        int64_t op = code[ip++];
+        switch (op) {
+            case VM_LOAD_COL: {
+                int64_t pos = code[ip++];
+                if (!PyTuple_Check(values) ||
+                    pos >= PyTuple_GET_SIZE(values)) {
+                    PyErr_SetString(PyExc_IndexError, "row too short");
+                    goto rowfail;
+                }
+                PyObject* v = PyTuple_GET_ITEM(values, pos);
+                Py_INCREF(v);
+                stack[sp++] = v;
+                break;
+            }
+            case VM_LOAD_KEY:
+                Py_INCREF(key);
+                stack[sp++] = key;
+                break;
+            case VM_LOAD_CONST: {
+                PyObject* v = P->consts[code[ip++]];
+                Py_INCREF(v);
+                stack[sp++] = v;
+                break;
+            }
+            case VM_CALL_PY: {
+                if (*kv_cache == nullptr) {
+                    *kv_cache = PyTuple_Pack(2, key, values);
+                    if (*kv_cache == nullptr) goto rowfail;
+                }
+                PyObject* r =
+                    PyObject_CallOneArg(P->pyfuncs[code[ip++]], *kv_cache);
+                if (r == nullptr) goto rowfail;
+                stack[sp++] = r;
+                break;
+            }
+            case VM_BIN: {
+                int64_t bop = code[ip++];
+                PyObject* b = stack[--sp];
+                PyObject* a = stack[--sp];
+                PyObject* r;
+                if (a == error_obj || b == error_obj) {
+                    Py_INCREF(error_obj);
+                    r = error_obj;
+                } else {
+                    r = vm_bin_fast(bop, a, b, error_obj);
+                    if (r == nullptr && !PyErr_Occurred())
+                        r = vm_bin_generic(bop, a, b, error_obj);
+                }
+                Py_DECREF(a);
+                Py_DECREF(b);
+                if (r == nullptr) goto rowfail;
+                stack[sp++] = r;
+                break;
+            }
+            case VM_NEG:
+            case VM_INV: {
+                PyObject* v = stack[sp - 1];
+                if (v == error_obj || v == Py_None) break;  // pass through
+                PyObject* r;
+                if (op == VM_INV && PyBool_Check(v)) {
+                    r = PyBool_FromLong(v == Py_False);
+                } else {
+                    r = op == VM_NEG ? PyNumber_Negative(v)
+                                     : PyNumber_Invert(v);
+                    if (r == nullptr) {
+                        if (!PyErr_ExceptionMatches(PyExc_TypeError))
+                            goto rowfail;
+                        PyErr_Clear();
+                        Py_INCREF(error_obj);
+                        r = error_obj;
+                    }
+                }
+                Py_DECREF(v);
+                stack[sp - 1] = r;
+                break;
+            }
+            case VM_IS_NONE: {
+                PyObject* v = stack[sp - 1];
+                if (v == error_obj) break;
+                PyObject* r = PyBool_FromLong(v == Py_None);
+                Py_DECREF(v);
+                stack[sp - 1] = r;
+                break;
+            }
+            case VM_BRANCH: {
+                int64_t else_t = code[ip], end_t = code[ip + 1];
+                ip += 2;
+                PyObject* c = stack[--sp];
+                if (c == error_obj) {
+                    stack[sp++] = c;  // keep the ref, reuse as result
+                    ip = (size_t)end_t;
+                    break;
+                }
+                int t = PyObject_IsTrue(c);
+                Py_DECREF(c);
+                if (t < 0) goto rowfail;
+                if (!t) ip = (size_t)else_t;
+                break;
+            }
+            case VM_JUMP:
+                ip = (size_t)code[ip];
+                break;
+            case VM_JUMP_NOT_NONE: {
+                int64_t t = code[ip++];
+                if (stack[sp - 1] != Py_None) ip = (size_t)t;
+                break;
+            }
+            case VM_POP:
+                Py_DECREF(stack[--sp]);
+                break;
+            case VM_REQUIRE: {
+                int64_t end_t = code[ip++];
+                PyObject* v = stack[--sp];
+                if (v == Py_None) {
+                    stack[sp++] = v;  // None is the result
+                    ip = (size_t)end_t;
+                } else {
+                    Py_DECREF(v);
+                }
+                break;
+            }
+            case VM_UNWRAP: {
+                PyObject* v = stack[sp - 1];
+                if (v == Py_None) {
+                    Py_DECREF(v);
+                    Py_INCREF(error_obj);
+                    stack[sp - 1] = error_obj;
+                }
+                break;
+            }
+            case VM_FILL_JUMP: {
+                int64_t t = code[ip++];
+                if (stack[sp - 1] != error_obj) ip = (size_t)t;
+                break;
+            }
+            case VM_CAST: {
+                int64_t tid = code[ip++];
+                PyObject* v = stack[sp - 1];
+                if (v == error_obj || v == Py_None) break;
+                PyObject* r = nullptr;
+                switch (tid) {
+                    case 0: r = PyNumber_Long(v); break;
+                    case 1: r = PyNumber_Float(v); break;
+                    case 2: {
+                        int t = PyObject_IsTrue(v);
+                        if (t >= 0) r = PyBool_FromLong(t);
+                        break;
+                    }
+                    case 3: r = PyObject_Str(v); break;
+                }
+                if (r == nullptr) {
+                    if (!PyErr_ExceptionMatches(PyExc_ValueError) &&
+                        !PyErr_ExceptionMatches(PyExc_TypeError))
+                        goto rowfail;
+                    PyErr_Clear();
+                    Py_INCREF(error_obj);
+                    r = error_obj;
+                }
+                Py_DECREF(v);
+                stack[sp - 1] = r;
+                break;
+            }
+            case VM_CONVERT: {
+                int64_t tid = code[ip], unwrap = code[ip + 1];
+                ip += 2;
+                PyObject* v = stack[sp - 1];
+                if (v == error_obj) break;
+                // Json unboxes to its .value first
+                if (g_json_type != nullptr &&
+                    PyObject_TypeCheck(
+                        v, reinterpret_cast<PyTypeObject*>(g_json_type))) {
+                    PyObject* inner = PyObject_GetAttrString(v, "value");
+                    if (inner == nullptr) goto rowfail;
+                    Py_DECREF(v);
+                    v = stack[sp - 1] = inner;
+                }
+                if (v == Py_None) {
+                    if (unwrap) {
+                        Py_DECREF(v);
+                        Py_INCREF(error_obj);
+                        stack[sp - 1] = error_obj;
+                    }
+                    break;
+                }
+                PyObject* r = nullptr;
+                bool type_ok;
+                switch (tid) {
+                    case 0:  // int: bool and non-numbers are ERROR
+                    case 1:  // float
+                        type_ok = !PyBool_Check(v) &&
+                                  (PyLong_Check(v) || PyFloat_Check(v));
+                        if (type_ok)
+                            r = tid == 0 ? PyNumber_Long(v)
+                                         : PyNumber_Float(v);
+                        break;
+                    case 2:
+                        type_ok = PyBool_Check(v);
+                        if (type_ok) {
+                            Py_INCREF(v);
+                            r = v;
+                        }
+                        break;
+                    default:
+                        type_ok = PyUnicode_Check(v);
+                        if (type_ok) {
+                            Py_INCREF(v);
+                            r = v;
+                        }
+                        break;
+                }
+                if (r == nullptr) {
+                    if (PyErr_Occurred()) {
+                        if (!PyErr_ExceptionMatches(PyExc_ValueError) &&
+                            !PyErr_ExceptionMatches(PyExc_TypeError))
+                            goto rowfail;
+                        PyErr_Clear();
+                    }
+                    Py_INCREF(error_obj);
+                    r = error_obj;
+                }
+                Py_DECREF(v);
+                stack[sp - 1] = r;
+                break;
+            }
+            case VM_MAKE_TUPLE: {
+                int64_t n = code[ip++];
+                PyObject* t = PyTuple_New(n);
+                if (t == nullptr) goto rowfail;
+                for (int64_t j = n - 1; j >= 0; j--)
+                    PyTuple_SET_ITEM(t, j, stack[--sp]);  // steals refs
+                stack[sp++] = t;
+                break;
+            }
+            case VM_GET: {
+                int64_t strict = code[ip], end_t = code[ip + 1];
+                ip += 2;
+                PyObject* idx = stack[--sp];
+                PyObject* obj = stack[--sp];
+                if (obj == error_obj || idx == error_obj) {
+                    Py_DECREF(obj);
+                    Py_DECREF(idx);
+                    Py_INCREF(error_obj);
+                    stack[sp++] = error_obj;
+                    ip = (size_t)end_t;
+                    break;
+                }
+                PyObject* v = nullptr;
+                bool is_json =
+                    g_json_type != nullptr &&
+                    PyObject_TypeCheck(
+                        obj, reinterpret_cast<PyTypeObject*>(g_json_type));
+                if (is_json) {
+                    PyObject* inner = PyObject_GetAttrString(obj, "value");
+                    if (inner == nullptr) {
+                        Py_DECREF(obj);
+                        Py_DECREF(idx);
+                        goto rowfail;
+                    }
+                    v = PyObject_GetItem(inner, idx);
+                    Py_DECREF(inner);
+                    if (v != nullptr &&
+                        !PyObject_TypeCheck(
+                            v, reinterpret_cast<PyTypeObject*>(g_json_type))) {
+                        // Json getitem re-wraps plain values as Json
+                        PyObject* wrapped = PyObject_CallFunctionObjArgs(
+                            g_json_type, v, nullptr);
+                        Py_DECREF(v);
+                        v = wrapped;
+                        if (v == nullptr) {
+                            Py_DECREF(obj);
+                            Py_DECREF(idx);
+                            goto rowfail;
+                        }
+                    }
+                } else {
+                    v = PyObject_GetItem(obj, idx);
+                }
+                Py_DECREF(obj);
+                Py_DECREF(idx);
+                if (v != nullptr) {
+                    stack[sp++] = v;
+                    ip = (size_t)end_t;
+                    break;
+                }
+                if (!PyErr_ExceptionMatches(PyExc_KeyError) &&
+                    !PyErr_ExceptionMatches(PyExc_IndexError) &&
+                    !PyErr_ExceptionMatches(PyExc_TypeError))
+                    goto rowfail;
+                PyErr_Clear();
+                if (strict) {
+                    Py_INCREF(error_obj);
+                    stack[sp++] = error_obj;
+                    ip = (size_t)end_t;
+                }
+                // non-strict: fall through into the default's code
+                break;
+            }
+            case VM_POINTER: {
+                int64_t n = code[ip], opt = code[ip + 1],
+                        rs_idx = code[ip + 2];
+                ip += 3;
+                PyObject** base = &stack[sp - n];
+                if (opt) {
+                    bool any_none = false;
+                    for (int64_t j = 0; j < n; j++)
+                        if (base[j] == Py_None) any_none = true;
+                    if (any_none) {
+                        for (int64_t j = 0; j < n; j++) Py_DECREF(base[j]);
+                        sp -= (size_t)n;
+                        Py_INCREF(Py_None);
+                        stack[sp++] = Py_None;
+                        break;
+                    }
+                }
+                Hasher h;
+                bool ok = g_pointer_type != nullptr;
+                for (int64_t j = 0; j < n && ok; j++) ok = feed(h, base[j]);
+                PyObject* r = nullptr;
+                if (ok) {
+                    PyObject* num = digest_to_long(h);
+                    if (num == nullptr) goto rowfail_ptr;
+                    r = PyObject_CallFunctionObjArgs(g_pointer_type, num,
+                                                     nullptr);
+                    Py_DECREF(num);
+                } else {
+                    if (PyErr_Occurred()) PyErr_Clear();
+                    // unsupported value type: defer to Python ref_scalar
+                    PyObject* t = PyTuple_New(n);
+                    if (t == nullptr) goto rowfail_ptr;
+                    for (int64_t j = 0; j < n; j++) {
+                        Py_INCREF(base[j]);
+                        PyTuple_SET_ITEM(t, j, base[j]);
+                    }
+                    r = PyObject_Call(P->consts[rs_idx], t, nullptr);
+                    Py_DECREF(t);
+                }
+                if (r == nullptr) goto rowfail_ptr;
+                for (int64_t j = 0; j < n; j++) Py_DECREF(base[j]);
+                sp -= (size_t)n;
+                stack[sp++] = r;
+                break;
+            rowfail_ptr:
+                goto rowfail;
+            }
+            default:
+                PyErr_SetString(PyExc_SystemError, "bad VM opcode");
+                goto rowfail;
+        }
+    }
+    if (sp != 1) {
+        PyErr_SetString(PyExc_SystemError, "VM stack imbalance");
+        goto rowfail;
+    }
+    return stack[0];
+rowfail:
+    while (sp > 0) Py_DECREF(stack[--sp]);
+    return nullptr;
+}
+
+PyObject* py_vm_compile(PyObject*, PyObject* args) {
+    // (code_seq[int], consts_seq, pyfuncs_seq) -> capsule
+    PyObject *code_obj, *consts_obj, *pyfuncs_obj;
+    if (!PyArg_ParseTuple(args, "OOO", &code_obj, &consts_obj, &pyfuncs_obj))
+        return nullptr;
+    PyObject* code_seq = PySequence_Fast(code_obj, "code must be a sequence");
+    if (code_seq == nullptr) return nullptr;
+    auto P = std::make_unique<VmProgram>();
+    Py_ssize_t nc = PySequence_Fast_GET_SIZE(code_seq);
+    P->code.reserve((size_t)nc);
+    for (Py_ssize_t i = 0; i < nc; i++) {
+        long long v =
+            PyLong_AsLongLong(PySequence_Fast_GET_ITEM(code_seq, i));
+        if (v == -1 && PyErr_Occurred()) {
+            Py_DECREF(code_seq);
+            return nullptr;
+        }
+        P->code.push_back(v);
+    }
+    Py_DECREF(code_seq);
+    PyObject* cseq = PySequence_Fast(consts_obj, "consts must be a sequence");
+    if (cseq == nullptr) return nullptr;
+    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(cseq); i++) {
+        PyObject* o = PySequence_Fast_GET_ITEM(cseq, i);
+        Py_INCREF(o);
+        P->consts.push_back(o);
+    }
+    Py_DECREF(cseq);
+    PyObject* fseq =
+        PySequence_Fast(pyfuncs_obj, "pyfuncs must be a sequence");
+    if (fseq == nullptr) return nullptr;
+    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(fseq); i++) {
+        PyObject* o = PySequence_Fast_GET_ITEM(fseq, i);
+        Py_INCREF(o);
+        P->pyfuncs.push_back(o);
+    }
+    Py_DECREF(fseq);
+    // validation pass: operand counts, jump targets, table indices —
+    // the VM itself trusts the program, so everything is checked here
+    size_t ip = 0, pushes = 0;
+    const size_t n = P->code.size();
+    while (ip < n) {
+        int64_t op = P->code[ip];
+        int nops = vm_n_operands(op);
+        if (nops < 0 || ip + 1 + (size_t)nops > n) {
+            PyErr_SetString(PyExc_ValueError, "malformed VM program");
+            return nullptr;
+        }
+        pushes++;
+        const int64_t* operands = &P->code[ip + 1];
+        bool ok = true;
+        switch (op) {
+            case VM_LOAD_COL: ok = operands[0] >= 0; break;
+            case VM_LOAD_CONST:
+                ok = operands[0] >= 0 &&
+                     (size_t)operands[0] < P->consts.size();
+                break;
+            case VM_CALL_PY:
+                ok = operands[0] >= 0 &&
+                     (size_t)operands[0] < P->pyfuncs.size();
+                break;
+            case VM_BIN: ok = operands[0] >= 0 && operands[0] <= B_XOR; break;
+            case VM_BRANCH:
+                ok = operands[0] >= 0 && (size_t)operands[0] <= n &&
+                     operands[1] >= 0 && (size_t)operands[1] <= n;
+                break;
+            case VM_JUMP:
+            case VM_JUMP_NOT_NONE:
+            case VM_REQUIRE:
+            case VM_FILL_JUMP:
+                ok = operands[0] >= 0 && (size_t)operands[0] <= n;
+                break;
+            case VM_CAST: ok = operands[0] >= 0 && operands[0] <= 3; break;
+            case VM_CONVERT:
+                ok = operands[0] >= 0 && operands[0] <= 3;
+                break;
+            case VM_MAKE_TUPLE: ok = operands[0] >= 0; break;
+            case VM_GET:
+                ok = operands[1] >= 0 && (size_t)operands[1] <= n;
+                break;
+            case VM_POINTER:
+                ok = operands[0] >= 1 && operands[2] >= 0 &&
+                     (size_t)operands[2] < P->consts.size();
+                break;
+        }
+        if (!ok) {
+            PyErr_SetString(PyExc_ValueError, "malformed VM program");
+            return nullptr;
+        }
+        ip += 1 + (size_t)nops;
+    }
+    // conservative stack bound: every instruction pushes at most one
+    // value beyond what it pops (MAKE_TUPLE/POINTER pop more)
+    P->max_stack = pushes + 2;
+    PyObject* cap =
+        PyCapsule_New(P.release(), "pathway_tpu.vm", vm_capsule_free);
+    return cap;
+}
+
+inline VmProgram* vm_from_capsule(PyObject* cap) {
+    return static_cast<VmProgram*>(
+        PyCapsule_GetPointer(cap, "pathway_tpu.vm"));
+}
+
+PyObject* py_vm_eval_batch(PyObject*, PyObject* args) {
+    // (batch, progs_seq, update_cls, error_obj, on_error) -> list[Update]
+    // Multi-column select: each program computes one output column; a
+    // row whose evaluation raises becomes (ERROR,) after on_error(exc),
+    // exactly like rowwise_map.
+    PyObject *batch, *progs_obj, *update_cls, *error_obj, *on_error;
+    if (!PyArg_ParseTuple(args, "OOOOO", &batch, &progs_obj, &update_cls,
+                          &error_obj, &on_error))
+        return nullptr;
+    PyObject* progs =
+        PySequence_Fast(progs_obj, "programs must be a sequence");
+    if (progs == nullptr) return nullptr;
+    Py_ssize_t np = PySequence_Fast_GET_SIZE(progs);
+    std::vector<VmProgram*> P((size_t)np);
+    size_t max_stack = 4;
+    for (Py_ssize_t j = 0; j < np; j++) {
+        P[(size_t)j] = vm_from_capsule(PySequence_Fast_GET_ITEM(progs, j));
+        if (P[(size_t)j] == nullptr) {
+            Py_DECREF(progs);
+            return nullptr;
+        }
+        max_stack = std::max(max_stack, P[(size_t)j]->max_stack);
+    }
+    PyObject* seq = PySequence_Fast(batch, "vm_eval_batch expects a sequence");
+    if (seq == nullptr) {
+        Py_DECREF(progs);
+        return nullptr;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(n);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        Py_DECREF(progs);
+        return nullptr;
+    }
+    std::vector<PyObject*> stack(max_stack);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            goto fail;
+        }
+        {
+            PyObject* key = PyTuple_GET_ITEM(u, 0);
+            PyObject* values = PyTuple_GET_ITEM(u, 1);
+            PyObject* diff = PyTuple_GET_ITEM(u, 2);
+            PyObject* kv = nullptr;
+            PyObject* vals = PyTuple_New(np);
+            if (vals == nullptr) goto fail;
+            for (Py_ssize_t j = 0; j < np; j++) {
+                PyObject* v = vm_eval(P[(size_t)j], key, values, error_obj,
+                                      &kv, stack);
+                if (v == nullptr) {
+                    Py_DECREF(vals);
+                    vals = nullptr;
+                    // row containment: Exception -> on_error + (ERROR,)
+                    if (!PyErr_ExceptionMatches(PyExc_Exception)) {
+                        Py_XDECREF(kv);
+                        goto fail;
+                    }
+                    PyObject *etype, *evalue, *etb;
+                    PyErr_Fetch(&etype, &evalue, &etb);
+                    PyErr_NormalizeException(&etype, &evalue, &etb);
+                    PyObject* r = PyObject_CallFunctionObjArgs(
+                        on_error, evalue ? evalue : Py_None, nullptr);
+                    Py_XDECREF(etype);
+                    Py_XDECREF(evalue);
+                    Py_XDECREF(etb);
+                    if (r == nullptr) {
+                        Py_XDECREF(kv);
+                        goto fail;
+                    }
+                    Py_DECREF(r);
+                    vals = PyTuple_Pack(1, error_obj);
+                    if (vals == nullptr) {
+                        Py_XDECREF(kv);
+                        goto fail;
+                    }
+                    break;
+                }
+                PyTuple_SET_ITEM(vals, j, v);
+            }
+            Py_XDECREF(kv);
+            PyObject* nu = make_update_obj(update_cls, key, vals, diff);
+            Py_DECREF(vals);
+            if (nu == nullptr) goto fail;
+            PyList_SET_ITEM(out, i, nu);
+        }
+    }
+    Py_DECREF(seq);
+    Py_DECREF(progs);
+    return out;
+fail:
+    Py_DECREF(seq);
+    Py_DECREF(progs);
+    Py_DECREF(out);
+    return nullptr;
+}
+
+// ===========================================================================
+// Native hash-join epoch pass
+//
+// The whole JoinNode.process hot loop (engine/graph.py JoinNode) in one
+// C call, mirroring the reference's join arrangement machinery
+// (src/engine/dataflow.rs join_tables): evaluate join keys via VM
+// programs, snapshot old per-key output blocks, apply both deltas to the
+// (Python-dict) arrangements, rebuild dirty blocks and emit the diff.
+// State stays plain Python dicts {jk: {row_key: values}} so operator
+// snapshots/resume and the Python fallback interoperate bit-for-bit.
+//
+// Any pre-mutation obstacle (unhashable join key, VM row error) raises
+// Unsupported so the caller reruns the batch in Python; obstacles after
+// mutation would desync state and therefore hard-fail instead — they
+// cannot occur for values the VM produced (jk tuples are hashable by
+// construction once PyObject_Hash succeeded).
+
+// okey = ref_scalar("__join__", int(lk), int(rk)|None) — keys.join_key
+PyObject* join_okey(PyObject* lk, PyObject* rk) {
+    Hasher h;
+    static const char kJ[] = "__join__";
+    h.tag(0x04);
+    h.u64le(sizeof(kJ) - 1);
+    h.bytes(kJ, sizeof(kJ) - 1);
+    if (!feed_pylong_plain(h, lk)) return nullptr;
+    if (rk == Py_None || rk == nullptr) {
+        h.tag(0x00);
+    } else if (!feed_pylong_plain(h, rk)) {
+        return nullptr;
+    }
+    PyObject* num = digest_to_long(h);
+    if (num == nullptr) return nullptr;
+    PyObject* p = PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
+    Py_DECREF(num);
+    return p;
+}
+
+// okey = ref_scalar("__join_r__", int(rk)) — right-outer unmatched rows
+PyObject* join_okey_r(PyObject* rk) {
+    Hasher h;
+    static const char kJ[] = "__join_r__";
+    h.tag(0x04);
+    h.u64le(sizeof(kJ) - 1);
+    h.bytes(kJ, sizeof(kJ) - 1);
+    if (!feed_pylong_plain(h, rk)) return nullptr;
+    PyObject* num = digest_to_long(h);
+    if (num == nullptr) return nullptr;
+    PyObject* p = PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
+    Py_DECREF(num);
+    return p;
+}
+
+struct JoinCtx {
+    int64_t kind;  // 0 inner, 1 left, 2 right, 3 outer
+    int left_id_only;
+    Py_ssize_t lncols, rncols;
+    PyObject* lnone;  // (None,)*lncols
+    PyObject* rnone;
+    PyObject* engine_error;
+};
+
+// output row = lv + rv + (lk, rk), built in one allocation
+PyObject* join_row(JoinCtx& C, PyObject* lv, PyObject* rv, PyObject* lk,
+                   PyObject* rk) {
+    if (lv == nullptr) lv = C.lnone;
+    if (rv == nullptr) rv = C.rnone;
+    if (!PyTuple_Check(lv) || !PyTuple_Check(rv)) {
+        // exotic row type: generic concat path
+        PyObject* lr = PySequence_Concat(lv, rv);
+        if (lr == nullptr) return nullptr;
+        PyObject* tail = PyTuple_Pack(2, lk, rk);
+        if (tail == nullptr) {
+            Py_DECREF(lr);
+            return nullptr;
+        }
+        PyObject* row = PySequence_Concat(lr, tail);
+        Py_DECREF(lr);
+        Py_DECREF(tail);
+        return row;
+    }
+    Py_ssize_t ln = PyTuple_GET_SIZE(lv), rn = PyTuple_GET_SIZE(rv);
+    PyObject* row = PyTuple_New(ln + rn + 2);
+    if (row == nullptr) return nullptr;
+    for (Py_ssize_t i = 0; i < ln; i++) {
+        PyObject* x = PyTuple_GET_ITEM(lv, i);
+        Py_INCREF(x);
+        PyTuple_SET_ITEM(row, i, x);
+    }
+    for (Py_ssize_t i = 0; i < rn; i++) {
+        PyObject* x = PyTuple_GET_ITEM(rv, i);
+        Py_INCREF(x);
+        PyTuple_SET_ITEM(row, ln + i, x);
+    }
+    Py_INCREF(lk);
+    PyTuple_SET_ITEM(row, ln + rn, lk);
+    Py_INCREF(rk);
+    PyTuple_SET_ITEM(row, ln + rn + 1, rk);
+    return row;
+}
+
+// Build the full output block {okey: lv+rv+(lk,rk)} for one join key.
+// Returns a NEW dict, or nullptr with exception set.
+PyObject* join_block(JoinCtx& C, PyObject* lrows, PyObject* rrows) {
+    PyObject* out = PyDict_New();
+    if (out == nullptr) return nullptr;
+    Py_ssize_t nl = lrows ? PyDict_GET_SIZE(lrows) : 0;
+    Py_ssize_t nr = rrows ? PyDict_GET_SIZE(rrows) : 0;
+    if (nl > 0 && nr > 0) {
+        if (C.left_id_only && nr > 1) {
+            PyErr_Format(C.engine_error,
+                         "join with id=left.id: left row has %zd right matches",
+                         nr);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        Py_ssize_t lpos = 0;
+        PyObject *lk, *lv;
+        while (PyDict_Next(lrows, &lpos, &lk, &lv)) {
+            Py_ssize_t rpos = 0;
+            PyObject *rk, *rv;
+            while (PyDict_Next(rrows, &rpos, &rk, &rv)) {
+                PyObject* okey;
+                if (C.left_id_only) {
+                    Py_INCREF(lk);
+                    okey = lk;
+                } else {
+                    okey = join_okey(lk, rk);
+                    if (okey == nullptr) {
+                        if (!PyErr_Occurred())
+                            PyErr_SetString(g_unsupported,
+                                            "join key hash fallback");
+                        Py_DECREF(out);
+                        return nullptr;
+                    }
+                }
+                PyObject* row = join_row(C, lv, rv, lk, rk);
+                if (row == nullptr || PyDict_SetItem(out, okey, row) < 0) {
+                    Py_XDECREF(row);
+                    Py_DECREF(okey);
+                    Py_DECREF(out);
+                    return nullptr;
+                }
+                Py_DECREF(row);
+                Py_DECREF(okey);
+            }
+        }
+    } else if (nl > 0 && (C.kind == 1 || C.kind == 3)) {
+        Py_ssize_t lpos = 0;
+        PyObject *lk, *lv;
+        while (PyDict_Next(lrows, &lpos, &lk, &lv)) {
+            PyObject* okey;
+            if (C.left_id_only) {
+                Py_INCREF(lk);
+                okey = lk;
+            } else {
+                okey = join_okey(lk, nullptr);
+                if (okey == nullptr) {
+                    Py_DECREF(out);
+                    return nullptr;
+                }
+            }
+            PyObject* row = join_row(C, lv, nullptr, lk, Py_None);
+            if (row == nullptr || PyDict_SetItem(out, okey, row) < 0) {
+                Py_XDECREF(row);
+                Py_DECREF(okey);
+                Py_DECREF(out);
+                return nullptr;
+            }
+            Py_DECREF(row);
+            Py_DECREF(okey);
+        }
+    } else if (nr > 0 && (C.kind == 2 || C.kind == 3)) {
+        Py_ssize_t rpos = 0;
+        PyObject *rk, *rv;
+        while (PyDict_Next(rrows, &rpos, &rk, &rv)) {
+            PyObject* okey = join_okey_r(rk);
+            if (okey == nullptr) {
+                Py_DECREF(out);
+                return nullptr;
+            }
+            PyObject* row = join_row(C, nullptr, rv, Py_None, rk);
+            if (row == nullptr || PyDict_SetItem(out, okey, row) < 0) {
+                Py_XDECREF(row);
+                Py_DECREF(okey);
+                Py_DECREF(out);
+                return nullptr;
+            }
+            Py_DECREF(row);
+            Py_DECREF(okey);
+        }
+    }
+    return out;
+}
+
+// Evaluate one side's join keys: list (same length as batch) of jk tuple
+// or None (null join key).  Pre-mutation: any obstacle -> Unsupported.
+PyObject* join_side_jks(VmProgram* prog, PyObject* seq, PyObject* error_obj,
+                        std::vector<PyObject*>& stack) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(n);
+    if (out == nullptr) return nullptr;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyObject* kv = nullptr;
+        PyObject* jk = vm_eval(prog, PyTuple_GET_ITEM(u, 0),
+                               PyTuple_GET_ITEM(u, 1), error_obj, &kv, stack);
+        Py_XDECREF(kv);
+        if (jk == nullptr) {
+            // VM row error: punt the whole batch to the Python path
+            PyErr_Clear();
+            PyErr_SetString(g_unsupported, "join key eval fallback");
+            Py_DECREF(out);
+            return nullptr;
+        }
+        // null join keys never match
+        bool null_jk = false;
+        if (PyTuple_Check(jk)) {
+            for (Py_ssize_t j = 0; j < PyTuple_GET_SIZE(jk); j++)
+                if (PyTuple_GET_ITEM(jk, j) == Py_None) null_jk = true;
+        } else {
+            null_jk = jk == Py_None;
+        }
+        if (null_jk) {
+            Py_DECREF(jk);
+            Py_INCREF(Py_None);
+            PyList_SET_ITEM(out, i, Py_None);
+            continue;
+        }
+        if (PyObject_Hash(jk) == -1) {
+            // unhashable cells (python path would use hashable_row):
+            // pre-mutation, safe to fall back
+            PyErr_Clear();
+            PyErr_SetString(g_unsupported, "unhashable join key");
+            Py_DECREF(jk);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, i, jk);
+    }
+    return out;
+}
+
+int join_apply_side(PyObject* side, PyObject* seq, PyObject* jks) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* jk = PyList_GET_ITEM(jks, i);
+        if (jk == Py_None) continue;
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* key = PyTuple_GET_ITEM(u, 0);
+        PyObject* values = PyTuple_GET_ITEM(u, 1);
+        PyObject* diff = PyTuple_GET_ITEM(u, 2);
+        PyObject* rows = PyDict_GetItemWithError(side, jk);  // borrowed
+        if (rows == nullptr) {
+            if (PyErr_Occurred()) return -1;
+            rows = PyDict_New();
+            if (rows == nullptr) return -1;
+            if (PyDict_SetItem(side, jk, rows) < 0) {
+                Py_DECREF(rows);
+                return -1;
+            }
+            Py_DECREF(rows);  // dict holds it; borrow below is safe
+            rows = PyDict_GetItemWithError(side, jk);
+            if (rows == nullptr) return -1;
+        }
+        long d = PyLong_AsLong(diff);
+        if (d == -1 && PyErr_Occurred()) return -1;
+        if (d > 0) {
+            if (PyDict_SetItem(rows, key, values) < 0) return -1;
+        } else {
+            if (PyDict_DelItem(rows, key) < 0) {
+                if (!PyErr_ExceptionMatches(PyExc_KeyError)) return -1;
+                PyErr_Clear();
+            }
+        }
+    }
+    return 0;
+}
+
+PyObject* py_join_process(PyObject*, PyObject* args) {
+    // (lbatch, rbatch, lprog, rprog, lstate, rstate, kind, left_id_only,
+    //  lncols, rncols, update_cls, error_obj, engine_error_cls)
+    PyObject *lbatch, *rbatch, *lcap, *rcap, *lstate, *rstate;
+    PyObject *update_cls, *error_obj, *engine_error;
+    long long kind, left_id_only, lncols, rncols;
+    if (!PyArg_ParseTuple(args, "OOOOO!O!LLLLOOO", &lbatch, &rbatch, &lcap,
+                          &rcap, &PyDict_Type, &lstate, &PyDict_Type, &rstate,
+                          &kind, &left_id_only, &lncols, &rncols, &update_cls,
+                          &error_obj, &engine_error))
+        return nullptr;
+    if (g_pointer_type == nullptr) {
+        PyErr_SetString(g_unsupported, "Pointer type not registered");
+        return nullptr;
+    }
+    VmProgram* LP = vm_from_capsule(lcap);
+    if (LP == nullptr) return nullptr;
+    VmProgram* RP = vm_from_capsule(rcap);
+    if (RP == nullptr) return nullptr;
+
+    JoinCtx C;
+    C.kind = kind;
+    C.left_id_only = (int)left_id_only;
+    C.lncols = (Py_ssize_t)lncols;
+    C.rncols = (Py_ssize_t)rncols;
+    C.engine_error = engine_error;
+    C.lnone = PyTuple_New(C.lncols);
+    C.rnone = PyTuple_New(C.rncols);
+    if (C.lnone == nullptr || C.rnone == nullptr) {
+        Py_XDECREF(C.lnone);
+        Py_XDECREF(C.rnone);
+        return nullptr;
+    }
+    for (Py_ssize_t i = 0; i < C.lncols; i++) {
+        Py_INCREF(Py_None);
+        PyTuple_SET_ITEM(C.lnone, i, Py_None);
+    }
+    for (Py_ssize_t i = 0; i < C.rncols; i++) {
+        Py_INCREF(Py_None);
+        PyTuple_SET_ITEM(C.rnone, i, Py_None);
+    }
+
+    PyObject *lseq = nullptr, *rseq = nullptr, *ljks = nullptr,
+             *rjks = nullptr, *dirty = nullptr, *dirty_list = nullptr,
+             *old_blocks = nullptr, *out = nullptr;
+    bool mutated = false;
+    std::vector<PyObject*> stack(
+        std::max(LP->max_stack, RP->max_stack) + 2);
+
+    lseq = PySequence_Fast(lbatch, "join: left batch");
+    if (lseq == nullptr) goto fail;
+    rseq = PySequence_Fast(rbatch, "join: right batch");
+    if (rseq == nullptr) goto fail;
+    ljks = join_side_jks(LP, lseq, error_obj, stack);
+    if (ljks == nullptr) goto fail;
+    rjks = join_side_jks(RP, rseq, error_obj, stack);
+    if (rjks == nullptr) goto fail;
+
+    // dirty key set (insertion-ordered via companion list)
+    dirty = PySet_New(nullptr);
+    dirty_list = PyList_New(0);
+    if (dirty == nullptr || dirty_list == nullptr) goto fail;
+    for (PyObject* jks : {ljks, rjks}) {
+        Py_ssize_t n = PyList_GET_SIZE(jks);
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject* jk = PyList_GET_ITEM(jks, i);
+            if (jk == Py_None) continue;
+            int has = PySet_Contains(dirty, jk);
+            if (has < 0) goto fail;
+            if (!has) {
+                if (PySet_Add(dirty, jk) < 0) goto fail;
+                if (PyList_Append(dirty_list, jk) < 0) goto fail;
+            }
+        }
+    }
+
+    // old blocks BEFORE mutation
+    old_blocks = PyList_New(0);
+    if (old_blocks == nullptr) goto fail;
+    {
+        Py_ssize_t nd = PyList_GET_SIZE(dirty_list);
+        for (Py_ssize_t i = 0; i < nd; i++) {
+            PyObject* jk = PyList_GET_ITEM(dirty_list, i);
+            PyObject* lrows = PyDict_GetItemWithError(lstate, jk);
+            if (lrows == nullptr && PyErr_Occurred()) goto fail;
+            PyObject* rrows = PyDict_GetItemWithError(rstate, jk);
+            if (rrows == nullptr && PyErr_Occurred()) goto fail;
+            if ((lrows == nullptr || PyDict_GET_SIZE(lrows) == 0) &&
+                (rrows == nullptr || PyDict_GET_SIZE(rrows) == 0)) {
+                // brand-new join key (bulk-load common case): empty old
+                // block — Py_None placeholder skips a dict allocation
+                if (PyList_Append(old_blocks, Py_None) < 0) goto fail;
+                continue;
+            }
+            PyObject* blk = join_block(C, lrows, rrows);
+            if (blk == nullptr) goto fail;
+            int rc = PyList_Append(old_blocks, blk);
+            Py_DECREF(blk);
+            if (rc < 0) goto fail;
+        }
+    }
+
+    // mutate arrangements — from here on, Unsupported must NOT escape
+    // (the Python fallback would re-apply the batch to mutated state)
+    mutated = true;
+    if (join_apply_side(lstate, lseq, ljks) < 0) goto fail;
+    if (join_apply_side(rstate, rseq, rjks) < 0) goto fail;
+
+    // new blocks + diff
+    out = PyList_New(0);
+    if (out == nullptr) goto fail;
+    {
+        PyObject* one = PyLong_FromLong(1);
+        PyObject* neg = PyLong_FromLong(-1);
+        if (one == nullptr || neg == nullptr) {
+            Py_XDECREF(one);
+            Py_XDECREF(neg);
+            goto fail;
+        }
+        Py_ssize_t nd = PyList_GET_SIZE(dirty_list);
+        bool ok = true;
+        for (Py_ssize_t i = 0; i < nd && ok; i++) {
+            PyObject* jk = PyList_GET_ITEM(dirty_list, i);
+            PyObject* lrows = PyDict_GetItemWithError(lstate, jk);
+            PyObject* rrows = PyDict_GetItemWithError(rstate, jk);
+            PyObject* old_blk = PyList_GET_ITEM(old_blocks, i);
+            if (old_blk == Py_None) {
+                // brand-new join key: every block row is an addition and
+                // okeys are unique per (lk, rk) pair — emit straight from
+                // the arrangements, skipping the block dict entirely
+                PyObject* blk = join_block(C, lrows, rrows);
+                if (blk == nullptr) {
+                    ok = false;
+                    break;
+                }
+                Py_ssize_t pos2 = 0;
+                PyObject *okey2, *vals2;
+                while (ok && PyDict_Next(blk, &pos2, &okey2, &vals2)) {
+                    PyObject* nu =
+                        make_update_obj(update_cls, okey2, vals2, one);
+                    if (nu == nullptr || PyList_Append(out, nu) < 0) {
+                        Py_XDECREF(nu);
+                        ok = false;
+                        break;
+                    }
+                    Py_DECREF(nu);
+                }
+                Py_DECREF(blk);
+                if (!ok) break;
+                // same empty-arrangement cleanup as the diff path (an
+                // add+retract within one epoch leaves empty dicts)
+                bool lempty2 =
+                    lrows == nullptr || PyDict_GET_SIZE(lrows) == 0;
+                bool rempty2 =
+                    rrows == nullptr || PyDict_GET_SIZE(rrows) == 0;
+                if (lempty2 && rempty2) {
+                    if (lrows != nullptr && PyDict_DelItem(lstate, jk) < 0)
+                        PyErr_Clear();
+                    if (rrows != nullptr && PyDict_DelItem(rstate, jk) < 0)
+                        PyErr_Clear();
+                }
+                continue;
+            }
+            PyObject* new_blk = join_block(C, lrows, rrows);
+            if (new_blk == nullptr) {
+                ok = false;
+                break;
+            }
+            // retractions: old rows missing/changed in new
+            Py_ssize_t pos = 0;
+            PyObject *okey, *vals;
+            while (ok && old_blk != Py_None &&
+                   PyDict_Next(old_blk, &pos, &okey, &vals)) {
+                PyObject* nv = PyDict_GetItemWithError(new_blk, okey);
+                if (nv == nullptr && PyErr_Occurred()) {
+                    ok = false;
+                    break;
+                }
+                int same = nv == nullptr
+                               ? 0
+                               : PyObject_RichCompareBool(nv, vals, Py_EQ);
+                if (same < 0) {
+                    ok = false;
+                    break;
+                }
+                if (!same) {
+                    PyObject* nu = make_update_obj(update_cls, okey, vals, neg);
+                    if (nu == nullptr || PyList_Append(out, nu) < 0) {
+                        Py_XDECREF(nu);
+                        ok = false;
+                        break;
+                    }
+                    Py_DECREF(nu);
+                }
+            }
+            // additions: new rows missing/changed in old
+            pos = 0;
+            while (ok && PyDict_Next(new_blk, &pos, &okey, &vals)) {
+                PyObject* ov =
+                    old_blk == Py_None
+                        ? nullptr
+                        : PyDict_GetItemWithError(old_blk, okey);
+                if (ov == nullptr && PyErr_Occurred()) {
+                    ok = false;
+                    break;
+                }
+                int same = ov == nullptr
+                               ? 0
+                               : PyObject_RichCompareBool(ov, vals, Py_EQ);
+                if (same < 0) {
+                    ok = false;
+                    break;
+                }
+                if (!same) {
+                    PyObject* nu = make_update_obj(update_cls, okey, vals, one);
+                    if (nu == nullptr || PyList_Append(out, nu) < 0) {
+                        Py_XDECREF(nu);
+                        ok = false;
+                        break;
+                    }
+                    Py_DECREF(nu);
+                }
+            }
+            Py_DECREF(new_blk);
+            if (!ok) break;
+            // drop fully-empty arrangements
+            bool lempty = lrows == nullptr || PyDict_GET_SIZE(lrows) == 0;
+            bool rempty = rrows == nullptr || PyDict_GET_SIZE(rrows) == 0;
+            if (lempty && rempty) {
+                if (lrows != nullptr && PyDict_DelItem(lstate, jk) < 0)
+                    PyErr_Clear();
+                if (rrows != nullptr && PyDict_DelItem(rstate, jk) < 0)
+                    PyErr_Clear();
+            }
+        }
+        Py_DECREF(one);
+        Py_DECREF(neg);
+        if (!ok) goto fail;
+    }
+
+    Py_DECREF(lseq);
+    Py_DECREF(rseq);
+    Py_DECREF(ljks);
+    Py_DECREF(rjks);
+    Py_DECREF(dirty);
+    Py_DECREF(dirty_list);
+    Py_DECREF(old_blocks);
+    Py_DECREF(C.lnone);
+    Py_DECREF(C.rnone);
+    return out;
+fail:
+    if (mutated && PyErr_ExceptionMatches(g_unsupported)) {
+        // never let the caller rerun an already-applied batch
+        PyErr_SetString(PyExc_RuntimeError,
+                        "native join pass failed after state mutation");
+    }
+    Py_XDECREF(lseq);
+    Py_XDECREF(rseq);
+    Py_XDECREF(ljks);
+    Py_XDECREF(rjks);
+    Py_XDECREF(dirty);
+    Py_XDECREF(dirty_list);
+    Py_XDECREF(old_blocks);
+    Py_XDECREF(C.lnone);
+    Py_XDECREF(C.rnone);
+    Py_XDECREF(out);
+    return nullptr;
+}
+
+PyObject* py_vm_filter_batch(PyObject*, PyObject* args) {
+    // (batch, prog_capsule, error_obj) -> surviving updates unchanged.
+    // Drop semantics mirror FilterNode/filter_batch: raising rows, None,
+    // and ERROR all drop; anything else keeps by truthiness.
+    PyObject *batch, *cap, *error_obj;
+    if (!PyArg_ParseTuple(args, "OOO", &batch, &cap, &error_obj))
+        return nullptr;
+    VmProgram* P = vm_from_capsule(cap);
+    if (P == nullptr) return nullptr;
+    PyObject* seq =
+        PySequence_Fast(batch, "vm_filter_batch expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(0);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    std::vector<PyObject*> stack(P->max_stack);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            goto fail;
+        }
+        {
+            PyObject* kv = nullptr;
+            PyObject* r = vm_eval(P, PyTuple_GET_ITEM(u, 0),
+                                  PyTuple_GET_ITEM(u, 1), error_obj, &kv,
+                                  stack);
+            Py_XDECREF(kv);
+            if (r == nullptr) {
+                if (!PyErr_ExceptionMatches(PyExc_Exception)) goto fail;
+                PyErr_Clear();
+                continue;  // raising predicate: drop the row
+            }
+            if (r == Py_None || r == error_obj) {
+                Py_DECREF(r);
+                continue;
+            }
+            int truthy = PyObject_IsTrue(r);
+            Py_DECREF(r);
+            if (truthy < 0) goto fail;
+            if (truthy && PyList_Append(out, u) < 0) goto fail;
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(seq);
+    Py_DECREF(out);
+    return nullptr;
+}
+
 PyMethodDef kMethods[] = {
     {"ref_scalar", py_ref_scalar, METH_VARARGS,
      "128-bit key hash of the argument values"},
     {"hash_rows", py_hash_rows, METH_O,
      "batch 128-bit key hashes for a sequence of value tuples"},
+    {"hash_prefix_ints", py_hash_prefix_ints, METH_VARARGS,
+     "bulk Pointer keys for (prefix..., seq+offset) rows"},
     {"scan_lines", py_scan_lines, METH_O,
      "offsets of non-empty lines in a bytes buffer"},
     {"consolidate", py_consolidate, METH_VARARGS,
@@ -1562,6 +3106,16 @@ PyMethodDef kMethods[] = {
      "keep updates whose (key, values) satisfy the predicate"},
     {"set_pointer_type", py_set_pointer_type, METH_O,
      "register the Pointer class for type-tagged hashing"},
+    {"set_json_type", py_set_json_type, METH_O,
+     "register the Json class for VM convert/get semantics"},
+    {"vm_compile", py_vm_compile, METH_VARARGS,
+     "compile an expression bytecode program to a capsule"},
+    {"vm_eval_batch", py_vm_eval_batch, METH_VARARGS,
+     "evaluate per-column VM programs across an update batch"},
+    {"vm_filter_batch", py_vm_filter_batch, METH_VARARGS,
+     "keep updates whose VM predicate result is truthy"},
+    {"join_process", py_join_process, METH_VARARGS,
+     "full incremental equi-join epoch pass over dict arrangements"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef kModule = {PyModuleDef_HEAD_INIT, "pathway_native",

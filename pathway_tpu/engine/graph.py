@@ -253,9 +253,14 @@ class RowwiseNode(Node):
         row_fn: Callable[[Pointer, tuple], tuple],
         name: str = "select",
         typecheck_info: tuple[list[str], list] | None = None,
+        programs: Any = None,
     ):
         super().__init__(graph, [input], name)
         self.row_fn = row_fn
+        #: per-column VM bytecode capsules (internals/expr_vm.py) — the
+        #: fully-native select path; row_fn remains the semantic ground
+        #: truth and the PATHWAY_DISABLE_NATIVE fallback
+        self.programs = programs
         #: (column names, declared dtypes) for PATHWAY_RUNTIME_TYPECHECKING
         self.typecheck_info = typecheck_info
         self._checker: Any = None
@@ -284,6 +289,16 @@ class RowwiseNode(Node):
         check = self._typecheck()
         native = _native.load()
         if native is not None and check is None:
+            if self.programs is not None:
+                # expression VM: typed tree evaluated in C, no per-row
+                # Python closure dispatch (reference expression.rs role)
+                return native.vm_eval_batch(
+                    inbatches[0],
+                    self.programs,
+                    Update,
+                    api.ERROR,
+                    lambda e: ctx.log_error(self, f"{self.name}: {e!r}"),
+                )
             return native.rowwise_map(
                 inbatches[0],
                 fn,
@@ -306,14 +321,27 @@ class RowwiseNode(Node):
 
 
 class FilterNode(Node):
-    def __init__(self, graph: EngineGraph, input: Node, pred: Callable[[Pointer, tuple], Any], name: str = "filter"):
+    def __init__(
+        self,
+        graph: EngineGraph,
+        input: Node,
+        pred: Callable[[Pointer, tuple], Any],
+        name: str = "filter",
+        program: Any = None,
+    ):
         super().__init__(graph, [input], name)
         self.pred = pred
+        #: VM bytecode capsule for the predicate (internals/expr_vm.py)
+        self.program = program
 
     def process(self, ctx, time, inbatches):
         pred = self.pred
         native = _native.load()
         if native is not None:
+            if self.program is not None:
+                return native.vm_filter_batch(
+                    inbatches[0], self.program, api.ERROR
+                )
             return native.filter_batch(inbatches[0], pred, api.ERROR)
         out = []
         for u in inbatches[0]:
@@ -677,7 +705,11 @@ class GroupByNode(Node):
                 dirty[gh] = g
         out = []
         for gh, g in dirty.items():
-            okey = self.output_key_fn(g["gvals"])
+            # output key is a pure function of the group values — hash it
+            # once per group's lifetime, not once per dirty epoch
+            okey = g.get("okey")
+            if okey is None:
+                okey = g["okey"] = self.output_key_fn(g["gvals"])
             if g["last_out"] is not None:
                 out.append(Update(okey, g["last_out"], -1))
                 g["last_out"] = None
@@ -766,6 +798,7 @@ class JoinNode(Node):
         *,
         left_id_only: bool = False,
         name: str = "join",
+        jk_programs: Any = None,
     ):
         super().__init__(graph, [left, right], name)
         self.left_jk_fn = left_jk_fn
@@ -774,6 +807,9 @@ class JoinNode(Node):
         self.right_ncols = right_ncols
         self.kind = kind
         self.left_id_only = left_id_only
+        #: (left_prog, right_prog) VM capsules computing the join-key
+        #: tuple per row — enables the full native epoch pass
+        self.jk_programs = jk_programs
 
     def exchange_routes(self):
         return [cl.route_by(self.left_jk_fn), cl.route_by(self.right_jk_fn)]
@@ -835,8 +871,35 @@ class JoinNode(Node):
             else:
                 rows.pop(u.key, None)
 
+    _KIND_CODES = {"inner": 0, "left": 1, "right": 2, "outer": 3}
+
     def process(self, ctx, time, inbatches):
         st = ctx.state(self)
+        native = _native.load()
+        if native is not None and self.jk_programs is not None:
+            # whole-epoch native pass (build/probe/diff in C, mirroring
+            # groupby_partials); Unsupported is only raised BEFORE the
+            # arrangements mutate, so the fallback below re-runs safely
+            try:
+                out = native.join_process(
+                    inbatches[0],
+                    inbatches[1],
+                    self.jk_programs[0],
+                    self.jk_programs[1],
+                    st["left"],
+                    st["right"],
+                    self._KIND_CODES[self.kind],
+                    1 if self.left_id_only else 0,
+                    self.left_ncols,
+                    self.right_ncols,
+                    Update,
+                    api.ERROR,
+                    api.EngineError,
+                )
+            except native.Unsupported:
+                pass
+            else:
+                return consolidate(out)
         ljks = self._side_jks(inbatches[0], self.left_jk_fn)
         rjks = self._side_jks(inbatches[1], self.right_jk_fn)
         dirty_keys: set = set()

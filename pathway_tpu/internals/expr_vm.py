@@ -1,0 +1,307 @@
+"""Lower expression ASTs to native stack-VM bytecode.
+
+The reference evaluates typed expression trees row-wise entirely in Rust
+(``src/engine/expression.rs:26-491``) — no Python in the select/filter
+hot loop.  This module is the TPU build's equivalent front half: it walks
+the (build-time-typed) :mod:`pathway_tpu.internals.expression` AST and
+emits a flat postfix program for the C++ VM in
+``native/pathway_native.cpp`` (``vm_eval_batch``/``vm_filter_batch``).
+
+Lazy constructs (``if_else``/``coalesce``/``fill_error``/``get`` default)
+compile to jump-based code so only the taken branch evaluates — the same
+observable behaviour as the Python closures.  Subtrees with no native
+lowering (UDF ``apply``, ``.dt``/``.str``/``.num`` namespace methods)
+fall back to their ordinary ``_compile`` closure, embedded as a single
+``CALL_PY`` instruction; the rest of the expression still runs native.
+
+Every op's behaviour is pinned to the Python closure semantics by the
+differential tests in ``tests/test_expr_vm.py`` (native program vs pure
+Python closure over a value matrix including ``None`` and ``ERROR``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals import keys
+from pathway_tpu.internals import native as _native
+
+# opcodes — must mirror enum VmOp in native/pathway_native.cpp
+OP_LOAD_COL = 1
+OP_LOAD_KEY = 2
+OP_LOAD_CONST = 3
+OP_CALL_PY = 4
+OP_BIN = 5
+OP_NEG = 6
+OP_INV = 7
+OP_IS_NONE = 8
+OP_BRANCH = 9
+OP_JUMP = 10
+OP_JUMP_NOT_NONE = 11
+OP_POP = 12
+OP_REQUIRE = 13
+OP_UNWRAP = 14
+OP_FILL_JUMP = 15
+OP_CAST = 16
+OP_CONVERT = 17
+OP_MAKE_TUPLE = 18
+OP_GET = 19
+OP_POINTER = 20
+
+# binary op ids — must mirror enum VmBin
+BIN_IDS = {
+    "+": 0, "-": 1, "*": 2, "/": 3, "//": 4, "%": 5, "**": 6, "@": 7,
+    "==": 8, "!=": 9, "<": 10, "<=": 11, ">": 12, ">=": 13,
+    "&": 14, "|": 15, "^": 16,
+}
+
+_CAST_IDS = {dt.INT: 0, dt.FLOAT: 1, dt.BOOL: 2, dt.STR: 3}
+
+
+class _Asm:
+    def __init__(self, layout: Any):
+        self.layout = layout
+        self.code: list[int] = []
+        self.consts: list[Any] = []
+        self.pyfuncs: list[Any] = []
+        self.native_ops = 0  # CALL_PY-only programs aren't worth running
+
+    def emit(self, *xs: int) -> None:
+        self.code.extend(xs)
+
+    def const(self, v: Any) -> int:
+        self.consts.append(v)
+        return len(self.consts) - 1
+
+    def here(self) -> int:
+        return len(self.code)
+
+    def patch(self, pos: int, val: int) -> None:
+        self.code[pos] = val
+
+    def fallback(self, e: ex.ColumnExpression) -> None:
+        """Embed the subtree's ordinary Python closure as one CALL_PY."""
+        fn = e._compile(self.layout.resolver)
+        self.pyfuncs.append(fn)
+        self.emit(OP_CALL_PY, len(self.pyfuncs) - 1)
+
+
+def _lower(e: ex.ColumnExpression, asm: _Asm) -> None:
+    t = type(e)
+    if t is ex.ConstExpression:
+        asm.emit(OP_LOAD_CONST, asm.const(e._value))
+        asm.native_ops += 1
+        return
+    if t is ex.ColumnReference:
+        pos = asm.layout.resolve_pos(e)
+        if pos is None:
+            asm.fallback(e)
+            return
+        if pos == -1:
+            asm.emit(OP_LOAD_KEY)
+        else:
+            asm.emit(OP_LOAD_COL, pos)
+        asm.native_ops += 1
+        return
+    if t is ex.BinaryExpression:
+        bid = BIN_IDS.get(e._op)
+        if bid is None:
+            asm.fallback(e)
+            return
+        _lower(e._left, asm)
+        _lower(e._right, asm)
+        asm.emit(OP_BIN, bid)
+        asm.native_ops += 1
+        return
+    if t is ex.UnaryExpression:
+        _lower(e._operand, asm)
+        asm.emit(OP_NEG if e._op == "-" else OP_INV)
+        asm.native_ops += 1
+        return
+    if t is ex.IsNoneExpression:
+        _lower(e._expr, asm)
+        asm.emit(OP_IS_NONE)
+        asm.native_ops += 1
+        return
+    if t is ex.IfElseExpression:
+        _lower(e._cond, asm)
+        asm.emit(OP_BRANCH, 0, 0)
+        fix = asm.here() - 2  # (else_t, end_t)
+        _lower(e._then, asm)
+        asm.emit(OP_JUMP, 0)
+        jfix = asm.here() - 1
+        asm.patch(fix, asm.here())  # else target
+        _lower(e._else, asm)
+        end = asm.here()
+        asm.patch(fix + 1, end)
+        asm.patch(jfix, end)
+        asm.native_ops += 1
+        return
+    if t is ex.CoalesceExpression:
+        if not e._args:
+            asm.emit(OP_LOAD_CONST, asm.const(None))
+            asm.native_ops += 1
+            return
+        jumps = []
+        for i, a in enumerate(e._args):
+            _lower(a, asm)
+            if i < len(e._args) - 1:
+                asm.emit(OP_JUMP_NOT_NONE, 0)
+                jumps.append(asm.here() - 1)
+                asm.emit(OP_POP)
+        end = asm.here()
+        for j in jumps:
+            asm.patch(j, end)
+        asm.native_ops += 1
+        return
+    if t is ex.RequireExpression:
+        fixes = []
+        for d in e._deps:
+            _lower(d, asm)
+            asm.emit(OP_REQUIRE, 0)
+            fixes.append(asm.here() - 1)
+        _lower(e._value, asm)
+        end = asm.here()
+        for f in fixes:
+            asm.patch(f, end)
+        asm.native_ops += 1
+        return
+    if t is ex.CastExpression:
+        tid = _CAST_IDS.get(e._target.strip_optional())
+        _lower(e._expr, asm)
+        if tid is None:
+            return  # unknown target passes the value through (closure parity)
+        asm.emit(OP_CAST, tid)
+        asm.native_ops += 1
+        return
+    if t is ex.ConvertExpression:
+        native = _native.load()
+        tid = _CAST_IDS.get(e._target.strip_optional())
+        if tid is None or native is None or not _json_registered(native):
+            asm.fallback(e)
+            return
+        _lower(e._expr, asm)
+        asm.emit(OP_CONVERT, tid, 1 if e._unwrap else 0)
+        asm.native_ops += 1
+        return
+    if t is ex.MakeTupleExpression:
+        for a in e._args:
+            _lower(a, asm)
+        asm.emit(OP_MAKE_TUPLE, len(e._args))
+        asm.native_ops += 1
+        return
+    if t is ex.GetExpression:
+        native = _native.load()
+        if native is None or not _json_registered(native):
+            asm.fallback(e)
+            return
+        _lower(e._obj, asm)
+        _lower(e._index, asm)
+        strict = 0 if e._check else 1
+        asm.emit(OP_GET, strict, 0)
+        fix = asm.here() - 1
+        if e._check:
+            _lower(e._default, asm)
+        asm.patch(fix, asm.here())
+        asm.native_ops += 1
+        return
+    if t is ex.UnwrapExpression:
+        _lower(e._expr, asm)
+        asm.emit(OP_UNWRAP)
+        asm.native_ops += 1
+        return
+    if t is ex.FillErrorExpression:
+        _lower(e._expr, asm)
+        asm.emit(OP_FILL_JUMP, 0)
+        fix = asm.here() - 1
+        asm.emit(OP_POP)
+        _lower(e._replacement, asm)
+        asm.patch(fix, asm.here())
+        asm.native_ops += 1
+        return
+    if t is ex.DeclareTypeExpression:
+        _lower(e._expr, asm)
+        return
+    if t is ex.PointerExpression:
+        # closure parity: only _args are evaluated (instance is a
+        # grouping hint, not hash material — expression.py:688-698)
+        for a in e._args:
+            _lower(a, asm)
+        rs_idx = asm.const(keys.ref_scalar)
+        asm.emit(
+            OP_POINTER, len(e._args), 1 if e._optional else 0, rs_idx
+        )
+        asm.native_ops += 1
+        return
+    # ApplyExpression (+async variants), MethodCallExpression, and any
+    # future node types run as their ordinary Python closure
+    asm.fallback(e)
+
+
+def _json_registered(native: Any) -> bool:
+    return getattr(native, "_json_registered", False)
+
+
+def lower_program(e: ex.ColumnExpression, layout: Any) -> Any | None:
+    """Compile one expression to a VM program capsule, or None when the
+    native module is absent or nothing in the tree lowers natively."""
+    native = _native.load()
+    if native is None:
+        return None
+    asm = _Asm(layout)
+    try:
+        _lower(e, asm)
+    except Exception:  # lowering must never break graph build
+        return None
+    if asm.native_ops == 0:
+        return None  # pure CALL_PY: the closure path is already optimal
+    try:
+        return native.vm_compile(asm.code, tuple(asm.consts), tuple(asm.pyfuncs))
+    except Exception:
+        return None
+
+
+def lower_programs(exprs: list[ex.ColumnExpression], layout: Any) -> Any | None:
+    """Capsules for a select's output columns.  A column with no native
+    lowering still becomes a one-CALL_PY program (the batch loop is the
+    same either way), but if NO column lowers natively the select keeps
+    the existing rowwise_map closure path — identical performance, less
+    machinery."""
+    native = _native.load()
+    if native is None:
+        return None
+    asms = []
+    total_native = 0
+    for e in exprs:
+        asm = _Asm(layout)
+        try:
+            _lower(e, asm)
+        except Exception:  # lowering must never break graph build
+            return None
+        total_native += asm.native_ops
+        asms.append(asm)
+    if total_native == 0:
+        return None
+    try:
+        return tuple(
+            native.vm_compile(a.code, tuple(a.consts), tuple(a.pyfuncs))
+            for a in asms
+        )
+    except Exception:
+        return None
+
+
+def project_program(positions: list[int]) -> Any | None:
+    """A program per position for pure column projection (filter's
+    project-back node): LOAD_COL only."""
+    native = _native.load()
+    if native is None:
+        return None
+    try:
+        return tuple(
+            native.vm_compile([OP_LOAD_COL, p], (), ()) for p in positions
+        )
+    except Exception:
+        return None

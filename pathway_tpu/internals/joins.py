@@ -112,6 +112,18 @@ class JoinResult:
                 if ref._table is left or ref._table is LEFT:
                     left_id_only = True
 
+        # native epoch pass: one VM program per side computing the whole
+        # join-key tuple (internals/expr_vm.py); falls back to the
+        # closures above when lowering is unavailable
+        from pathway_tpu.internals import expr_vm as _vm
+        from pathway_tpu.internals.expression import MakeTupleExpression
+
+        lprog = _vm.lower_program(MakeTupleExpression(*left_exprs), llayout)
+        rprog = _vm.lower_program(MakeTupleExpression(*right_exprs), rlayout)
+        jk_programs = (
+            (lprog, rprog) if lprog is not None and rprog is not None else None
+        )
+
         self._node = eg.JoinNode(
             G.engine_graph,
             left._node,
@@ -122,6 +134,7 @@ class JoinResult:
             right_ncols=len(right._column_names),
             kind=kind.value,
             left_id_only=left_id_only,
+            jk_programs=jk_programs,
         )
 
     # ------------------------------------------------------------------

@@ -67,5 +67,17 @@ def load() -> Any:
         except Exception as e:  # noqa: BLE001
             _logger.info("native load failed: %r", e)
             return None
+        # register the value classes the VM needs for type-tagged
+        # hashing (Pointer) and Json get/convert semantics.  Local
+        # imports: keys/json import this module at top level.
+        try:
+            from pathway_tpu.internals.json import Json
+            from pathway_tpu.internals.keys import Pointer
+
+            mod.set_pointer_type(Pointer)
+            mod.set_json_type(Json)
+            mod._json_registered = True
+        except Exception:  # registration failure only disables fast paths
+            mod._json_registered = False
         _module = mod
         return mod

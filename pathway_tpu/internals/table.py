@@ -13,6 +13,7 @@ from typing import Any, Callable, Iterable, Mapping
 
 from pathway_tpu.internals import api
 from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expr_vm as _vm
 from pathway_tpu.internals import keys as K
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.expression import (
@@ -401,6 +402,7 @@ class Table:
         node = eg.RowwiseNode(
             G.engine_graph, in_node, row_fn, name="select",
             typecheck_info=(names, [dtypes[n] for n in names]),
+            programs=_vm.lower_programs(exprs, layout),
         )
         # select keeps row keys -> same universe token; new layout family
         return Table(
@@ -566,13 +568,15 @@ class Table:
         layout, in_node = self._prepare([e])
         c = e._compile(layout.resolver)
         node: eg.Node = eg.FilterNode(
-            G.engine_graph, in_node, lambda key, values: c((key, values))
+            G.engine_graph, in_node, lambda key, values: c((key, values)),
+            program=_vm.lower_program(e, layout),
         )
         if in_node is not self._node:
             # predicate needed zipped columns: project back to our layout
             n = len(self._column_names)
             node = eg.RowwiseNode(
-                G.engine_graph, node, lambda key, values: values[:n], name="project"
+                G.engine_graph, node, lambda key, values: values[:n], name="project",
+                programs=_vm.project_program(list(range(n))),
             )
         return Table(
             node,
@@ -600,7 +604,10 @@ class Table:
         if any(_contains_async(e) for e in all_exprs):
             return self._select_async(all_names, all_exprs, layout, dtypes, in_node)
         row_fn = compile_exprs(all_exprs, layout)
-        node = eg.RowwiseNode(G.engine_graph, in_node, row_fn, name="with_columns")
+        node = eg.RowwiseNode(
+            G.engine_graph, in_node, row_fn, name="with_columns",
+            programs=_vm.lower_programs(all_exprs, layout),
+        )
         return Table(
             node, all_names, dtypes, name=f"{self._name}.with_columns",
             layout_token=self._layout_token,

@@ -1,0 +1,401 @@
+"""Differential tests: native expression-VM programs vs the pure-Python
+closures in internals/expression.py.
+
+The reference evaluates typed expression trees in Rust
+(``src/engine/expression.rs:26-491``); our equivalent is the bytecode VM
+in ``native/pathway_native.cpp`` lowered by ``internals/expr_vm.py``.
+These tests pin the VM to the closure semantics op by op over an
+adversarial value matrix (None, ERROR, bools vs ints, big ints, mixed
+types, Json), so any divergence between the two paths fails loudly.
+"""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.stream import Update
+from pathway_tpu.internals import api
+from pathway_tpu.internals import expr_vm
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import native as _native
+from pathway_tpu.internals.json import Json
+
+
+@pytest.fixture(scope="module")
+def native():
+    mod = _native.load()
+    if mod is None or not hasattr(mod, "vm_compile"):
+        pytest.skip("native VM unavailable")
+    return mod
+
+
+class _Table:
+    """Stand-in table identity for ColumnReference."""
+
+
+class _Layout:
+    """Minimal layout: columns x,y,z at positions 0,1,2; id is the key."""
+
+    _POS = {"x": 0, "y": 1, "z": 2}
+
+    def resolver(self, ref):
+        if ref._name == "id":
+            return lambda kv: kv[0]
+        pos = self._POS[ref._name]
+        return lambda kv, pos=pos: kv[1][pos]
+
+    def resolve_pos(self, ref):
+        if ref._name == "id":
+            return -1
+        return self._POS[ref._name]
+
+
+_T = _Table()
+X = ex.ColumnReference(_T, "x")
+Y = ex.ColumnReference(_T, "y")
+Z = ex.ColumnReference(_T, "z")
+LAYOUT = _Layout()
+
+E = api.ERROR
+
+#: (x, y, z) rows covering the value lattice the closures handle
+ROWS = [
+    (1, 2, 3),
+    (-5, 3, 0),
+    (0, 0, 1),
+    (2**62, 2**62, 1),          # int64 overflow in + and *
+    (2**100, 7, 2),              # big ints -> generic path
+    (1.5, 2.5, 0.0),
+    (1.5, 0.0, -3.25),
+    (float("nan"), 1.0, 2.0),
+    (1, 2.5, 3),                 # mixed int/float
+    (True, False, True),         # bools are NOT ints for & | ^
+    (True, 1, 0),
+    ("ab", "cd", "ab"),
+    ("ab", 3, None),             # str+int -> ERROR; None ops -> None
+    (None, None, 1),
+    (None, 5, "x"),
+    (E, 1, 2),                   # ERROR propagation
+    (1, E, E),
+    ((1, 2), (3, 4), 1),         # tuple concat / compare / getitem
+    (b"ab", b"cd", 0),
+]
+
+
+def _key(i):
+    return K.ref_scalar("vmtest", i)
+
+
+def _batch():
+    return [Update(_key(i), row, 1) for i, row in enumerate(ROWS)]
+
+
+def _canon(v):
+    """Identity-aware canonical form: distinguishes 1/True/1.0, treats
+    NaN as equal to itself, keeps ERROR as a sentinel."""
+    if v is api.ERROR:
+        return "<ERROR>"
+    if isinstance(v, tuple):
+        return tuple(_canon(x) for x in v)
+    if isinstance(v, float) and math.isnan(v):
+        return "<nan>"
+    return (type(v).__name__, repr(v))
+
+
+def _assert_parity(native, exprs, *, expect_native=True):
+    """Evaluate exprs through the VM and through the closures; compare."""
+    progs = expr_vm.lower_programs(list(exprs), LAYOUT)
+    if expect_native:
+        assert progs is not None, "expected a native lowering"
+    if progs is None:
+        return
+    errors_native: list = []
+    out = native.vm_eval_batch(
+        _batch(), progs, Update, api.ERROR, errors_native.append
+    )
+    closures = [e._compile(LAYOUT.resolver) for e in exprs]
+    for u_in, u_out in zip(_batch(), out):
+        expected = []
+        row_raised = False
+        for c in closures:
+            try:
+                expected.append(c((u_in.key, u_in.values)))
+            except Exception:
+                row_raised = True
+                break
+        if row_raised:
+            expected = [api.ERROR]
+        assert u_out.key == u_in.key and u_out.diff == u_in.diff
+        got = list(u_out.values)
+        assert [_canon(g) for g in got] == [_canon(e) for e in expected], (
+            u_in.values,
+            got,
+            expected,
+        )
+
+
+ALL_BIN_OPS = ["+", "-", "*", "/", "//", "%", "**", "==", "!=", "<", "<=",
+               ">", ">=", "&", "|", "^"]
+
+
+@pytest.mark.parametrize("op", ALL_BIN_OPS)
+def test_binary_op_parity(native, op):
+    if op == "**":
+        # huge-exponent rows would legitimately compute for hours on BOTH
+        # paths; pin ** to a bounded matrix instead
+        rows = [
+            (2, 10, 0), (2, -2, 0), (0, 0, 0), (1.5, 2.0, 0),
+            (-2, 3, 0), (None, 2, 0), (E, 2, 0), ("a", 2, 0),
+            (True, True, 0),
+        ]
+        batch = [Update(_key(i), r, 1) for i, r in enumerate(rows)]
+        e = ex.BinaryExpression(op, X, Y)
+        progs = expr_vm.lower_programs([e], LAYOUT)
+        assert progs is not None
+        out = native.vm_eval_batch(batch, progs, Update, api.ERROR, lambda x: None)
+        c = e._compile(LAYOUT.resolver)
+        for u_in, u_out in zip(batch, out):
+            expected = c((u_in.key, u_in.values))
+            assert _canon(u_out.values[0]) == _canon(expected), u_in.values
+        return
+    _assert_parity(native, [ex.BinaryExpression(op, X, Y)])
+
+
+def test_unary_parity(native):
+    _assert_parity(native, [ex.UnaryExpression("-", X), ex.UnaryExpression("~", X)])
+
+
+def test_is_none_parity(native):
+    _assert_parity(native, [X.is_none(), X.is_not_none()])
+
+
+def test_if_else_coalesce_require_parity(native):
+    _assert_parity(
+        native,
+        [
+            ex.if_else(ex.BinaryExpression(">", X, Y), X, Y),
+            ex.coalesce(X, Y, ex.ConstExpression(99)),
+            ex.require(X, Y),
+            ex.if_else(
+                X.is_none(), ex.ConstExpression(-1),
+                ex.if_else(ex.BinaryExpression(">", X, ex.ConstExpression(0)), X, Y),
+            ),
+        ],
+    )
+
+
+def test_cast_parity(native):
+    import pathway_tpu.internals.dtype as dt
+
+    _assert_parity(
+        native,
+        [ex.cast(t, X) for t in (dt.INT, dt.FLOAT, dt.BOOL, dt.STR)],
+    )
+
+
+def test_tuple_get_parity(native):
+    _assert_parity(
+        native,
+        [
+            ex.make_tuple(X, Y),
+            ex.GetExpression(X, ex.ConstExpression(0), check_if_exists=False),
+            ex.GetExpression(
+                X, ex.ConstExpression(0),
+                default=ex.ConstExpression("dflt"), check_if_exists=True,
+            ),
+        ],
+    )
+
+
+def test_unwrap_fill_error_parity(native):
+    _assert_parity(
+        native,
+        [
+            ex.unwrap(X),
+            ex.fill_error(ex.BinaryExpression("/", X, Y), ex.ConstExpression(-1)),
+            ex.fill_error(X, Y),
+        ],
+    )
+
+
+def test_pointer_parity(native):
+    _assert_parity(
+        native,
+        [
+            ex.PointerExpression(_T, X, Y),
+            ex.PointerExpression(_T, X, optional=True),
+        ],
+    )
+
+
+def test_declare_type_and_const(native):
+    import pathway_tpu.internals.dtype as dt
+
+    _assert_parity(
+        native,
+        [ex.declare_type(dt.ANY, X), ex.ConstExpression("k")],
+    )
+
+
+def test_mixed_native_and_pycall(native):
+    """A UDF apply rides CALL_PY inside an otherwise-native program."""
+    _assert_parity(
+        native,
+        [
+            ex.BinaryExpression(
+                "+",
+                ex.apply_with_type(lambda v: (v, v), object, X),
+                ex.MakeTupleExpression(Y),
+            ),
+        ],
+        expect_native=True,
+    )
+
+
+def test_raising_udf_contains_row(native):
+    """ApplyExpression's closure contains its own exception (error-logged,
+    returns ERROR) — the VM must propagate that ERROR through native ops.
+    A closure that raises PAST the containment (bare pyfunc) must instead
+    trigger the row-level on_error + (ERROR,) path like rowwise_map."""
+
+    def boom(v):
+        raise RuntimeError("boom")
+
+    # (a) apply: contained inside the closure -> column is ERROR, no
+    # row-level on_error
+    e = ex.apply_with_type(boom, int, X)
+    prog = expr_vm.lower_programs(
+        [ex.BinaryExpression("+", e, ex.ConstExpression(1))], LAYOUT
+    )
+    assert prog is not None
+    logged: list = []
+    out = native.vm_eval_batch(
+        _batch()[:3], prog, Update, api.ERROR, logged.append
+    )
+    assert all(u.values == (api.ERROR,) for u in out)
+    assert logged == []
+
+    # (b) a raw raising pyfunc (no Apply containment): row-level
+    # containment fires exactly like rowwise_map
+    raw = native.vm_compile(
+        [expr_vm.OP_CALL_PY, 0], (), (lambda kv: (_ for _ in ()).throw(RuntimeError("x")),)
+    )
+    out2 = native.vm_eval_batch(
+        _batch()[:2], (raw,), Update, api.ERROR, logged.append
+    )
+    assert all(u.values == (api.ERROR,) for u in out2)
+    assert len(logged) == 2 and all(isinstance(x, RuntimeError) for x in logged)
+
+
+def test_json_get_convert_parity(native):
+    rows = [
+        (Json({"a": 1, "b": [10, 20]}), "a", 1),
+        (Json({"a": "s"}), "a", 0),
+        (Json({"a": None}), "a", 0),
+        (Json(3.5), "q", 0),
+        (Json(True), "q", 0),
+        (None, "a", 0),
+        (E, "a", 0),
+    ]
+    batch = [Update(_key(i), r, 1) for i, r in enumerate(rows)]
+    import pathway_tpu.internals.dtype as dt
+
+    exprs = [
+        ex.GetExpression(X, Y, check_if_exists=False),
+        ex.GetExpression(
+            X, Y, default=ex.ConstExpression(None), check_if_exists=True
+        ),
+        ex.ConvertExpression(
+            dt.INT,
+            ex.GetExpression(
+                X, ex.ConstExpression("a"),
+                default=ex.ConstExpression(None), check_if_exists=True,
+            ),
+        ),
+        ex.ConvertExpression(dt.FLOAT, X),
+        ex.ConvertExpression(dt.BOOL, X, unwrap=True),
+    ]
+    progs = expr_vm.lower_programs(exprs, LAYOUT)
+    assert progs is not None
+    out = native.vm_eval_batch(batch, progs, Update, api.ERROR, lambda e: None)
+    closures = [e._compile(LAYOUT.resolver) for e in exprs]
+    for u_in, u_out in zip(batch, out):
+        expected = [c((u_in.key, u_in.values)) for c in closures]
+        assert [_canon(g) for g in u_out.values] == [
+            _canon(e) for e in expected
+        ], (u_in.values, list(u_out.values), expected)
+
+
+def test_filter_parity(native):
+    preds = [
+        ex.BinaryExpression(">", X, Y),
+        X.is_none(),
+        ex.BinaryExpression("==", X, X),
+        ex.BinaryExpression("/", ex.ConstExpression(1), X),  # 1/x truthiness
+    ]
+    for pred in preds:
+        prog = expr_vm.lower_program(pred, LAYOUT)
+        assert prog is not None
+        out = native.vm_filter_batch(_batch(), prog, api.ERROR)
+        c = pred._compile(LAYOUT.resolver)
+        expected = []
+        for u in _batch():
+            try:
+                keep = c((u.key, u.values))
+            except Exception:
+                continue
+            if keep is not None and keep is not api.ERROR and bool(keep):
+                expected.append(u)
+        assert [u.key for u in out] == [u.key for u in expected], pred
+
+
+def test_vm_rejects_malformed_programs(native):
+    with pytest.raises(ValueError):
+        native.vm_compile([expr_vm.OP_JUMP, 999], (), ())
+    with pytest.raises(ValueError):
+        native.vm_compile([expr_vm.OP_LOAD_CONST, 5], (), ())
+    with pytest.raises(ValueError):
+        native.vm_compile([expr_vm.OP_CALL_PY, 0], (), ())
+    with pytest.raises(ValueError):
+        native.vm_compile([99], (), ())
+
+
+def test_end_to_end_pipeline_matches_disable_native():
+    """The same select/filter pipeline prints identically with the VM and
+    with PATHWAY_DISABLE_NATIVE=1 (subprocess)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import pathway_tpu as pw\n"
+        "t = pw.debug.table_from_markdown('''\n"
+        "a | b | s\n"
+        "1 | 2 | x\n"
+        "3 | 0 | y\n"
+        "5 | 4 | z\n"
+        "''')\n"
+        "out = t.select(t.a, q=t.a * 2 + t.b, d=t.a / t.b,\n"
+        "    w=pw.if_else(t.a > 2, t.s, pw.coalesce(t.s, 'n')),\n"
+        "    p=t.pointer_from(t.a), m=pw.make_tuple(t.a, t.b)[1])\n"
+        "out = out.filter(out.q > 3)\n"
+        "pw.debug.compute_and_print(out, include_id=False)\n"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    a = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env
+    )
+    env2 = dict(env, PATHWAY_DISABLE_NATIVE="1")
+    b = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, env=env2
+    )
+    assert a.returncode == 0, a.stderr
+    assert b.returncode == 0, b.stderr
+    assert a.stdout == b.stdout
