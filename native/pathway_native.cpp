@@ -37,6 +37,7 @@
 #include <cstdint>
 #include <cstring>
 #include <memory>
+#include <queue>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -2299,63 +2300,137 @@ PyObject* py_vm_compile(PyObject*, PyObject* args) {
         P->pyfuncs.push_back(o);
     }
     Py_DECREF(fseq);
-    // validation pass: operand counts, jump targets, table indices —
-    // the VM itself trusts the program, so everything is checked here
-    size_t ip = 0, pushes = 0;
-    const size_t n = P->code.size();
-    while (ip < n) {
-        int64_t op = P->code[ip];
-        int nops = vm_n_operands(op);
-        if (nops < 0 || ip + 1 + (size_t)nops > n) {
+    // Validation pass: operand counts, jump targets (instruction
+    // boundaries only), table indices, AND full stack discipline — a
+    // worklist dataflow over (ip -> stack depth).  The VM itself trusts
+    // the program completely, so this is the only guard against stack
+    // underflow / imbalance from a buggy or hostile lowering.
+    {
+        const size_t n = P->code.size();
+        // instruction boundaries
+        std::vector<uint8_t> is_insn(n + 1, 0);
+        size_t ip = 0;
+        while (ip < n) {
+            is_insn[ip] = 1;
+            int64_t op = P->code[ip];
+            int nops = vm_n_operands(op);
+            if (nops < 0 || ip + 1 + (size_t)nops > n) {
+                PyErr_SetString(PyExc_ValueError, "malformed VM program");
+                return nullptr;
+            }
+            ip += 1 + (size_t)nops;
+        }
+        is_insn[n] = 1;  // falling off the end is the exit
+        std::vector<int> depth_at(n + 1, -1);  // -1 = unvisited
+        std::vector<size_t> work;
+        auto fail = [&]() {
             PyErr_SetString(PyExc_ValueError, "malformed VM program");
+        };
+        auto flow = [&](size_t target, int depth) -> bool {
+            if (target > n || !is_insn[target]) return false;
+            if (target == n && depth != 1) return false;  // exit depth
+            if (depth_at[target] == -1) {
+                depth_at[target] = depth;
+                if (target < n) work.push_back(target);
+                return true;
+            }
+            return depth_at[target] == depth;  // merge must agree
+        };
+        if (!flow(0, 0)) {
+            fail();
             return nullptr;
         }
-        pushes++;
-        const int64_t* operands = &P->code[ip + 1];
-        bool ok = true;
-        switch (op) {
-            case VM_LOAD_COL: ok = operands[0] >= 0; break;
-            case VM_LOAD_CONST:
-                ok = operands[0] >= 0 &&
-                     (size_t)operands[0] < P->consts.size();
-                break;
-            case VM_CALL_PY:
-                ok = operands[0] >= 0 &&
-                     (size_t)operands[0] < P->pyfuncs.size();
-                break;
-            case VM_BIN: ok = operands[0] >= 0 && operands[0] <= B_XOR; break;
-            case VM_BRANCH:
-                ok = operands[0] >= 0 && (size_t)operands[0] <= n &&
-                     operands[1] >= 0 && (size_t)operands[1] <= n;
-                break;
-            case VM_JUMP:
-            case VM_JUMP_NOT_NONE:
-            case VM_REQUIRE:
-            case VM_FILL_JUMP:
-                ok = operands[0] >= 0 && (size_t)operands[0] <= n;
-                break;
-            case VM_CAST: ok = operands[0] >= 0 && operands[0] <= 3; break;
-            case VM_CONVERT:
-                ok = operands[0] >= 0 && operands[0] <= 3;
-                break;
-            case VM_MAKE_TUPLE: ok = operands[0] >= 0; break;
-            case VM_GET:
-                ok = operands[1] >= 0 && (size_t)operands[1] <= n;
-                break;
-            case VM_POINTER:
-                ok = operands[0] >= 1 && operands[2] >= 0 &&
-                     (size_t)operands[2] < P->consts.size();
-                break;
+        size_t max_depth = 1;
+        while (!work.empty()) {
+            size_t at = work.back();
+            work.pop_back();
+            int64_t op = P->code[at];
+            const int64_t* o = &P->code[at + 1];
+            int d = depth_at[at];
+            size_t next = at + 1 + (size_t)vm_n_operands(op);
+            bool ok = true;
+            int nd = d;
+            switch (op) {
+                case VM_LOAD_COL:
+                    ok = o[0] >= 0 && flow(next, d + 1);
+                    nd = d + 1;
+                    break;
+                case VM_LOAD_KEY:
+                    ok = flow(next, d + 1);
+                    nd = d + 1;
+                    break;
+                case VM_LOAD_CONST:
+                    ok = o[0] >= 0 && (size_t)o[0] < P->consts.size() &&
+                         flow(next, d + 1);
+                    nd = d + 1;
+                    break;
+                case VM_CALL_PY:
+                    ok = o[0] >= 0 && (size_t)o[0] < P->pyfuncs.size() &&
+                         flow(next, d + 1);
+                    nd = d + 1;
+                    break;
+                case VM_BIN:
+                    ok = o[0] >= 0 && o[0] <= B_XOR && d >= 2 &&
+                         flow(next, d - 1);
+                    break;
+                case VM_NEG:
+                case VM_INV:
+                case VM_IS_NONE:
+                case VM_UNWRAP:
+                    ok = d >= 1 && flow(next, d);
+                    break;
+                case VM_CAST:
+                    ok = o[0] >= 0 && o[0] <= 3 && d >= 1 && flow(next, d);
+                    break;
+                case VM_CONVERT:
+                    ok = o[0] >= 0 && o[0] <= 3 && d >= 1 && flow(next, d);
+                    break;
+                case VM_BRANCH:
+                    // pop cond; ERROR path pushes and jumps to end
+                    ok = d >= 1 && flow(next, d - 1) &&
+                         flow((size_t)o[0], d - 1) && flow((size_t)o[1], d);
+                    break;
+                case VM_JUMP:
+                    ok = flow((size_t)o[0], d);
+                    break;
+                case VM_JUMP_NOT_NONE:
+                case VM_FILL_JUMP:
+                    ok = d >= 1 && flow(next, d) && flow((size_t)o[0], d);
+                    break;
+                case VM_POP:
+                    ok = d >= 1 && flow(next, d - 1);
+                    break;
+                case VM_REQUIRE:
+                    // pop; None path re-pushes and jumps to end
+                    ok = d >= 1 && flow(next, d - 1) && flow((size_t)o[0], d);
+                    break;
+                case VM_MAKE_TUPLE:
+                    ok = o[0] >= 0 && d >= (int)o[0] &&
+                         flow(next, d - (int)o[0] + 1);
+                    nd = d - (int)o[0] + 1;
+                    break;
+                case VM_GET:
+                    // pops obj+idx; success/ERROR jump to end with +1
+                    ok = d >= 2 && flow((size_t)o[1], d - 1) &&
+                         (o[0] != 0 || flow(next, d - 2));
+                    break;
+                case VM_POINTER:
+                    ok = o[0] >= 1 && d >= (int)o[0] && o[2] >= 0 &&
+                         (size_t)o[2] < P->consts.size() &&
+                         flow(next, d - (int)o[0] + 1);
+                    nd = d - (int)o[0] + 1;
+                    break;
+                default:
+                    ok = false;
+            }
+            if (!ok) {
+                fail();
+                return nullptr;
+            }
+            if ((size_t)(nd + 1) > max_depth) max_depth = (size_t)(nd + 1);
         }
-        if (!ok) {
-            PyErr_SetString(PyExc_ValueError, "malformed VM program");
-            return nullptr;
-        }
-        ip += 1 + (size_t)nops;
+        P->max_stack = max_depth + 2;
     }
-    // conservative stack bound: every instruction pushes at most one
-    // value beyond what it pops (MAKE_TUPLE/POINTER pop more)
-    P->max_stack = pushes + 2;
     PyObject* cap =
         PyCapsule_New(P.release(), "pathway_tpu.vm", vm_capsule_free);
     return cap;
@@ -3071,6 +3146,444 @@ fail:
     return nullptr;
 }
 
+// ===========================================================================
+// HNSW graph ANN index
+//
+// Host-side hierarchical navigable small-world index, the role of the
+// reference's usearch integration
+// (src/external_integration/usearch_integration.rs:1-163): greedy
+// multi-layer descent + ef-bounded best-first search on layer 0, Malkov
+// neighbor-selection heuristic, tombstone removals with slot reuse.
+// The pointer-chasing walk is hostile to XLA, so unlike the brute-force
+// and IVF indexes this one lives entirely on the host — in C++, since a
+// per-hop Python interpreter step would dominate the traversal.
+// Vectors are float32, contiguous; cos uses pre-normalized vectors with
+// distance = -dot (the Python wrapper normalizes).
+
+struct HnswIndex {
+    int dim, M, M0, efc, metric;  // metric: 0 ip (-dot; cos = normalized ip), 1 l2sq
+    double inv_log_m;
+    std::vector<float> vecs;                             // slot*dim
+    std::vector<int> levels;                             // per slot
+    std::vector<std::vector<std::vector<uint32_t>>> links;  // slot -> level -> ids
+    std::vector<uint8_t> alive;
+    std::vector<uint32_t> freelist;
+    std::vector<uint32_t> visited_stamp;
+    uint32_t stamp = 0;
+    int64_t entry = -1;
+    int max_level = -1;
+    size_t n_alive = 0;
+    uint64_t rng = 0x9e3779b97f4a7c15ULL;
+
+    float dist(const float* a, const float* b) const {
+        float acc = 0.f;
+        if (metric == 1) {
+            for (int i = 0; i < dim; i++) {
+                float d = a[i] - b[i];
+                acc += d * d;
+            }
+            return acc;
+        }
+        for (int i = 0; i < dim; i++) acc += a[i] * b[i];
+        return -acc;
+    }
+    const float* vec(uint32_t s) const { return vecs.data() + (size_t)s * dim; }
+    uint64_t next_rand() {
+        rng ^= rng << 13;
+        rng ^= rng >> 7;
+        rng ^= rng << 17;
+        return rng;
+    }
+    int random_level() {
+        double u = ((next_rand() >> 11) + 1) * (1.0 / 9007199254740992.0);
+        int l = (int)(-std::log(u) * inv_log_m);
+        return l < 32 ? l : 32;
+    }
+    bool visit(uint32_t s) {  // true if first visit this query
+        if (visited_stamp.size() < levels.size())
+            visited_stamp.resize(levels.size(), 0);
+        if (visited_stamp[s] == stamp) return false;
+        visited_stamp[s] = stamp;
+        return true;
+    }
+};
+
+void hnsw_capsule_free(PyObject* cap) {
+    delete static_cast<HnswIndex*>(
+        PyCapsule_GetPointer(cap, "pathway_tpu.hnsw"));
+}
+
+inline HnswIndex* hnsw_from_capsule(PyObject* cap) {
+    return static_cast<HnswIndex*>(
+        PyCapsule_GetPointer(cap, "pathway_tpu.hnsw"));
+}
+
+using DistSlot = std::pair<float, uint32_t>;  // (distance, slot)
+
+// best-first search on one layer; returns up to ef closest (sorted asc)
+void hnsw_search_layer(HnswIndex& H, const float* q, uint32_t start, int ef,
+                       int level, std::vector<DistSlot>& out) {
+    H.stamp++;
+    std::priority_queue<DistSlot, std::vector<DistSlot>,
+                        std::greater<DistSlot>>
+        cand;  // min-heap by distance
+    std::priority_queue<DistSlot> best;  // max-heap by distance
+    float d0 = H.dist(q, H.vec(start));
+    H.visit(start);
+    cand.push({d0, start});
+    best.push({d0, start});
+    while (!cand.empty()) {
+        DistSlot c = cand.top();
+        if (c.first > best.top().first && (int)best.size() >= ef) break;
+        cand.pop();
+        if ((int)H.links[c.second].size() <= level) continue;
+        for (uint32_t nb : H.links[c.second][level]) {
+            if (!H.visit(nb)) continue;
+            float d = H.dist(q, H.vec(nb));
+            if ((int)best.size() < ef || d < best.top().first) {
+                cand.push({d, nb});
+                best.push({d, nb});
+                if ((int)best.size() > ef) best.pop();
+            }
+        }
+    }
+    out.clear();
+    out.resize(best.size());
+    for (size_t i = best.size(); i-- > 0;) {
+        out[i] = best.top();
+        best.pop();
+    }
+}
+
+// Malkov heuristic: keep a candidate only if it is closer to q than to
+// every already-selected neighbor (diversity), up to M
+void hnsw_select_neighbors(HnswIndex& H, const float* q,
+                           const std::vector<DistSlot>& cand, int M,
+                           std::vector<uint32_t>& out) {
+    out.clear();
+    for (const auto& c : cand) {
+        if ((int)out.size() >= M) break;
+        bool good = true;
+        for (uint32_t s : out) {
+            if (H.dist(H.vec(c.second), H.vec(s)) < c.first) {
+                good = false;
+                break;
+            }
+        }
+        if (good) out.push_back(c.second);
+    }
+    // backfill with closest skipped candidates if diversity starved us
+    if ((int)out.size() < M) {
+        for (const auto& c : cand) {
+            if ((int)out.size() >= M) break;
+            if (std::find(out.begin(), out.end(), c.second) == out.end())
+                out.push_back(c.second);
+        }
+    }
+}
+
+void hnsw_prune(HnswIndex& H, uint32_t s, int level, int cap) {
+    auto& lst = H.links[s][level];
+    if ((int)lst.size() <= cap) return;
+    std::vector<DistSlot> cand;
+    cand.reserve(lst.size());
+    for (uint32_t nb : lst) cand.push_back({H.dist(H.vec(s), H.vec(nb)), nb});
+    std::sort(cand.begin(), cand.end());
+    std::vector<uint32_t> kept;
+    hnsw_select_neighbors(H, H.vec(s), cand, cap, kept);
+    lst = std::move(kept);
+}
+
+uint32_t hnsw_insert(HnswIndex& H, const float* v) {
+    uint32_t slot;
+    bool reused = false;
+    if (!H.freelist.empty()) {
+        // hnswlib-style update-in-place: the tombstone's old links are
+        // KEPT (they may be the only bridges through its neighborhood —
+        // clearing them measurably disconnects the graph under churn)
+        // and the fresh links from the normal insert procedure are
+        // merged in below, with pruning gradually retiring the
+        // wrong-distance old edges.
+        slot = H.freelist.back();
+        H.freelist.pop_back();
+        reused = !H.links[slot].empty();
+        std::copy(v, v + H.dim, H.vecs.begin() + (size_t)slot * H.dim);
+        H.alive[slot] = 1;
+        if (H.entry == (int64_t)slot) {
+            // the reused slot WAS the (tombstoned) entry: the insert
+            // below must not greedy-start from the node being inserted.
+            // Re-seed the entry with the highest-level other node.
+            int64_t other = -1;
+            int best = -1;
+            for (size_t i = 0; i < H.levels.size(); i++) {
+                if (i == (size_t)slot) continue;
+                int lv = (int)H.links[i].size() - 1;
+                if (lv > best) {
+                    best = lv;
+                    other = (int64_t)i;
+                }
+            }
+            H.entry = other;
+            H.max_level = best < 0 ? -1 : best;
+        }
+    } else {
+        slot = (uint32_t)H.levels.size();
+        H.vecs.insert(H.vecs.end(), v, v + H.dim);
+        H.levels.push_back(0);
+        H.links.emplace_back();
+        H.alive.push_back(1);
+    }
+    int level = H.random_level();
+    if (reused)  // keep the inherited high-level edges reachable
+        level = std::max(level, (int)H.links[slot].size() - 1);
+    H.levels[slot] = level;
+    H.links[slot].resize(level + 1);
+    H.n_alive++;
+    if (H.entry < 0) {
+        H.entry = slot;
+        H.max_level = level;
+        return slot;
+    }
+    uint32_t cur = (uint32_t)H.entry;
+    float dcur = H.dist(v, H.vec(cur));
+    for (int l = H.max_level; l > level; l--) {
+        bool moved = true;
+        while (moved) {
+            moved = false;
+            if ((int)H.links[cur].size() <= l) break;
+            for (uint32_t nb : H.links[cur][l]) {
+                float d = H.dist(v, H.vec(nb));
+                if (d < dcur) {
+                    dcur = d;
+                    cur = nb;
+                    moved = true;
+                }
+            }
+        }
+    }
+    std::vector<DistSlot> cand;
+    std::vector<uint32_t> sel;
+    for (int l = std::min(level, H.max_level); l >= 0; l--) {
+        hnsw_search_layer(H, v, cur, H.efc, l, cand);
+        if (reused) {
+            // the node under (re)insertion is itself reachable through
+            // its inherited in/out edges — it must not self-select
+            cand.erase(std::remove_if(cand.begin(), cand.end(),
+                                      [slot](const DistSlot& c) {
+                                          return c.second == slot;
+                                      }),
+                       cand.end());
+            if (cand.empty()) continue;
+        }
+        int cap = l == 0 ? H.M0 : H.M;
+        hnsw_select_neighbors(H, v, cand, cap, sel);
+        auto& own = H.links[slot][l];
+        for (uint32_t nb : sel)
+            if (std::find(own.begin(), own.end(), nb) == own.end())
+                own.push_back(nb);
+        hnsw_prune(H, slot, l, cap);
+        for (uint32_t nb : sel) {
+            if ((int)H.links[nb].size() <= l) H.links[nb].resize(l + 1);
+            auto& lnb = H.links[nb][l];
+            if (std::find(lnb.begin(), lnb.end(), slot) == lnb.end())
+                lnb.push_back(slot);
+            hnsw_prune(H, nb, l, l == 0 ? H.M0 : H.M);
+        }
+        if (!cand.empty()) cur = cand[0].second;
+    }
+    if (level > H.max_level) {
+        H.max_level = level;
+        H.entry = slot;
+    }
+    return slot;
+}
+
+PyObject* py_hnsw_new(PyObject*, PyObject* args) {
+    // (dim, M, ef_construction, metric:int 0 ip | 1 l2sq) -> capsule
+    long long dim, M, efc, metric;
+    if (!PyArg_ParseTuple(args, "LLLL", &dim, &M, &efc, &metric))
+        return nullptr;
+    if (dim <= 0 || M < 2 || efc < M || (metric != 0 && metric != 1)) {
+        PyErr_SetString(PyExc_ValueError, "bad HNSW parameters");
+        return nullptr;
+    }
+    auto* H = new HnswIndex();
+    H->dim = (int)dim;
+    H->M = (int)M;
+    H->M0 = (int)(2 * M);
+    H->efc = (int)efc;
+    H->metric = (int)metric;
+    H->inv_log_m = 1.0 / std::log((double)M);
+    return PyCapsule_New(H, "pathway_tpu.hnsw", hnsw_capsule_free);
+}
+
+// parse a C-contiguous float32 (n, dim) buffer
+int hnsw_get_matrix(PyObject* obj, int dim, Py_buffer* view,
+                    Py_ssize_t* n_out) {
+    if (PyObject_GetBuffer(obj, view, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) < 0)
+        return -1;
+    bool f32 = view->format == nullptr || strcmp(view->format, "f") == 0;
+    if (!f32 || view->itemsize != 4 || view->len % (dim * 4) != 0) {
+        PyBuffer_Release(view);
+        PyErr_SetString(PyExc_TypeError,
+                        "expected C-contiguous float32 (n, dim) buffer");
+        return -1;
+    }
+    *n_out = view->len / (dim * 4);
+    return 0;
+}
+
+PyObject* py_hnsw_add(PyObject*, PyObject* args) {
+    // (capsule, float32 (n, dim) buffer) -> list of assigned slots
+    PyObject *cap, *buf;
+    if (!PyArg_ParseTuple(args, "OO", &cap, &buf)) return nullptr;
+    HnswIndex* H = hnsw_from_capsule(cap);
+    if (H == nullptr) return nullptr;
+    Py_buffer view;
+    Py_ssize_t n;
+    if (hnsw_get_matrix(buf, H->dim, &view, &n) < 0) return nullptr;
+    std::vector<uint32_t> slots((size_t)n);
+    const float* data = static_cast<const float*>(view.buf);
+    Py_BEGIN_ALLOW_THREADS;
+    for (Py_ssize_t i = 0; i < n; i++)
+        slots[(size_t)i] = hnsw_insert(*H, data + (size_t)i * H->dim);
+    Py_END_ALLOW_THREADS;
+    PyBuffer_Release(&view);
+    PyObject* out = PyList_New(n);
+    if (out == nullptr) return nullptr;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* v = PyLong_FromUnsignedLong(slots[(size_t)i]);
+        if (v == nullptr) {
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, i, v);
+    }
+    return out;
+}
+
+PyObject* py_hnsw_remove(PyObject*, PyObject* args) {
+    // (capsule, iterable of slots) — tombstone + slot reuse
+    PyObject *cap, *slots_obj;
+    if (!PyArg_ParseTuple(args, "OO", &cap, &slots_obj)) return nullptr;
+    HnswIndex* H = hnsw_from_capsule(cap);
+    if (H == nullptr) return nullptr;
+    PyObject* seq = PySequence_Fast(slots_obj, "hnsw_remove expects slots");
+    if (seq == nullptr) return nullptr;
+    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(seq); i++) {
+        long long s = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(seq, i));
+        if (s == -1 && PyErr_Occurred()) {
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        if (s < 0 || (size_t)s >= H->alive.size() || !H->alive[(size_t)s])
+            continue;
+        H->alive[(size_t)s] = 0;
+        H->freelist.push_back((uint32_t)s);
+        H->n_alive--;
+    }
+    Py_DECREF(seq);
+    if (H->n_alive == 0) {  // empty graph: full reset
+        H->vecs.clear();
+        H->levels.clear();
+        H->links.clear();
+        H->alive.clear();
+        H->freelist.clear();
+        H->entry = -1;
+        H->max_level = -1;
+    }
+    Py_RETURN_NONE;
+}
+
+PyObject* py_hnsw_search(PyObject*, PyObject* args) {
+    // (capsule, float32 (nq, dim) buffer, k, ef) -> list of
+    // ([slots...], [dists...]) per query; tombstones excluded
+    PyObject *cap, *buf;
+    long long k, ef;
+    if (!PyArg_ParseTuple(args, "OOLL", &cap, &buf, &k, &ef)) return nullptr;
+    HnswIndex* H = hnsw_from_capsule(cap);
+    if (H == nullptr) return nullptr;
+    Py_buffer view;
+    Py_ssize_t nq;
+    if (hnsw_get_matrix(buf, H->dim, &view, &nq) < 0) return nullptr;
+    const float* data = static_cast<const float*>(view.buf);
+    int eff_ef = (int)std::max(ef, k);
+    std::vector<std::vector<DistSlot>> results((size_t)nq);
+    Py_BEGIN_ALLOW_THREADS;
+    for (Py_ssize_t qi = 0; qi < nq; qi++) {
+        if (H->entry < 0) continue;
+        const float* q = data + (size_t)qi * H->dim;
+        uint32_t cur = (uint32_t)H->entry;
+        float dcur = H->dist(q, H->vec(cur));
+        for (int l = H->max_level; l > 0; l--) {
+            bool moved = true;
+            while (moved) {
+                moved = false;
+                if ((int)H->links[cur].size() <= l) break;
+                for (uint32_t nb : H->links[cur][l]) {
+                    float d = H->dist(q, H->vec(nb));
+                    if (d < dcur) {
+                        dcur = d;
+                        cur = nb;
+                        moved = true;
+                    }
+                }
+            }
+        }
+        std::vector<DistSlot> found;
+        // tombstones participate in traversal but not in results; a
+        // bounded slack absorbs light churn, and the Python wrapper
+        // retries with a larger ef if survivors run short
+        int fetch = eff_ef + std::min((int)(H->alive.size() - H->n_alive),
+                                      eff_ef);
+        if (fetch > (int)H->levels.size()) fetch = (int)H->levels.size();
+        hnsw_search_layer(*H, q, cur, fetch, 0, found);
+        auto& out = results[(size_t)qi];
+        for (const auto& ds : found) {
+            if (!H->alive[ds.second]) continue;
+            out.push_back(ds);
+            if ((int)out.size() >= k) break;
+        }
+    }
+    Py_END_ALLOW_THREADS;
+    PyBuffer_Release(&view);
+    PyObject* out = PyList_New(nq);
+    if (out == nullptr) return nullptr;
+    for (Py_ssize_t qi = 0; qi < nq; qi++) {
+        const auto& r = results[(size_t)qi];
+        PyObject* ids = PyList_New((Py_ssize_t)r.size());
+        PyObject* ds = PyList_New((Py_ssize_t)r.size());
+        PyObject* pair = (ids && ds) ? PyTuple_Pack(2, ids, ds) : nullptr;
+        Py_XDECREF(ids);
+        Py_XDECREF(ds);
+        if (pair == nullptr) {
+            Py_DECREF(out);
+            return nullptr;
+        }
+        for (size_t j = 0; j < r.size(); j++) {
+            PyObject* i_ = PyLong_FromUnsignedLong(r[j].second);
+            PyObject* d_ = PyFloat_FromDouble((double)r[j].first);
+            if (i_ == nullptr || d_ == nullptr) {
+                Py_XDECREF(i_);
+                Py_XDECREF(d_);
+                Py_DECREF(pair);
+                Py_DECREF(out);
+                return nullptr;
+            }
+            PyList_SET_ITEM(ids, (Py_ssize_t)j, i_);
+            PyList_SET_ITEM(ds, (Py_ssize_t)j, d_);
+        }
+        PyList_SET_ITEM(out, qi, pair);
+    }
+    return out;
+}
+
+PyObject* py_hnsw_len(PyObject*, PyObject* cap) {
+    HnswIndex* H = hnsw_from_capsule(cap);
+    if (H == nullptr) return nullptr;
+    return PyLong_FromSize_t(H->n_alive);
+}
+
 PyMethodDef kMethods[] = {
     {"ref_scalar", py_ref_scalar, METH_VARARGS,
      "128-bit key hash of the argument values"},
@@ -3116,6 +3629,15 @@ PyMethodDef kMethods[] = {
      "keep updates whose VM predicate result is truthy"},
     {"join_process", py_join_process, METH_VARARGS,
      "full incremental equi-join epoch pass over dict arrangements"},
+    {"hnsw_new", py_hnsw_new, METH_VARARGS,
+     "create an HNSW graph ANN index (dim, M, ef_construction, metric)"},
+    {"hnsw_add", py_hnsw_add, METH_VARARGS,
+     "bulk-insert float32 rows; returns assigned slots"},
+    {"hnsw_remove", py_hnsw_remove, METH_VARARGS,
+     "tombstone slots (freed for reuse)"},
+    {"hnsw_search", py_hnsw_search, METH_VARARGS,
+     "batch ANN search: (slots, distances) per query"},
+    {"hnsw_len", py_hnsw_len, METH_O, "live item count"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef kModule = {PyModuleDef_HEAD_INIT, "pathway_native",

@@ -30,7 +30,8 @@ _KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "as", "join",
     "inner", "left", "right", "outer", "on", "and", "or", "not", "union",
     "all", "distinct", "with", "in", "between", "like", "is", "null",
-    "case", "when", "then", "else", "end", "true", "false",
+    "case", "when", "then", "else", "end", "true", "false", "intersect",
+    "except",
 }
 
 _AGGS = {"sum", "count", "avg", "min", "max"}
@@ -117,6 +118,11 @@ class _Parser:
         if self.at_kw("in"):
             self.eat()
             self.eat("op", "(")
+            if self.at_kw("select"):
+                # IN (SELECT ...) — semi/anti-join subquery
+                sub = self.select()
+                self.eat("op", ")")
+                return ("in_sub", left, sub, negated)
             vals = [self.expr()]
             while self.peek() == ("op", ","):
                 self.eat()
@@ -241,17 +247,26 @@ class _Parser:
                     self.eat()
                     continue
                 break
-        first = self.select()
-        unions = []
-        while self.at_kw("union"):
-            self.eat()
+        # set-op chain; INTERSECT binds tighter than UNION/EXCEPT (SQL
+        # standard precedence), so parse intersect-chains as units
+        def intersect_chain() -> dict | tuple:
+            node: dict | tuple = self.select()
+            while self.at_kw("intersect"):
+                self.eat()
+                node = ("intersect", node, self.select())
+            return node
+
+        first = intersect_chain()
+        setops = []
+        while self.at_kw("union", "except"):
+            op = self.eat()
             all_ = False
-            if self.at_kw("all"):
+            if op == "union" and self.at_kw("all"):
                 self.eat()
                 all_ = True
-            unions.append((all_, self.select()))
+            setops.append((op, all_, intersect_chain()))
         self.eat("end")
-        return {"ctes": ctes, "select": first, "unions": unions}
+        return {"ctes": ctes, "select": first, "setops": setops}
 
     def select(self) -> dict:
         self.eat("kw", "select")
@@ -451,6 +466,96 @@ def _distinct(table: Table) -> Table:
     )
 
 
+def _positional_align(left: Table, right: Table) -> Table:
+    """Rename ``right``'s columns to ``left``'s, positionally (set ops
+    match columns by position, like the reference's SQLGlot translation)."""
+    if len(right._column_names) != len(left._column_names):
+        raise ValueError("set-operation arms must have the same column count")
+    renames = {
+        ln: right[rn]
+        for ln, rn in zip(left._column_names, right._column_names)
+    }
+    return right.select(**renames)
+
+
+def _setop(left: Table, right: Table, op: str) -> Table:
+    """Value-based INTERSECT / EXCEPT with SQL set semantics.
+
+    Implemented as a tagged concat + groupby over all columns rather than
+    a join, so NULL cells compare equal (SQL set ops use IS NOT DISTINCT
+    FROM semantics, unlike joins) and the result is deduplicated."""
+    import pathway_tpu as pw
+
+    right = _positional_align(left, right)
+    cols = left._column_names
+    a = left.select(*[left[c] for c in cols], _pw_l=1, _pw_r=0)
+    b = right.select(*[right[c] for c in cols], _pw_l=0, _pw_r=1)
+    u = a.concat_reindex(b)
+    g = u.groupby(*[u[c] for c in cols]).reduce(
+        *[u[c] for c in cols],
+        _pw_l=pw.reducers.sum(u["_pw_l"]),
+        _pw_r=pw.reducers.sum(u["_pw_r"]),
+    )
+    if op == "intersect":
+        kept = g.filter((g["_pw_l"] > 0) & (g["_pw_r"] > 0))
+    else:  # except
+        kept = g.filter((g["_pw_l"] > 0) & (g["_pw_r"] == 0))
+    return kept.select(**{c: kept[c] for c in cols})
+
+
+def _split_conjuncts(ast) -> list:
+    if isinstance(ast, tuple) and ast[0] == "and":
+        return _split_conjuncts(ast[1]) + _split_conjuncts(ast[2])
+    return [ast]
+
+
+def _contains_in_sub(ast) -> bool:
+    if not isinstance(ast, tuple):
+        return False
+    if ast[0] == "in_sub":
+        return True
+    return any(
+        _contains_in_sub(c) for c in ast[1:] if isinstance(c, (tuple, list))
+    )
+
+
+def _apply_in_subquery(
+    tr: "_Translator", scope: Table, node: tuple, tables: dict[str, Table]
+) -> Table:
+    """WHERE x [NOT] IN (SELECT c FROM ...) as a semi/anti-join.
+
+    The subquery is deduplicated first, so the semi-join never duplicates
+    scope rows.  NULL handling: a NULL probe value never matches (IN drops
+    it; NOT IN drops it too, per SQL three-valued logic); NULL values
+    *inside* the subquery are treated as non-matching values — stricter
+    standard semantics would make NOT IN empty whenever the subquery
+    contains a NULL, which is almost never what a query means."""
+    import pathway_tpu as pw
+
+    _tag, left_ast, sub_ast, negated = node
+    sub = _translate_select(sub_ast, tables)
+    if len(sub._column_names) != 1:
+        raise ValueError("IN (SELECT ...) must select exactly one column")
+    sc = sub._column_names[0]
+    subd = _distinct(sub)
+    marked = subd.select(_pw_in_val=subd[sc], _pw_m=1)
+    lexpr = _wrap(tr.to_expr(left_ast, scope))
+    cols = scope._column_names
+    if negated:
+        # NULL probes drop first (NULL NOT IN (...) is NULL in SQL);
+        # the anti-join then keeps rows with no subquery match
+        non_null = scope.filter(~lexpr.is_none())
+        j = non_null.join_left(marked, lexpr == marked["_pw_in_val"])
+        j2 = j.select(
+            **{c: pw.left[c] for c in cols}, _pw_m=pw.right["_pw_m"]
+        )
+        kept = j2.filter(j2["_pw_m"].is_none())
+    else:
+        j = scope.join(marked, lexpr == marked["_pw_in_val"])
+        kept = j.select(**{c: pw.left[c] for c in cols})
+    return kept.select(**{c: kept[c] for c in cols})
+
+
 def sql(query: str, **tables: Table) -> Table:
     """Run a SQL query against keyword-named tables::
 
@@ -458,27 +563,36 @@ def sql(query: str, **tables: Table) -> Table:
 
     Supported: SELECT [DISTINCT] expressions/aliases/*, FROM (incl.
     derived-table subqueries), WITH ctes, INNER/LEFT/RIGHT/OUTER JOIN ON
-    equality, WHERE, GROUP BY, HAVING, UNION [ALL], IN / BETWEEN / LIKE /
-    IS [NOT] NULL / CASE WHEN, and SUM/COUNT/AVG/MIN/MAX.
+    equality, WHERE (incl. ``[NOT] IN (SELECT ...)`` semi/anti-join
+    conjuncts), GROUP BY, HAVING, UNION [ALL], INTERSECT, EXCEPT,
+    IN / BETWEEN / LIKE / IS [NOT] NULL / CASE WHEN, and
+    SUM/COUNT/AVG/MIN/MAX.
     """
     stmt = _Parser(_tokenize(query)).statement()
     env = dict(tables)
     for name, sub_ast in stmt["ctes"]:
         env[name] = _translate_select(sub_ast, env)
-    result = _translate_select(stmt["select"], env)
-    for all_, sub_ast in stmt["unions"]:
-        other = _translate_select(sub_ast, env)
-        if len(other._column_names) != len(result._column_names):
-            raise ValueError("UNION arms must have the same column count")
-        # positional column matching, then key-disjoint concat
-        renames = {
-            ln: other[rn]
-            for ln, rn in zip(result._column_names, other._column_names)
-        }
-        result = result.concat_reindex(other.select(**renames))
-        if not all_:
-            result = _distinct(result)
+    result = _translate_set(stmt["select"], env)
+    for op, all_, node in stmt["setops"]:
+        other = _translate_set(node, env)
+        if op == "union":
+            result = result.concat_reindex(_positional_align(result, other))
+            if not all_:
+                result = _distinct(result)
+        else:  # except
+            result = _setop(result, other, "except")
     return result
+
+
+def _translate_set(node: Any, tables: dict[str, Table]) -> Table:
+    """An intersect-chain unit: a plain select dict or ("intersect", l, r)."""
+    if isinstance(node, tuple) and node[0] == "intersect":
+        return _setop(
+            _translate_set(node[1], tables),
+            _translate_set(node[2], tables),
+            "intersect",
+        )
+    return _translate_select(node, tables)
 
 
 def _translate_select(ast: dict, tables: dict[str, Table]) -> Table:
@@ -520,7 +634,24 @@ def _translate_select(ast: dict, tables: dict[str, Table]) -> Table:
         scope = jr.select(**seen)
 
     if ast["where"] is not None:
-        scope = scope.filter(_wrap(tr.to_expr(ast["where"], scope)))
+        # [NOT] IN (SELECT ...) conjuncts become semi/anti-joins; the
+        # remaining conjuncts recombine into one ordinary filter
+        plain: list = []
+        for conj in _split_conjuncts(ast["where"]):
+            if isinstance(conj, tuple) and conj[0] == "in_sub":
+                scope = _apply_in_subquery(tr, scope, conj, tables)
+            elif _contains_in_sub(conj):
+                raise ValueError(
+                    "IN (SELECT ...) is only supported as a top-level "
+                    "WHERE conjunct"
+                )
+            else:
+                plain.append(conj)
+        if plain:
+            combined = plain[0]
+            for conj in plain[1:]:
+                combined = ("and", combined, conj)
+            scope = scope.filter(_wrap(tr.to_expr(combined, scope)))
 
     items = ast["items"]
     if ast["group_by"]:

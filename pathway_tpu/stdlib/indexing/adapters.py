@@ -18,7 +18,13 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
-__all__ = ["KnnAdapter", "IvfAdapter", "BM25Adapter", "HybridAdapter"]
+__all__ = [
+    "KnnAdapter",
+    "IvfAdapter",
+    "HnswAdapter",
+    "BM25Adapter",
+    "HybridAdapter",
+]
 
 _OVERFETCH = 4
 
@@ -89,6 +95,34 @@ class KnnAdapter:
                 reply = [(key, s) for key, s in reply if f(self.meta.get(key) or {})]
             out.append(reply[: k[qi]])
         return out
+
+
+class HnswAdapter(KnnAdapter):
+    """(key, vector) index over the host HNSW graph
+    (:class:`~pathway_tpu.stdlib.indexing.hnsw.HnswIndex`), the
+    reference's usearch role (``usearch_integration.rs``).  Same contract
+    and metadata-filter flow as :class:`KnnAdapter`."""
+
+    def __init__(
+        self,
+        dim: int,
+        *,
+        metric: str = "cos",
+        M: int = 16,
+        ef_construction: int = 128,
+        ef_search: int = 64,
+        **_ignored: Any,
+    ):
+        from pathway_tpu.stdlib.indexing.hnsw import HnswIndex
+
+        self.index = HnswIndex(
+            dim,
+            metric=metric,
+            M=M,
+            ef_construction=ef_construction,
+            ef_search=ef_search,
+        )
+        self.meta: dict[Any, dict | None] = {}
 
 
 class BM25Adapter:

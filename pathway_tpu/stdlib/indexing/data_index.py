@@ -103,13 +103,14 @@ class BruteForceKnn(InnerIndex):
 
 class UsearchKnn(BruteForceKnn):
     """Approximate KNN (reference ``USearchKnn`` fronting an HNSW,
-    ``src/external_integration/usearch_integration.rs``).  TPU re-design:
-    an IVF-flat index (:class:`pathway_tpu.parallel.IvfKnnIndex`) —
-    k-means cells in HBM, query = centroid matmul -> gather nprobe cells
-    -> einsum + top-k, scanning ``nprobe/nlist`` of the corpus instead of
-    all of it (HNSW's pointer-chasing walk is hostile to XLA).  ``l2sq``
-    falls back to the exact brute-force index (IVF cells here are inner-
-    product trained)."""
+    ``src/external_integration/usearch_integration.rs``).  Backed by the
+    native host HNSW graph (``native/pathway_native.cpp`` ``hnsw_*`` via
+    :class:`~pathway_tpu.stdlib.indexing.hnsw.HnswIndex`) — the graph
+    walk is pointer-chasing, so like the reference it runs on the host,
+    not on the TPU.  Pass ``nlist``/``nprobe`` to choose the TPU-resident
+    IVF-flat alternative instead (:class:`pathway_tpu.parallel.IvfKnnIndex`:
+    k-means cells in HBM, centroid matmul -> gather -> einsum + top-k),
+    which trades a little recall for device-side batch throughput."""
 
     def __init__(
         self,
@@ -123,6 +124,9 @@ class UsearchKnn(BruteForceKnn):
         dtype: Any = None,
         nlist: int | None = None,
         nprobe: int | None = None,
+        M: int = 16,
+        ef_construction: int = 128,
+        ef_search: int = 64,
     ):
         super().__init__(
             data_column,
@@ -135,30 +139,43 @@ class UsearchKnn(BruteForceKnn):
         )
         self.nlist = nlist
         self.nprobe = nprobe
+        self.M = M
+        self.ef_construction = ef_construction
+        self.ef_search = ef_search
 
     def make_adapter(self) -> Any:
-        if self.metric == BruteForceKnnMetricKind.L2SQ:
-            return super().make_adapter()  # exact fallback
         if self.mesh is not None:
-            # IVF is single-device; a mesh caller sized reserved_space for
-            # the aggregate HBM of all chips — give them the SHARDED exact
-            # index rather than silently dropping the mesh
+            # HNSW/IVF are single-host; a mesh caller sized reserved_space
+            # for the aggregate HBM of all chips — give them the SHARDED
+            # exact index rather than silently dropping the mesh
             import logging
 
             logging.getLogger("pathway_tpu").info(
                 "UsearchKnn: mesh given -> using the mesh-sharded exact "
-                "brute-force index (IVF cells are single-device)"
+                "brute-force index (graph/IVF ANN is single-host)"
             )
             return super().make_adapter()
-        from pathway_tpu.stdlib.indexing.adapters import IvfAdapter
+        if self.nlist is not None or self.nprobe is not None:
+            if self.metric == BruteForceKnnMetricKind.L2SQ:
+                return super().make_adapter()  # IVF cells are ip-trained
+            from pathway_tpu.stdlib.indexing.adapters import IvfAdapter
 
-        return IvfAdapter(
+            return IvfAdapter(
+                self.dimensions,
+                metric=self.metric,
+                capacity=self.reserved_space,
+                dtype=self.dtype,
+                nlist=self.nlist,
+                nprobe=self.nprobe,
+            )
+        from pathway_tpu.stdlib.indexing.adapters import HnswAdapter
+
+        return HnswAdapter(
             self.dimensions,
             metric=self.metric,
-            capacity=self.reserved_space,
-            dtype=self.dtype,
-            nlist=self.nlist,
-            nprobe=self.nprobe,
+            M=self.M,
+            ef_construction=self.ef_construction,
+            ef_search=self.ef_search,
         )
 
 

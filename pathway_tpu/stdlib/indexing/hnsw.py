@@ -1,0 +1,149 @@
+"""Host HNSW graph ANN index (the reference's usearch role,
+``src/external_integration/usearch_integration.rs:1-163``).
+
+The graph walk is pointer-chasing — hostile to XLA — so like the
+reference this index lives on the host: the C++ implementation in
+``native/pathway_native.cpp`` (``hnsw_*``), fronted here by a key-mapped
+wrapper with the same ``(key, vector)`` contract as
+:class:`~pathway_tpu.parallel.ShardedKnnIndex`.  Without the native
+module it degrades to exact brute force (numpy), which is slower but
+identical in results.
+
+Scores follow the repo convention (higher = closer): ``cos``/``dot``
+return the inner product; ``l2sq`` the negated squared distance.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from pathway_tpu.internals import native as _native
+
+__all__ = ["HnswIndex"]
+
+
+class HnswIndex:
+    """(key, vector) ANN index with live add/remove."""
+
+    def __init__(
+        self,
+        dim: int,
+        *,
+        metric: str = "cos",
+        M: int = 16,
+        ef_construction: int = 128,
+        ef_search: int = 64,
+    ):
+        if metric not in ("cos", "dot", "l2sq"):
+            raise ValueError(f"unknown metric {metric!r}")
+        self.dim = dim
+        self.metric = metric
+        self.M = M
+        self.ef_construction = ef_construction
+        self.ef_search = ef_search
+        self._slot_of: dict[Any, int] = {}
+        self._key_of: dict[int, Any] = {}
+        native = _native.load()
+        if native is not None and hasattr(native, "hnsw_new"):
+            self._native = native
+            self._h = native.hnsw_new(
+                dim, M, ef_construction, 1 if metric == "l2sq" else 0
+            )
+        else:  # exact fallback: same results, no graph
+            self._native = None
+            self._vecs: dict[Any, np.ndarray] = {}
+
+    def __len__(self) -> int:
+        if self._native is None:
+            return len(self._vecs)
+        return self._native.hnsw_len(self._h)
+
+    def _prep(self, vecs: np.ndarray) -> np.ndarray:
+        vecs = np.ascontiguousarray(vecs, np.float32)
+        if self.metric == "cos":
+            norms = np.linalg.norm(vecs, axis=-1, keepdims=True)
+            vecs = vecs / np.maximum(norms, 1e-12)
+        return vecs
+
+    def add(self, items: Sequence[tuple[Any, Any]]) -> None:
+        if not items:
+            return
+        # re-adding a key replaces its vector
+        stale = [k for k, _ in items if k in self._slot_of]
+        if stale:
+            self.remove(stale)
+        keys = [k for k, _ in items]
+        mat = self._prep(np.stack([np.asarray(v, np.float32) for _, v in items]))
+        if self._native is None:
+            for key, row in zip(keys, mat):
+                self._vecs[key] = row
+            return
+        slots = self._native.hnsw_add(self._h, mat)
+        for key, slot in zip(keys, slots):
+            self._slot_of[key] = slot
+            self._key_of[slot] = key
+
+    def remove(self, keys: Sequence[Any]) -> None:
+        if self._native is None:
+            for k in keys:
+                self._vecs.pop(k, None)
+            return
+        slots = []
+        for k in keys:
+            s = self._slot_of.pop(k, None)
+            if s is not None:
+                self._key_of.pop(s, None)
+                slots.append(s)
+        if slots:
+            self._native.hnsw_remove(self._h, slots)
+
+    def search(
+        self, queries: np.ndarray, k: int
+    ) -> list[list[tuple[Any, float]]]:
+        """Top-k per query as [(key, score), ...], score higher = closer."""
+        queries = self._prep(np.atleast_2d(np.asarray(queries, np.float32)))
+        n = len(self)
+        if n == 0:
+            return [[] for _ in range(queries.shape[0])]
+        k = min(k, n)
+        if self._native is None:
+            return self._search_exact(queries, k)
+        ef = max(self.ef_search, k)
+        raw = self._native.hnsw_search(self._h, queries, k, ef)
+        # adaptive retry: heavy tombstone churn can starve survivors
+        while any(len(ids) < k for ids, _ in raw) and ef < 4 * n:
+            ef *= 4
+            raw = self._native.hnsw_search(self._h, queries, k, ef)
+        out: list[list[tuple[Any, float]]] = []
+        for ids, dists in raw:
+            # native distance is -dot (ip) or l2sq; both negate into the
+            # higher-is-closer score convention
+            out.append(
+                [
+                    (self._key_of[s], -d)
+                    for s, d in zip(ids, dists)
+                    if s in self._key_of
+                ]
+            )
+        return out
+
+    def _search_exact(self, q: np.ndarray, k: int) -> list[list[tuple[Any, float]]]:
+        keys = list(self._vecs.keys())
+        mat = np.stack([self._vecs[key] for key in keys])
+        if self.metric == "l2sq":
+            scores = -(
+                ((q[:, None, :] - mat[None, :, :]) ** 2).sum(-1)
+            )
+        else:
+            scores = q @ mat.T
+        out = []
+        for row in scores:
+            top = np.argsort(-row)[:k]
+            out.append([(keys[i], float(row[i])) for i in top])
+        return out
+
+# NOTE: no state_dict — external-index adapters are rebuilt from replayed
+# input on recovery (engine/external_index.py keeps docs in operator
+# state; the adapter is reconstructed, never pickled).

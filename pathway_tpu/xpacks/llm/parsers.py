@@ -18,6 +18,8 @@ __all__ = [
     "Utf8Parser",
     "ParseUnstructured",
     "UnstructuredParser",
+    "ParseHtml",
+    "ParseDocx",
     "PypdfParser",
     "ImageParser",
     "SlideParser",
@@ -56,24 +58,115 @@ class _GatedParser(UDF):
         self._kwargs = kwargs
 
 
-class ParseUnstructured(_GatedParser):
-    """reference ``parsers.py:79`` (unstructured-io)"""
+class ParseUnstructured(UDF):
+    """Auto-format document partitioner (reference ``parsers.py:79``).
 
-    _pkg = "unstructured"
+    Uses the ``unstructured`` package when installed; otherwise falls
+    back to the built-in extractors (content-sniffed): PDF via
+    ``_pdf.extract_pdf_text``, DOCX and HTML via ``_doc`` (stdlib
+    zipfile/xml/html.parser — no dependencies), anything else UTF-8.
+    ``mode="single"`` joins everything into one chunk; ``"elements"``
+    yields one chunk per block with ``category`` metadata (Title /
+    NarrativeText / ListItem / Table, the unstructured vocabulary);
+    ``"paged"`` joins per page (PDF) or per document (other formats).
+    """
+
+    def __init__(self, mode: str = "single", **kwargs: Any):
+        super().__init__()
+        if mode not in ("single", "elements", "paged"):
+            raise ValueError(f"invalid mode {mode!r}")
+        self.mode = mode
+        self._kwargs = kwargs
+
+    def _partition_builtin(self, contents: bytes) -> list[tuple[str, dict]]:
+        from pathway_tpu.xpacks.llm import _doc
+
+        fmt = _doc.sniff_format(contents)
+        if fmt == "pdf":
+            from pathway_tpu.xpacks.llm._pdf import extract_pdf_text
+
+            return [
+                (t, {"category": "NarrativeText", "page_number": i})
+                for i, t in enumerate(extract_pdf_text(contents))
+                if t.strip()
+            ]
+        if fmt == "docx":
+            return _doc.extract_docx_blocks(contents)
+        if fmt == "html":
+            return _doc.extract_html_blocks(contents)
+        text = contents.decode("utf-8", errors="replace")
+        return [(text, {"category": "NarrativeText"})] if text.strip() else []
 
     def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
-        import io
+        if isinstance(contents, str):
+            contents = contents.encode()
+        try:
+            import io
 
-        from unstructured.partition.auto import partition
+            from unstructured.partition.auto import partition
 
-        elements = partition(file=io.BytesIO(contents))
-        mode = self._kwargs.get("mode", "single")
-        if mode == "elements":
-            return [(str(e), {"category": getattr(e, "category", None)}) for e in elements]
-        return [("\n\n".join(str(e) for e in elements), {})]
+            elements: list[tuple[str, dict]] = []
+            for e in partition(file=io.BytesIO(contents)):
+                emeta = getattr(e, "metadata", None)
+                meta = {"category": getattr(e, "category", None)}
+                page = getattr(emeta, "page_number", None)
+                if page is not None:  # paged mode groups by this
+                    meta["page_number"] = page
+                elements.append((str(e), meta))
+        except ImportError:
+            elements = self._partition_builtin(contents)
+        if self.mode == "elements":
+            return elements
+        if self.mode == "paged":
+            pages: dict[Any, list[str]] = {}
+            for text, meta in elements:
+                pages.setdefault(meta.get("page_number", 0), []).append(text)
+            return [
+                ("\n\n".join(parts), {"page_number": pg})
+                for pg, parts in sorted(pages.items())
+            ]
+        return [("\n\n".join(t for t, _ in elements), {})] if elements else []
 
 
 UnstructuredParser = ParseUnstructured
+
+
+class ParseHtml(UDF):
+    """Built-in HTML parser: block elements with category metadata
+    (``_doc.extract_html_blocks``); ``mode="single"`` joins blocks."""
+
+    def __init__(self, mode: str = "single", **kwargs: Any):
+        super().__init__()
+        if mode not in ("single", "elements"):
+            raise ValueError(f"invalid mode {mode!r}")
+        self.mode = mode
+
+    def __wrapped__(self, contents: Any, **kwargs: Any) -> list[tuple[str, dict]]:
+        from pathway_tpu.xpacks.llm._doc import extract_html_blocks
+
+        blocks = extract_html_blocks(contents)
+        if self.mode == "elements":
+            return blocks
+        return [("\n\n".join(t for t, _ in blocks), {})] if blocks else []
+
+
+class ParseDocx(UDF):
+    """Built-in DOCX parser: WordprocessingML paragraphs/tables with
+    category metadata (``_doc.extract_docx_blocks``)."""
+
+    def __init__(self, mode: str = "single", **kwargs: Any):
+        super().__init__()
+        if mode not in ("single", "elements"):
+            raise ValueError(f"invalid mode {mode!r}")
+        self.mode = mode
+
+    def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
+        from pathway_tpu.xpacks.llm._doc import extract_docx_blocks
+
+        blocks = extract_docx_blocks(contents)
+        if self.mode == "elements":
+            return blocks
+        return [("\n\n".join(t for t, _ in blocks), {})] if blocks else []
 
 
 class PypdfParser(UDF):

@@ -40,6 +40,75 @@ class BaseRestServer:
         )
         writer(handler(queries))
 
+    def serve_callable(
+        self,
+        route: str,
+        schema: Any = None,
+        callable_func: Callable | None = None,
+        retry_strategy: Any = None,
+        cache_strategy: Any = None,
+        **additional_endpoint_kwargs: Any,
+    ) -> Callable:
+        """Expose an arbitrary Python callable (sync or async) as a REST
+        endpoint (reference ``xpacks/llm/servers.py:227-272``).
+
+        Each request row runs through an :class:`AsyncTransformer`, so a
+        slow or async callable never blocks the engine loop; the HTTP
+        response is the callable's return value.  When ``schema`` is
+        omitted it is inferred from the callable's argument names (each
+        argument becomes a JSON-typed request field).  Usable directly or
+        as a decorator::
+
+            @server.serve_callable("/v1/my_fn")
+            async def my_fn(query: str): ...
+        """
+        from pathway_tpu.internals.json import Json
+        from pathway_tpu.stdlib.utils.async_transformer import (
+            AsyncTransformer,
+            coerce_async,
+        )
+
+        def decorator(fn: Callable) -> Callable:
+            use_schema = schema
+            if use_schema is None:
+                import inspect
+
+                names = [
+                    p.name
+                    for p in inspect.signature(fn).parameters.values()
+                    if p.kind
+                    in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+                ]
+                use_schema = pw.schema_from_types(**{n: object for n in names})
+            async_fn = coerce_async(fn)
+
+            class FuncAsyncTransformer(AsyncTransformer):
+                output_schema = pw.schema_from_types(result=object)
+
+                async def invoke(self, **kwargs: Any) -> dict:
+                    kwargs = {
+                        k: (v.value if isinstance(v, Json) else v)
+                        for k, v in kwargs.items()
+                    }
+                    return {"result": await async_fn(**kwargs)}
+
+            def handler(table: Table) -> Table:
+                return (
+                    FuncAsyncTransformer(input_table=table)
+                    .with_options(
+                        retry_strategy=retry_strategy,
+                        cache_strategy=cache_strategy,
+                    )
+                    .successful
+                )
+
+            self.serve(route, use_schema, handler, **additional_endpoint_kwargs)
+            return fn
+
+        if callable_func is None:
+            return decorator
+        return decorator(callable_func)
+
     def run(
         self,
         threaded: bool = False,

@@ -362,6 +362,22 @@ def test_vm_rejects_malformed_programs(native):
         native.vm_compile([expr_vm.OP_CALL_PY, 0], (), ())
     with pytest.raises(ValueError):
         native.vm_compile([99], (), ())
+    # stack discipline: underflow and wrong exit depth must be rejected
+    with pytest.raises(ValueError):
+        native.vm_compile([expr_vm.OP_BIN, 0], (), ())  # pops empty stack
+    with pytest.raises(ValueError):
+        native.vm_compile([expr_vm.OP_POP], (), ())
+    with pytest.raises(ValueError):
+        native.vm_compile(
+            [expr_vm.OP_LOAD_KEY, expr_vm.OP_LOAD_KEY], (), ()
+        )  # exits with depth 2
+    with pytest.raises(ValueError):
+        native.vm_compile(
+            [expr_vm.OP_LOAD_KEY, expr_vm.OP_MAKE_TUPLE, 2], (), ()
+        )  # MAKE_TUPLE deeper than stack
+    with pytest.raises(ValueError):
+        # jump into the middle of an instruction's operands
+        native.vm_compile([expr_vm.OP_JUMP, 3, expr_vm.OP_LOAD_COL, 0], (), ())
 
 
 def test_end_to_end_pipeline_matches_disable_native():

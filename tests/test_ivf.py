@@ -85,8 +85,10 @@ def test_ivf_grow_cells():
     assert len(idx) == 3001
 
 
-def test_usearch_factory_uses_ivf():
-    from pathway_tpu.stdlib.indexing.adapters import IvfAdapter
+def test_usearch_factory_dispatch():
+    """Default UsearchKnn is the native HNSW graph (the reference's
+    usearch role); nlist/nprobe opt into the TPU-resident IVF."""
+    from pathway_tpu.stdlib.indexing.adapters import HnswAdapter, IvfAdapter
     from pathway_tpu.stdlib.indexing.data_index import UsearchKnn
 
     import pathway_tpu as pw
@@ -96,13 +98,14 @@ def test_usearch_factory_uses_ivf():
 
     t = pw.debug.table_from_rows(S, [(1, ([1.0, 0.0],))])
     knn = UsearchKnn(t.v, dimensions=2, reserved_space=64)
-    adapter = knn.make_adapter()
-    assert isinstance(adapter, IvfAdapter)
+    assert isinstance(knn.make_adapter(), HnswAdapter)
 
-    # l2sq falls back to the exact brute-force adapter
+    ivf = UsearchKnn(t.v, dimensions=2, reserved_space=64, nlist=4, nprobe=2)
+    assert isinstance(ivf.make_adapter(), IvfAdapter)
+
+    # l2sq is native to the HNSW graph
     knn2 = UsearchKnn(t.v, dimensions=2, reserved_space=64, metric="l2sq")
-    a2 = knn2.make_adapter()
-    assert not isinstance(a2, IvfAdapter)
+    assert isinstance(knn2.make_adapter(), HnswAdapter)
 
 
 def test_ivf_state_roundtrip():

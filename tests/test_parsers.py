@@ -97,3 +97,99 @@ def test_extract_nested_parens_tj_brackets_hex_quote():
 def test_parse_utf8():
     out = ParseUtf8().__wrapped__("héllo".encode())
     assert out[0][0] == "héllo"
+
+
+# ---------------------------------------------------------------------------
+# built-in HTML / DOCX extraction (_doc.py)
+
+
+def _minimal_docx() -> bytes:
+    """A structurally valid DOCX (zip of WordprocessingML)."""
+    import io
+    import zipfile
+
+    W = "http://schemas.openxmlformats.org/wordprocessingml/2006/main"
+    document = f"""<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<w:document xmlns:w="{W}"><w:body>
+<w:p><w:pPr><w:pStyle w:val="Heading1"/></w:pPr><w:r><w:t>Quarterly Report</w:t></w:r></w:p>
+<w:p><w:r><w:t>Revenue grew by </w:t></w:r><w:r><w:t>ten percent.</w:t></w:r></w:p>
+<w:p><w:pPr><w:numPr><w:ilvl w:val="0"/></w:numPr></w:pPr><w:r><w:t>first item</w:t></w:r></w:p>
+<w:tbl><w:tr><w:tc><w:p><w:r><w:t>Region</w:t></w:r></w:p></w:tc>
+<w:tc><w:p><w:r><w:t>Sales</w:t></w:r></w:p></w:tc></w:tr>
+<w:tr><w:tc><w:p><w:r><w:t>EMEA</w:t></w:r></w:p></w:tc>
+<w:tc><w:p><w:r><w:t>120</w:t></w:r></w:p></w:tc></w:tr></w:tbl>
+</w:body></w:document>"""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr(
+            "[Content_Types].xml",
+            '<?xml version="1.0"?><Types xmlns="http://schemas.openxmlformats.org/package/2006/content-types"/>',
+        )
+        zf.writestr("word/document.xml", document)
+    return buf.getvalue()
+
+
+_HTML = b"""<!DOCTYPE html><html><head><title>Fruit Guide</title>
+<style>body { color: red }</style><script>var x = 1;</script></head>
+<body><h1>All About Fruit</h1>
+<p>Apples grow on trees.</p>
+<ul><li>sweet</li><li>crunchy</li></ul>
+<table><tr><th>Name</th><th>Color</th></tr>
+<tr><td>banana</td><td>yellow</td></tr></table>
+</body></html>"""
+
+
+def test_extract_html_blocks_categories():
+    from pathway_tpu.xpacks.llm._doc import extract_html_blocks
+
+    blocks = extract_html_blocks(_HTML)
+    cats = {t: m["category"] for t, m in blocks}
+    assert cats["All About Fruit"] == "Title"
+    assert cats["Apples grow on trees."] == "NarrativeText"
+    assert cats["sweet"] == "ListItem"
+    table = [t for t, m in blocks if m["category"] == "Table"]
+    assert len(table) == 1 and "banana" in table[0] and "yellow" in table[0]
+    # script/style never leak into text
+    assert not any("var x" in t or "color: red" in t for t, _ in blocks)
+    assert all(m.get("page_title") == "Fruit Guide" for _, m in blocks)
+
+
+def test_extract_docx_blocks_categories():
+    from pathway_tpu.xpacks.llm._doc import extract_docx_blocks
+
+    blocks = extract_docx_blocks(_minimal_docx())
+    cats = {t: m["category"] for t, m in blocks}
+    assert cats["Quarterly Report"] == "Title"
+    # runs join into one paragraph
+    assert cats["Revenue grew by ten percent."] == "NarrativeText"
+    assert cats["first item"] == "ListItem"
+    table = [t for t, m in blocks if m["category"] == "Table"]
+    assert len(table) == 1 and "EMEA\t120" in table[0]
+
+
+def test_parse_unstructured_builtin_sniffing():
+    from pathway_tpu.xpacks.llm.parsers import ParseUnstructured
+
+    # elements mode keeps per-block category metadata
+    elems = ParseUnstructured(mode="elements").__wrapped__(_HTML)
+    assert any(m["category"] == "Title" for _, m in elems)
+    # single mode joins; docx sniffed from PK zip magic
+    single = ParseUnstructured(mode="single").__wrapped__(_minimal_docx())
+    assert len(single) == 1 and "Quarterly Report" in single[0][0]
+    # pdf sniffed from %PDF magic, paged mode groups per page
+    paged = ParseUnstructured(mode="paged").__wrapped__(
+        _minimal_pdf(CONTENT, compress=True)
+    )
+    assert len(paged) == 1 and "Hello PDF world" in paged[0][0]
+    # plain text falls through to utf-8
+    txt = ParseUnstructured().__wrapped__(b"just plain text")
+    assert txt == [("just plain text", {})] or txt[0][0] == "just plain text"
+
+
+def test_parse_html_docx_udfs():
+    from pathway_tpu.xpacks.llm.parsers import ParseDocx, ParseHtml
+
+    html_blocks = ParseHtml(mode="elements").__wrapped__(_HTML)
+    assert any(m["category"] == "ListItem" for _, m in html_blocks)
+    docx_single = ParseDocx().__wrapped__(_minimal_docx())
+    assert "Revenue grew by ten percent." in docx_single[0][0]

@@ -262,3 +262,57 @@ def test_adaptive_rag_answerer(tiny_embedder):
     )
     rows = run_to_rows(rag.answer_query(queries))
     assert rows[0][-1]["response"] == "The answer is 42."
+
+
+def test_document_store_ingests_html_and_docx(tiny_embedder):
+    """DocumentStore ingests binary .html/.docx via ParseUnstructured's
+    built-in extractors; chunks carry element-category metadata
+    (VERDICT r3 item 8)."""
+    from tests.test_parsers import _HTML, _minimal_docx
+    from pathway_tpu.xpacks.llm.parsers import ParseUnstructured
+
+    files = [("page.html", _HTML), ("report.docx", _minimal_docx())]
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(data=bytes, _metadata=dict),
+        [(data, {"path": f"/in/{name}"}) for name, data in files],
+    )
+    factory = BruteForceKnnFactory(embedder=tiny_embedder, reserved_space=32)
+    store = DocumentStore(
+        docs,
+        retriever_factory=factory,
+        parser=ParseUnstructured(mode="elements"),
+    )
+    inputs_q = T(
+        """
+    dummy
+    x
+    """
+    ).select(
+        metadata_filter=pw.apply(lambda _q: None, pw.this.dummy),
+        filepath_globpattern=pw.apply(lambda _q: None, pw.this.dummy),
+    )
+    listing = run_to_rows(store.inputs_query(inputs_q))
+    paths = {d["path"] for d in listing[0][0]}
+    assert paths == {"/in/page.html", "/in/report.docx"}
+
+    queries = T(
+        """
+    q
+    revenue
+    """
+    ).select(
+        query=pw.this.q,
+        k=pw.apply(lambda _q: 4, pw.this.q),
+        metadata_filter=pw.apply(lambda _q: None, pw.this.q),
+        filepath_globpattern=pw.apply(lambda _q: None, pw.this.q),
+    )
+    res = run_to_rows(store.retrieve_query(queries))
+    docs_out = res[0][-1]
+    assert docs_out, "retrieval returned nothing"
+    texts = " ".join(d["text"] for d in docs_out)
+    all_meta = [d["metadata"] for d in docs_out]
+    # chunks originate from parsed blocks with category metadata
+    assert any(m.get("category") in
+               ("Title", "NarrativeText", "ListItem", "Table")
+               for m in all_meta), all_meta
+    assert "Revenue" in texts or "Apples" in texts
