@@ -44,8 +44,10 @@ N_QUERIES = 50
 BASELINE_MS = 50.0
 
 EMBED_SEQ = 128
-EMBED_BATCH = 256  # chunk size; encode() pipelines chunk i+1 over i's readback
-EMBED_DOCS = 4096
+EMBED_BATCH = 512  # chunk size; encode() pipelines chunk i+1 over i's readback
+EMBED_DEPTH = 4  # in-flight chunks (hides the host link RTT)
+EMBED_DOCS = 8192
+EMBED_TRIALS = 3  # report the MEDIAN e2e pass (tunnel variance is +-40%)
 EMBED_TARGET_PER_CHIP = 10_000 / 8  # BASELINE target is for v5e-8
 
 WC_LINES = 2_000_000
@@ -212,7 +214,7 @@ def bench_embed(extra: dict) -> None:
         mesh=mesh,
         max_batch=EMBED_BATCH,
         max_len=EMBED_SEQ,
-        pipeline_depth=3,  # hide the link round trip on tunneled backends
+        pipeline_depth=EMBED_DEPTH,
     )
     idx = ShardedKnnIndex(cfg.hidden, metric="cos", capacity=EMBED_DOCS, mesh=mesh)
 
@@ -224,18 +226,35 @@ def bench_embed(extra: dict) -> None:
 
     log(
         f"embed bench: BGE-large-class ({cfg.layers}L/{cfg.hidden}h bf16), "
-        f"seq {EMBED_SEQ}, batch {EMBED_BATCH}, {EMBED_DOCS} docs"
+        f"seq {EMBED_SEQ}, batch {EMBED_BATCH} x depth {EMBED_DEPTH}, "
+        f"{EMBED_DOCS} docs x {EMBED_TRIALS} trials (median)"
     )
-    # warmup/compile on the same bucket shape
+    # warmup: compile the bucket shape, one full pipelined pass (warm
+    # upload/readback streams), and the index scatter at the full-batch
+    # shape — the first cold pass otherwise pays every compile and reads
+    # ~50% low
     enc.encode(docs[:EMBED_BATCH])
-
-    t0 = time.perf_counter()
-    embs = enc.encode(docs)  # chunks of EMBED_BATCH, pipelined readback
-    idx.add_batch(range(EMBED_DOCS), embs)
+    enc.encode(docs[: EMBED_BATCH * EMBED_DEPTH])
+    idx.add_batch(
+        range(EMBED_DOCS), np.zeros((EMBED_DOCS, cfg.hidden), np.float32)
+    )
     jax.block_until_ready(idx._vectors)
-    dt = time.perf_counter() - t0
+
+    # repeated full passes: the tunnel RTT and shared-TPU load swing
+    # single passes by +-40%, so the headline is the MEDIAN trial
+    trial_dps = []
     done = EMBED_DOCS
-    dps = done / dt
+    for trial in range(EMBED_TRIALS):
+        t0 = time.perf_counter()
+        embs = enc.encode(docs)  # chunks of EMBED_BATCH, pipelined readback
+        idx.add_batch(range(EMBED_DOCS), embs)
+        jax.block_until_ready(idx._vectors)
+        trial_dt = time.perf_counter() - t0
+        trial_dps.append(done / trial_dt)
+        log(f"  e2e trial {trial}: {done / trial_dt:.0f} docs/s")
+    trial_dps.sort()
+    dps = trial_dps[len(trial_dps) // 2]
+    dt = done / dps
 
     # device steady state (re-dispatch one resident chunk): isolates the
     # compiled encoder's MFU from host tokenize/upload/readback overheads
@@ -274,6 +293,7 @@ def bench_embed(extra: dict) -> None:
         + f"; target share {target:.0f} docs/s"
     )
     extra["embed_docs_per_sec"] = round(dps, 1)
+    extra["embed_docs_per_sec_trials"] = [round(x, 1) for x in trial_dps]
     extra["embed_mfu_pct"] = round(mfu * 100, 1) if mfu is not None else None
     extra["embed_device_docs_per_sec"] = round(dev_dps, 1)
     extra["embed_device_mfu_pct"] = (
@@ -288,18 +308,23 @@ def bench_embed(extra: dict) -> None:
 # ---------------------------------------------------------------------------
 
 
-def bench_wordcount(extra: dict) -> None:
-    import pathway_tpu as pw
-    from pathway_tpu.internals.parse_graph import G
-
-    G.clear()
-    d = tempfile.mkdtemp(prefix="pw_bench_wc_")
+def _write_wc_input(d: str) -> str:
     fp = os.path.join(d, "lines.jsonl")
     rng = np.random.default_rng(2)
     words = rng.integers(0, WC_WORDS, size=WC_LINES)
     with open(fp, "w") as f:
         f.write("\n".join('{"word": "w%d"}' % w for w in words))
         f.write("\n")
+    return fp
+
+
+def bench_wordcount(extra: dict) -> None:
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    d = tempfile.mkdtemp(prefix="pw_bench_wc_")
+    fp = _write_wc_input(d)
 
     class S(pw.Schema):
         word: str
@@ -326,10 +351,119 @@ def bench_wordcount(extra: dict) -> None:
     extra["wordcount_persistence"] = "PERSISTING"
 
 
+def bench_wordcount_multiprocess(extra: dict) -> None:
+    """The same wordcount across a 2-process TCP cluster (spawn env
+    contract) — the scale story the thread mode (GIL-bound) can't tell."""
+    import subprocess
+    import textwrap
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    d = tempfile.mkdtemp(prefix="pw_bench_wc_mp_")
+    fp = _write_wc_input(d)
+    out_fp = os.path.join(d, "out.jsonl")
+    prog = os.path.join(d, "prog.py")
+    with open(prog, "w") as f:
+        f.write(
+            textwrap.dedent(
+                f"""
+                import sys
+                sys.path.insert(0, {repo!r})
+                import pathway_tpu as pw
+
+                class S(pw.Schema):
+                    word: str
+
+                t = pw.io.jsonlines.read({fp!r}, schema=S, mode="static")
+                counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+                pw.io.jsonlines.write(counts, {out_fp!r})
+                import time as _time
+                _t0 = _time.perf_counter()
+                pw.run(autocommit_duration_ms=200)
+                print("RUN_SECONDS=%.3f" % (_time.perf_counter() - _t0))
+                """
+            )
+        )
+    n_procs = 2
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(
+        os.environ,
+        PATHWAY_THREADS="1",
+        PATHWAY_PROCESSES=str(n_procs),
+        PATHWAY_FIRST_PORT=str(port),
+        JAX_PLATFORMS="cpu",
+    )
+    log(f"wordcount multiprocess: {WC_LINES} lines over {n_procs} processes")
+    procs = []
+    for pid in range(n_procs):
+        e = dict(env, PATHWAY_PROCESS_ID=str(pid))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, prog],
+                env=e,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    run_secs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        if p.returncode != 0:
+            raise RuntimeError(f"cluster proc failed: {err.decode()[-500:]}")
+        for line in out.decode().splitlines():
+            if line.startswith("RUN_SECONDS="):
+                run_secs.append(float(line.split("=", 1)[1]))
+    # per-run wall time (slowest worker), excluding interpreter + jax
+    # import startup — the steady-state cluster rate, which is what the
+    # thread-vs-process scaling question is about
+    dt = max(run_secs)
+    rps = WC_LINES / dt
+    log(
+        f"wordcount multiprocess: {rps:.0f} rows/s over {n_procs} procs "
+        f"(run {dt:.1f}s, startup excluded)"
+    )
+    extra["wordcount_multiprocess_rows_per_sec"] = round(rps)
+    extra["wordcount_multiprocess_n_procs"] = n_procs
+
+
+def bench_select(extra: dict) -> None:
+    """Expression-VM select/filter pipeline throughput (native bytecode,
+    reference expression.rs role)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    N = 1_000_000
+    rows = [(i, float(i % 97)) for i in range(N)]
+    t = pw.debug.table_from_rows(pw.schema_from_types(a=int, b=float), rows)
+    out = t.select(
+        t.a,
+        q=t.a * 3 + 1,
+        r=t.b / 2.0,
+        f=pw.if_else(t.a % 7 > 3, t.a, -t.a),
+    )
+    flt = out.filter(out.q % 5 != 0)
+    cap = flt._capture_node()
+    t0 = time.perf_counter()
+    ctx = pw.run()
+    dt = time.perf_counter() - t0
+    n_out = len(ctx.state(cap)["rows"])
+    assert n_out > 0
+    log(f"select+filter pipeline: {N / dt:.0f} rows/s ({n_out} survivors)")
+    extra["select_rows_per_sec"] = round(N / dt)
+
+
 # ---------------------------------------------------------------------------
 
 
 def main() -> None:
+    # batch-job collector discipline: long sweep interval (the managed-GC
+    # caretaker still bounds cycles; see internals/run.py _ManagedGc)
+    os.environ.setdefault("PATHWAY_GC_INTERVAL_S", "10")
     extra: dict = {}
     p50 = bench_knn(extra)
     try:
@@ -342,6 +476,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001
         log(f"wordcount bench failed: {e!r}")
         extra["wordcount_error"] = repr(e)
+    try:
+        bench_wordcount_multiprocess(extra)
+    except Exception as e:  # noqa: BLE001
+        log(f"wordcount multiprocess bench failed: {e!r}")
+        extra["wordcount_multiprocess_error"] = repr(e)
+    try:
+        bench_select(extra)
+    except Exception as e:  # noqa: BLE001
+        log(f"select bench failed: {e!r}")
+        extra["select_error"] = repr(e)
 
     print(
         json.dumps(

@@ -37,6 +37,7 @@
 #include <cstdint>
 #include <cstring>
 #include <memory>
+#include <mutex>
 #include <queue>
 #include <string>
 #include <unordered_map>
@@ -2405,7 +2406,10 @@ PyObject* py_vm_compile(PyObject*, PyObject* args) {
                     ok = d >= 1 && flow(next, d - 1) && flow((size_t)o[0], d);
                     break;
                 case VM_MAKE_TUPLE:
-                    ok = o[0] >= 0 && d >= (int)o[0] &&
+                    // full int64 comparison: a truncated (int) cast would
+                    // let counts like 2^32+2 slip past and underflow the
+                    // runtime stack
+                    ok = o[0] >= 0 && (int64_t)d >= o[0] &&
                          flow(next, d - (int)o[0] + 1);
                     nd = d - (int)o[0] + 1;
                     break;
@@ -2415,7 +2419,7 @@ PyObject* py_vm_compile(PyObject*, PyObject* args) {
                          (o[0] != 0 || flow(next, d - 2));
                     break;
                 case VM_POINTER:
-                    ok = o[0] >= 1 && d >= (int)o[0] && o[2] >= 0 &&
+                    ok = o[0] >= 1 && (int64_t)d >= o[0] && o[2] >= 0 &&
                          (size_t)o[2] < P->consts.size() &&
                          flow(next, d - (int)o[0] + 1);
                     nd = d - (int)o[0] + 1;
@@ -3162,6 +3166,10 @@ fail:
 
 struct HnswIndex {
     int dim, M, M0, efc, metric;  // metric: 0 ip (-dot; cos = normalized ip), 1 l2sq
+    //: add/search/remove release the GIL around the graph work; this
+    //: mutex is what actually serializes them (search mutates the
+    //: visited stamps too, so even concurrent reads need it)
+    std::mutex mu;
     double inv_log_m;
     std::vector<float> vecs;                             // slot*dim
     std::vector<int> levels;                             // per slot
@@ -3445,8 +3453,11 @@ PyObject* py_hnsw_add(PyObject*, PyObject* args) {
     std::vector<uint32_t> slots((size_t)n);
     const float* data = static_cast<const float*>(view.buf);
     Py_BEGIN_ALLOW_THREADS;
-    for (Py_ssize_t i = 0; i < n; i++)
-        slots[(size_t)i] = hnsw_insert(*H, data + (size_t)i * H->dim);
+    {
+        std::lock_guard<std::mutex> lock(H->mu);
+        for (Py_ssize_t i = 0; i < n; i++)
+            slots[(size_t)i] = hnsw_insert(*H, data + (size_t)i * H->dim);
+    }
     Py_END_ALLOW_THREADS;
     PyBuffer_Release(&view);
     PyObject* out = PyList_New(n);
@@ -3470,28 +3481,33 @@ PyObject* py_hnsw_remove(PyObject*, PyObject* args) {
     if (H == nullptr) return nullptr;
     PyObject* seq = PySequence_Fast(slots_obj, "hnsw_remove expects slots");
     if (seq == nullptr) return nullptr;
-    for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(seq); i++) {
-        long long s = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(seq, i));
-        if (s == -1 && PyErr_Occurred()) {
-            Py_DECREF(seq);
-            return nullptr;
+    {
+        // serialize against GIL-released add/search; safe to hold with
+        // the GIL because mutex holders never ACQUIRE the GIL themselves
+        std::lock_guard<std::mutex> lock(H->mu);
+        for (Py_ssize_t i = 0; i < PySequence_Fast_GET_SIZE(seq); i++) {
+            long long s = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(seq, i));
+            if (s == -1 && PyErr_Occurred()) {
+                Py_DECREF(seq);
+                return nullptr;
+            }
+            if (s < 0 || (size_t)s >= H->alive.size() || !H->alive[(size_t)s])
+                continue;
+            H->alive[(size_t)s] = 0;
+            H->freelist.push_back((uint32_t)s);
+            H->n_alive--;
         }
-        if (s < 0 || (size_t)s >= H->alive.size() || !H->alive[(size_t)s])
-            continue;
-        H->alive[(size_t)s] = 0;
-        H->freelist.push_back((uint32_t)s);
-        H->n_alive--;
+        if (H->n_alive == 0) {  // empty graph: full reset
+            H->vecs.clear();
+            H->levels.clear();
+            H->links.clear();
+            H->alive.clear();
+            H->freelist.clear();
+            H->entry = -1;
+            H->max_level = -1;
+        }
     }
     Py_DECREF(seq);
-    if (H->n_alive == 0) {  // empty graph: full reset
-        H->vecs.clear();
-        H->levels.clear();
-        H->links.clear();
-        H->alive.clear();
-        H->freelist.clear();
-        H->entry = -1;
-        H->max_level = -1;
-    }
     Py_RETURN_NONE;
 }
 
@@ -3510,6 +3526,10 @@ PyObject* py_hnsw_search(PyObject*, PyObject* args) {
     int eff_ef = (int)std::max(ef, k);
     std::vector<std::vector<DistSlot>> results((size_t)nq);
     Py_BEGIN_ALLOW_THREADS;
+    // inner scope: the mutex MUST release before Py_END reacquires the
+    // GIL, or a GIL-holding caller blocked on the mutex deadlocks us
+    {
+    std::lock_guard<std::mutex> lock(H->mu);
     for (Py_ssize_t qi = 0; qi < nq; qi++) {
         if (H->entry < 0) continue;
         const float* q = data + (size_t)qi * H->dim;
@@ -3545,6 +3565,7 @@ PyObject* py_hnsw_search(PyObject*, PyObject* args) {
             if ((int)out.size() >= k) break;
         }
     }
+    }  // mutex released here, before the GIL reacquire below
     Py_END_ALLOW_THREADS;
     PyBuffer_Release(&view);
     PyObject* out = PyList_New(nq);

@@ -670,6 +670,17 @@ class Scheduler:
             w.join()
         if errors:
             raise errors[0]
+        # the returned (worker-0) context carries every worker's operator
+        # errors: a partitioned operator logs on whichever worker owns the
+        # row, and callers read ctx.error_log topology-independently.  The
+        # end-of-run allgather covers OTHER PROCESSES too; the thread merge
+        # is the fallback when the exchange didn't complete.
+        gathered = getattr(ctxs[0], "all_errors", None)
+        if gathered is not None:
+            ctxs[0].error_log = list(gathered)
+        else:
+            for c in ctxs[1:]:
+                ctxs[0].error_log.extend(c.error_log)
         return ctxs[0]
 
     def _worker_loop(self, cluster: Cluster, tid: int, ctx: RunContext) -> None:
@@ -752,12 +763,18 @@ class Scheduler:
         commit_requested = False
         last_cut = _time.monotonic()
         while True:
-            # drain whatever is buffered right now (non-blocking)
-            while True:
+            # drain whatever is buffered right now (non-blocking, bounded).
+            # A commit item ENDS the drain: rows enqueued after a commit
+            # belong to the next transaction — merging across it would
+            # consolidate an add with its later retraction into nothing
+            # (timed update streams rely on the boundary).
+            drained = 0
+            while drained < 8192:
                 try:
                     nid, kind, key, values = q.get_nowait()
                 except queue.Empty:
                     break
+                drained += 1
                 if kind == "add":
                     buffers[nid].append(Update(key, values, 1))
                 elif kind == "batch":
@@ -766,6 +783,7 @@ class Scheduler:
                     buffers[nid].append(Update(key, values, -1))
                 elif kind == "commit":
                     commit_requested = True
+                    break
                 elif kind == "close":
                     open_subjects.discard(nid)
 
@@ -847,6 +865,15 @@ class Scheduler:
                 w, ctx.time, getattr(ctx, "consumed", {}), wrappers, ctx=ctx
             ),
         )
+        # final error-log exchange: errors are logged on whichever worker
+        # (possibly another PROCESS) owned the row; gather so the caller's
+        # returned context reports them topology-independently.  Best
+        # effort — a torn-down cluster must not mask the run result.
+        try:
+            gathered = cluster.allgather(("errlog", "final"), tid, list(ctx.error_log))
+            ctx.all_errors = [e for worker_errs in gathered for e in worker_errs]  # type: ignore[attr-defined]
+        except Exception:
+            pass
 
     def _cluster_replay(
         self,

@@ -59,6 +59,15 @@ class EncoderConfig:
     #: tanh-approximated gelu (faster on MXU); HF "gelu" is the exact erf
     #: form — the checkpoint converter sets this from config.json
     gelu_approx: bool = True
+    #: sequence-parallel long-document attention: when a Mesh is set,
+    #: every SelfAttention runs ops.ring_attention with the sequence
+    #: dimension sharded over ``seq_axis`` (K/V blocks rotate over ICI
+    #: via ppermute; exact flash-style running softmax).  Sequences may
+    #: then exceed one device's attention memory; max_len still bounds
+    #: the position table.  Meshes hash by identity, so the config stays
+    #: a valid static jit argument.
+    seq_mesh: Any = None
+    seq_axis: str = "data"
 
     @property
     def head_dim(self) -> int:
@@ -92,11 +101,20 @@ class SelfAttention(nn.Module):
         q = dense("query")(x)  # [B, L, h, d]
         k = dense("key")(x)
         v = dense("value")(x)
-        scale = 1.0 / jnp.sqrt(jnp.float32(cfg.head_dim))
-        logits = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
-        bias = jnp.where(mask.astype(bool)[:, None, None, :], 0.0, -1e30)
-        probs = jax.nn.softmax(logits + bias, axis=-1).astype(cfg.dtype)
-        ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
+        if cfg.seq_mesh is not None:
+            # long-document path: sequence-parallel ring attention
+            # (ops/ring_attention.py) — same math, K/V ring over ICI
+            from pathway_tpu.ops.ring_attention import ring_attention
+
+            ctx = ring_attention(
+                q, k, v, mask, mesh=cfg.seq_mesh, axis=cfg.seq_axis
+            )
+        else:
+            scale = 1.0 / jnp.sqrt(jnp.float32(cfg.head_dim))
+            logits = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
+            bias = jnp.where(mask.astype(bool)[:, None, None, :], 0.0, -1e30)
+            probs = jax.nn.softmax(logits + bias, axis=-1).astype(cfg.dtype)
+            ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
         out = nn.DenseGeneral(
             features=cfg.hidden,
             axis=(-2, -1),

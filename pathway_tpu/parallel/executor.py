@@ -53,7 +53,13 @@ class JittedEncoder:
         params: Any = None,
         checkpoint_dir: str | None = None,
         pipeline_depth: int = 2,
+        sequence_axis: str | None = None,
     ):
+        #: sequence_axis: shard the SEQUENCE dimension over this mesh
+        #: axis and run ring attention inside every layer — the
+        #: long-document path: max_len may exceed one device's attention
+        #: memory (it must divide by the axis size).  Mutually exclusive
+        #: with sharding the batch over the same axis.
         #: chunks kept in flight before collecting a readback.  2 keeps
         #: the historical device-memory footprint (one computing + one
         #: draining); raise on high-RTT links to hide the round trip at
@@ -92,6 +98,17 @@ class JittedEncoder:
                 tokenizer = WordPieceTokenizer(vocab)
         elif config is None:
             raise ValueError("config is required without checkpoint_dir")
+        self.sequence_axis = sequence_axis
+        if sequence_axis is not None:
+            import dataclasses as _dc
+
+            if mesh is None or sequence_axis not in mesh.shape:
+                raise ValueError(
+                    "sequence_axis requires a mesh containing that axis"
+                )
+            config = _dc.replace(
+                config, seq_mesh=mesh, seq_axis=sequence_axis
+            )
         self.config = config
         self.cross = cross
         self.mesh = mesh
@@ -99,24 +116,48 @@ class JittedEncoder:
         self.model_axis = model_axis
         self.max_batch = max_batch
         self.max_len = max_len or config.max_len
+        if sequence_axis is not None:
+            n_seq = mesh.shape[sequence_axis]
+            if self.max_len % n_seq != 0:
+                raise ValueError(
+                    f"max_len {self.max_len} must divide the "
+                    f"{sequence_axis!r} axis size {n_seq}"
+                )
         self.tokenizer = tokenizer or get_tokenizer(model_name, config.vocab_size)
         self.model = (CrossEncoderModel if cross else TextEncoderModel)(config)
 
         if params is None:
             rng = jax.random.PRNGKey(seed)
             dummy = jnp.zeros((1, 8), jnp.int32)
-            params = self.model.init(rng, dummy, jnp.ones((1, 8), jnp.int32))
+            init_model = self.model
+            if sequence_axis is not None:
+                # init with the local-attention twin: identical params
+                # (ring attention adds no parameters), no shard_map at
+                # init time
+                import dataclasses as _dc
+
+                init_model = (CrossEncoderModel if cross else TextEncoderModel)(
+                    _dc.replace(config, seq_mesh=None)
+                )
+            params = init_model.init(rng, dummy, jnp.ones((1, 8), jnp.int32))
+        # batch layout: DP shards rows over data_axis; the SP long-doc
+        # path instead shards the SEQUENCE dimension over sequence_axis
+        in_spec = (
+            P(None, sequence_axis)
+            if sequence_axis is not None
+            else P(data_axis, None)
+        )
         if mesh is not None and model_axis in mesh.shape:
             specs = encoder_param_specs(params, model_axis)
             shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
             params = jax.device_put(params, shardings)
-            self._in_batch_sharding = NamedSharding(mesh, P(data_axis, None))
+            self._in_batch_sharding = NamedSharding(mesh, in_spec)
             self._out_sharding = NamedSharding(mesh, P())
         elif mesh is not None:
             params = jax.device_put(
                 params, jax.tree.map(lambda _: NamedSharding(mesh, P()), params)
             )
-            self._in_batch_sharding = NamedSharding(mesh, P(data_axis, None))
+            self._in_batch_sharding = NamedSharding(mesh, in_spec)
             self._out_sharding = NamedSharding(mesh, P())
         else:
             self._in_batch_sharding = None
@@ -160,6 +201,13 @@ class JittedEncoder:
         remote/tunneled backends the transfer of chunk i overlaps the
         tokenize+compute of chunk i+1."""
         ids, mask, tps, n = self._pad_batch(ids, mask, tps)
+        if self.sequence_axis is not None and ids.shape[1] < self.max_len:
+            # SP shards the sequence dimension: pad to the full max_len so
+            # every device holds an equal block
+            pad = ((0, 0), (0, self.max_len - ids.shape[1]))
+            ids = np.pad(ids, pad)
+            mask = np.pad(mask, pad)
+            tps = np.pad(tps, pad)
         if self._narrow_ids:
             ids = ids.astype(np.int16, copy=False)
             mask = mask.astype(np.uint8, copy=False)

@@ -70,6 +70,13 @@ class HnswIndex:
     def add(self, items: Sequence[tuple[Any, Any]]) -> None:
         if not items:
             return
+        # upsert semantics: last occurrence of a key wins — dedup WITHIN
+        # the batch too, or the earlier duplicate's slot would stay alive
+        # (and keep surfacing in results) with no key mapping back to it
+        last: dict[Any, Any] = {}
+        for k, v in items:
+            last[k] = v
+        items = list(last.items())
         # re-adding a key replaces its vector
         stale = [k for k, _ in items if k in self._slot_of]
         if stale:

@@ -81,6 +81,7 @@ class TPUEncoderEmbedder(BaseEmbedder):
         call_kwargs: dict | None = None,
         params: Any = None,
         config: Any = None,
+        sequence_axis: str | None = None,
         **kwargs: Any,
     ):
         super().__init__(max_batch_size=max_batch_size, **kwargs)
@@ -101,6 +102,7 @@ class TPUEncoderEmbedder(BaseEmbedder):
         self.encoder = JittedEncoder(
             cfg, mesh=mesh, model_name=model, params=params,
             max_batch=max_batch_size or 1024, checkpoint_dir=checkpoint_dir,
+            sequence_axis=sequence_axis,
         )
 
     def _embed_batch(self, texts: list[str]) -> list:

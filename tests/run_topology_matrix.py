@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Topology test matrix (reference pattern ``tests/utils.py:37-50``:
+the suite runs under multiple worker topologies, not just the default).
+
+Runs the full test suite under PATHWAY_THREADS={1,2,4}.  The 2-process
+TCP-cluster topology is exercised by tests/test_multiworker.py's
+subprocess tests inside every pass (they spawn their own clusters via
+the PATHWAY_PROCESSES env contract).
+
+Usage:  python tests/run_topology_matrix.py [extra pytest args]
+Exit code 0 iff every topology passes.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+TOPOLOGIES = [
+    {"PATHWAY_THREADS": "1"},
+    {"PATHWAY_THREADS": "2"},
+    {"PATHWAY_THREADS": "4"},
+]
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+    extra = sys.argv[1:]
+    results: list[tuple[str, int, float]] = []
+    for topo in TOPOLOGIES:
+        env = dict(os.environ, **topo)
+        label = ",".join(f"{k.split('_')[-1].lower()}={v}" for k, v in topo.items())
+        print(f"\n=== topology [{label}] ===", flush=True)
+        t0 = time.monotonic()
+        rc = subprocess.call(
+            [sys.executable, "-m", "pytest", "tests/", "-q", *extra],
+            cwd=repo,
+            env=env,
+        )
+        results.append((label, rc, time.monotonic() - t0))
+    print("\n=== topology matrix summary ===")
+    for label, rc, dt in results:
+        print(f"  [{label}] {'PASS' if rc == 0 else f'FAIL rc={rc}'} ({dt:.0f}s)")
+    return 0 if all(rc == 0 for _, rc, _ in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

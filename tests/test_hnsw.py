@@ -182,3 +182,65 @@ def test_usearch_knn_end_to_end_pipeline():
     by_q = {r[0]: r for r in rows}
     assert [d["doc"] for d in by_q["q1"][4]] == ["apple", "cherry"]
     assert [d["doc"] for d in by_q["q2"][4]] == ["banana", "cherry"]
+
+
+def test_hnsw_duplicate_key_within_one_batch():
+    """Last occurrence wins; the earlier duplicate's slot must not stay
+    alive under a lost key."""
+    idx = HnswIndex(4, metric="cos")
+    idx.add([("a", [1.0, 0, 0, 0]), ("b", [0.9, 0.4, 0, 0]), ("a", [0, 0, 1.0, 0])])
+    assert len(idx) == 2
+    (res,) = idx.search(np.array([[1.0, 0, 0, 0]], np.float32), 2)
+    assert [k for k, _ in res] == ["b", "a"]  # old 'a' vector gone
+
+
+def test_hnsw_concurrent_add_search_remove():
+    """add/search/remove from multiple threads (the native side releases
+    the GIL; the index's internal mutex must serialize)."""
+    import threading
+
+    x = _corpus(n=2000, d=16)
+    idx = HnswIndex(16, metric="cos")
+    idx.add(list(enumerate(x[:1000])))
+    stop = threading.Event()
+    errors: list = []
+
+    def adder():
+        try:
+            i = 1000
+            while not stop.is_set() and i < 2000:
+                idx.add([(i, x[i])])
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def searcher():
+        try:
+            while not stop.is_set():
+                res = idx.search(x[:8], 5)
+                assert len(res) == 8
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def remover():
+        try:
+            i = 0
+            while not stop.is_set() and i < 500:
+                idx.remove([i])
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=f) for f in (adder, searcher, remover)
+    ]
+    for t in threads:
+        t.start()
+    import time as _t
+
+    _t.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    assert len(idx) > 0

@@ -250,3 +250,52 @@ def test_cross_encoder_scores():
     ce = JittedEncoder(cfg, cross=True)
     s = ce.score_pairs(["q", "q"], ["relevant doc", "other"])
     assert s.shape == (2,) and s.dtype == np.float32
+
+
+def test_encoder_long_doc_ring_attention_parity(mesh8):
+    """The long-document path: TextEncoderModel with seq_mesh runs ring
+    attention INSIDE every layer and must match local attention at seq
+    1024 with the same params (VERDICT r3 item 6)."""
+    import dataclasses
+
+    from pathway_tpu.models.encoder import TextEncoderModel
+
+    cfg_local = dataclasses.replace(
+        TINY, max_len=1024, dtype=jnp.float32
+    )
+    cfg_ring = dataclasses.replace(cfg_local, seq_mesh=mesh8, seq_axis="data")
+    model_local = TextEncoderModel(cfg_local)
+    model_ring = TextEncoderModel(cfg_ring)
+
+    rng = np.random.default_rng(7)
+    ids = jnp.asarray(rng.integers(0, TINY.vocab_size, size=(2, 1024)), jnp.int32)
+    mask = np.ones((2, 1024), np.int32)
+    mask[1, 700:] = 0  # ragged doc: padded tail crosses device blocks
+    mask = jnp.asarray(mask)
+
+    params = model_local.init(jax.random.PRNGKey(0), ids, mask)
+    out_local = model_local.apply(params, ids, mask)
+    out_ring = jax.jit(model_ring.apply)(params, ids, mask)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_local), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_jitted_encoder_sequence_parallel_long_docs(mesh8):
+    """JittedEncoder(sequence_axis=...) embeds documents longer than one
+    device's block; short and long inputs agree with the local-attention
+    encoder on the same params."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, max_len=512, dtype=jnp.float32)
+    enc_sp = JittedEncoder(cfg, mesh=mesh8, sequence_axis="data")
+    enc_local = JittedEncoder(cfg, params=enc_sp.params)
+
+    docs = [
+        "short text",
+        "long document " * 120,  # ~240+ tokens, crosses device blocks
+    ]
+    out_sp = enc_sp.encode(docs)
+    out_local = enc_local.encode(docs)
+    assert out_sp.shape == out_local.shape == (2, cfg.hidden)
+    np.testing.assert_allclose(out_sp, out_local, rtol=2e-3, atol=2e-3)
