@@ -17,7 +17,7 @@ from typing import Any
 from pathway_tpu.internals import api as _api
 from pathway_tpu.internals import dtype as _dt
 from pathway_tpu.internals import udfs
-from pathway_tpu.internals.api import PENDING
+from pathway_tpu.internals.api import PENDING, PyObjectWrapper, wrap_py_object
 from pathway_tpu.internals.expression import (
     ColumnExpression,
     ColumnReference,
@@ -197,6 +197,8 @@ __all__ = [
     "Pointer",
     "Error",
     "PENDING",
+    "PyObjectWrapper",
+    "wrap_py_object",
     "ColumnExpression",
     "ColumnReference",
     "this",

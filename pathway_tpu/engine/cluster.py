@@ -220,14 +220,21 @@ class Cluster:
         self._barrier.wait()
         if thread_id == 0:
             local = self._local.pop(slot)
-            # remote: payload[src_tid][dst_tid] = updates
+            # remote: payload[src_tid][dst_tid] = updates as PLAIN
+            # (int_key, values, diff) tuples — pickling the Pointer
+            # int-subclass goes through per-object copyreg and measures
+            # ~6x slower to serialize; the receiver rewraps.  In-process
+            # workers share memory and skip all of this.
             if self._links is not None:
                 for peer in range(P):
                     if peer == self.process_id:
                         continue
                     payload = [
                         [
-                            local[src_tid][peer * T + dst_tid]
+                            [
+                                (int(u[0]), u[1], u[2])
+                                for u in local[src_tid][peer * T + dst_tid]
+                            ]
                             for dst_tid in range(T)
                         ]
                         for src_tid in range(T)
@@ -245,9 +252,15 @@ class Cluster:
                         for dst_tid in range(T):
                             merged[dst_tid].extend(boxes[base + dst_tid])
                     else:
+                        from pathway_tpu.engine.stream import Update
+                        from pathway_tpu.internals.keys import Pointer
+
                         payload = remote[src_pid]
                         for dst_tid in range(T):
-                            merged[dst_tid].extend(payload[src_tid][dst_tid])
+                            merged[dst_tid].extend(
+                                Update(Pointer(k), v, d)
+                                for k, v, d in payload[src_tid][dst_tid]
+                            )
             with self._lock:
                 self._merged[slot] = merged
         self._barrier.wait()

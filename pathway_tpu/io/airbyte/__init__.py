@@ -1,24 +1,436 @@
-"""``pw.io.airbyte`` — Airbyte-sourced tables (reference
+"""``pw.io.airbyte`` — Airbyte-protocol sources (reference
 ``python/pathway/io/airbyte`` + vendored ``airbyte_serverless``).
 
-Intentionally gated, not implemented: the reference runs an Airbyte
-SOURCE CONTAINER (Docker, or a GCP Cloud Run job) and speaks the Airbyte
-protocol over its stdout — the connector's substance is container
-orchestration plus each source's own OAuth/config flow, none of which
-exists in this environment (no Docker daemon, zero egress).  The
-incremental-state bookkeeping the wrapper adds on top is already
-exercised by this build's Debezium/Kafka upsert paths.  The API surface
-matches the reference so code written against it ports; calls raise
-``MissingDependency`` until a container runtime + ``airbyte-serverless``
-are available.
+TPU-build redesign: the reference launches the connector as a Docker
+image or a PyPI package in a venv and speaks the `Airbyte protocol
+<https://docs.airbyte.com/understanding-airbyte/airbyte-protocol>`_ over
+its stdout.  This environment has no Docker daemon and no egress, so the
+execution layer here runs any LOCAL executable speaking that same
+protocol (``spec``/``discover``/``read`` subcommands emitting JSONL
+``AirbyteMessage``\\s) — which is exactly what a connector container
+does inside — while the Docker/PyPI launch paths stay gated with the
+original error.  Everything above the execution layer is full fidelity:
+
+- catalog discovery and per-stream sync-mode selection (``incremental``
+  preferred, ``full_refresh`` fallback — reference ``logic.py:15-16``);
+- the incremental STATE machinery: ``LEGACY`` / ``GLOBAL`` / ``STREAM``
+  state messages folded into one global envelope that is handed back to
+  the connector on the next poll (reference
+  ``logic.py:_PathwayAirbyteDestination``);
+- commit boundaries at STATE messages, so each poll's rows become
+  engine transactions aligned with the connector's own checkpoints;
+- ``full_refresh`` snapshot diffing: unchanged rows don't churn,
+  disappeared rows are retracted (reference ``logic.py:on_event``);
+- durable state (``state_path``): the state envelope is written at every
+  commit, so a restarted pipeline resumes the incremental sync instead
+  of re-extracting history.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import json
+import logging
+import os
+import subprocess
+import tempfile
+import time
+from typing import Any, Sequence
 
-from pathway_tpu.io._gated import gated_reader
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import coerce_row
+from pathway_tpu.io.python import ConnectorSubject
+from pathway_tpu.io.python import read as python_read
 
-read = gated_reader("airbyte", "airbyte_serverless", "docker")
+__all__ = ["read", "ExecutableAirbyteSource", "AirbyteStateTracker"]
 
-__all__ = ["read"]
+_logger = logging.getLogger("pathway_tpu")
+
+MAX_RETRIES = 5
+INCREMENTAL_SYNC_MODE = "incremental"
+FULL_REFRESH_SYNC_MODE = "full_refresh"
+
+
+class AirbyteStateTracker:
+    """Folds Airbyte STATE messages into one resumable global envelope.
+
+    The protocol has three state flavors (the reference handles the same
+    trio, ``logic.py:68-131``): ``LEGACY`` (one opaque blob), ``STREAM``
+    (per-stream descriptors), and ``GLOBAL`` (stream states + an
+    optional shared state).  The tracker accepts any mix and renders a
+    ``GLOBAL`` envelope — the most general form — to feed back to the
+    connector's ``--state``.
+    """
+
+    def __init__(self) -> None:
+        self._stream_states: dict[str, Any] = {}
+        self._shared_state: Any = None
+        self._legacy: Any = None
+
+    def observe(self, state_msg: dict) -> None:
+        """Fold one STATE message payload (the ``state`` field)."""
+        state_type = state_msg.get("type", "LEGACY")
+        if state_type == "LEGACY":
+            blob = state_msg.get("data")
+            if blob is None:
+                _logger.warning("airbyte LEGACY state without 'data'")
+            else:
+                self._legacy = blob
+            return
+        if state_type in ("STREAM", "PER_STREAM"):
+            self._fold_stream(state_msg.get("stream"))
+            return
+        if state_type == "GLOBAL":
+            g = state_msg.get("global")
+            if g is None:
+                _logger.warning("airbyte GLOBAL state without 'global'")
+                return
+            for s in g.get("stream_states") or []:
+                self._fold_stream(s)
+            self._shared_state = g.get("shared_state")
+            return
+        _logger.warning("unknown airbyte state type %r ignored", state_type)
+
+    def _fold_stream(self, stream: Any) -> None:
+        if not isinstance(stream, dict):
+            _logger.warning("airbyte stream state without 'stream' section")
+            return
+        desc = stream.get("stream_descriptor") or {}
+        name = desc.get("name")
+        if name is None:
+            _logger.warning("airbyte stream state without descriptor name")
+            return
+        self._stream_states[name] = stream.get("stream_state")
+
+    def envelope(self) -> dict | None:
+        """The state to hand back to the connector (None = from scratch)."""
+        if self._stream_states or self._shared_state is not None:
+            g: dict[str, Any] = {
+                "stream_states": [
+                    {
+                        "stream_descriptor": {"name": name},
+                        "stream_state": state,
+                    }
+                    for name, state in self._stream_states.items()
+                ]
+            }
+            if self._shared_state is not None:
+                g["shared_state"] = self._shared_state
+            return {"type": "GLOBAL", "global": g}
+        if self._legacy is not None:
+            return {"type": "LEGACY", "data": self._legacy}
+        return None
+
+    def load(self, envelope: dict | None) -> None:
+        self._stream_states = {}
+        self._shared_state = None
+        self._legacy = None
+        if envelope:
+            self.observe(envelope)
+
+
+class ExecutableAirbyteSource:
+    """Runs a local Airbyte-protocol executable.
+
+    ``command`` is the argv prefix (e.g. ``["python", "my_source.py"]``
+    or a connector binary); the source invokes ``<command> discover
+    --config f`` once and ``<command> read --config f --catalog f
+    [--state f]`` per poll, parsing JSONL ``AirbyteMessage``\\s from
+    stdout.  This is the role of the reference's Docker/venv runners
+    with the container layer stripped away.
+    """
+
+    def __init__(
+        self,
+        command: Sequence[str],
+        *,
+        config: dict | None = None,
+        streams: Sequence[str] | None = None,
+        catalog: dict | None = None,
+        env_vars: dict[str, str] | None = None,
+    ):
+        self.command = list(command)
+        self.config = config or {}
+        self.streams = list(streams or [])
+        self._catalog = catalog
+        self._configured: dict | None = None
+        self.env_vars = env_vars
+
+    # -- protocol plumbing ---------------------------------------------
+    def _run(self, args: list[str], *, timeout: float = 600.0) -> list[dict]:
+        env = dict(os.environ, **(self.env_vars or {}))
+        proc = subprocess.run(
+            self.command + args,
+            capture_output=True,
+            timeout=timeout,
+            env=env,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"airbyte connector {self.command} failed: "
+                f"{proc.stderr.decode(errors='replace')[-1000:]}"
+            )
+        out = []
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                _logger.debug("non-JSON connector output: %r", line[:200])
+        return out
+
+    def _tmp_json(self, d: str, name: str, payload: Any) -> str:
+        path = os.path.join(d, name)
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+    def discover(self) -> dict:
+        """The connector's catalog (cached)."""
+        if self._catalog is not None:
+            return self._catalog
+        with tempfile.TemporaryDirectory(prefix="pw_airbyte_") as d:
+            cfg = self._tmp_json(d, "config.json", self.config)
+            messages = self._run(["discover", "--config", cfg])
+        for m in messages:
+            if m.get("type") == "CATALOG":
+                self._catalog = m["catalog"]
+                return self._catalog
+        raise RuntimeError("airbyte connector emitted no CATALOG message")
+
+    @property
+    def configured_catalog(self) -> dict:
+        """Configured catalog over the requested streams; incremental
+        sync when the stream supports it, full refresh otherwise."""
+        if self._configured is not None:
+            return self._configured
+        catalog = self.discover()
+        wanted = set(self.streams) or {
+            s["name"] for s in catalog.get("streams", [])
+        }
+        configured = []
+        for s in catalog.get("streams", []):
+            if s["name"] not in wanted:
+                continue
+            modes = s.get("supported_sync_modes") or ["full_refresh"]
+            sync = (
+                INCREMENTAL_SYNC_MODE
+                if INCREMENTAL_SYNC_MODE in modes
+                else FULL_REFRESH_SYNC_MODE
+            )
+            configured.append(
+                {
+                    "stream": s,
+                    "sync_mode": sync,
+                    "destination_sync_mode": "append",
+                }
+            )
+        missing = wanted - {c["stream"]["name"] for c in configured}
+        if missing:
+            raise ValueError(f"streams not found in catalog: {sorted(missing)}")
+        self._configured = {"streams": configured}
+        return self._configured
+
+    @property
+    def sync_mode(self) -> str:
+        return self.configured_catalog["streams"][0]["sync_mode"]
+
+    def extract(self, state: dict | None) -> list[dict]:
+        """One ``read`` pass; returns RECORD/STATE messages in order."""
+        with tempfile.TemporaryDirectory(prefix="pw_airbyte_") as d:
+            args = [
+                "read",
+                "--config",
+                self._tmp_json(d, "config.json", self.config),
+                "--catalog",
+                self._tmp_json(d, "catalog.json", self.configured_catalog),
+            ]
+            if state is not None:
+                args += ["--state", self._tmp_json(d, "state.json", state)]
+            messages = self._run(args)
+        return [
+            m for m in messages if m.get("type") in ("RECORD", "STATE")
+        ]
+
+    def on_stop(self) -> None:
+        pass
+
+
+class _AirbyteSubject(ConnectorSubject):
+    """Polls the source, emits rows, commits at connector STATE
+    checkpoints, and persists the state envelope (reference
+    ``logic.py:_PathwayAirbyteSubject``)."""
+
+    def __init__(
+        self,
+        source: ExecutableAirbyteSource,
+        *,
+        mode: str,
+        refresh_interval_ms: int,
+        state_path: str | None = None,
+    ):
+        super().__init__(datasource_name="airbyte")
+        self.source = source
+        self.mode = mode
+        self.refresh_interval = refresh_interval_ms / 1000.0
+        self.state_path = state_path
+        self.tracker = AirbyteStateTracker()
+        if state_path and os.path.exists(state_path):
+            with open(state_path) as f:
+                self.tracker.load(json.load(f))
+        #: full-refresh snapshot diffing: content-key -> coerced row
+        self._cache: dict[K.Pointer, tuple] = {}
+        self._present: set[K.Pointer] = set()
+
+    # -- emission -------------------------------------------------------
+    def _emit(self, payload: dict) -> None:
+        if self.source.sync_mode == INCREMENTAL_SYNC_MODE:
+            self.next_json({"data": payload})
+            return
+        # full refresh: content-addressed upsert; unchanged rows no-op
+        message = json.dumps(
+            {"data": payload}, ensure_ascii=False, sort_keys=True
+        )
+        key = K.ref_scalar("__airbyte__", message)
+        self._present.add(key)
+        if key not in self._cache:
+            row = coerce_row({"data": payload}, self._schema)
+            self._cache[key] = row
+            self._events.add(key, row)
+
+    def _retract_absent(self) -> None:
+        absent = [k for k in self._cache if k not in self._present]
+        for key in absent:
+            self._events.remove(key, self._cache.pop(key))
+        self._present.clear()
+
+    def _checkpoint(self) -> None:
+        """Commit + durably save the state envelope at a connector
+        checkpoint, in that order: the engine log's commit record and
+        the saved state then describe the same frontier."""
+        self.commit()
+        if self.state_path:
+            env = self.tracker.envelope()
+            tmp = f"{self.state_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(env, f)
+            os.replace(tmp, self.state_path)
+
+    # -- main loop ------------------------------------------------------
+    def run(self) -> None:
+        failures = 0
+        while True:
+            started = time.monotonic()
+            try:
+                messages = self.source.extract(self.tracker.envelope())
+            except Exception:
+                _logger.exception("airbyte extract failed, retrying")
+                failures += 1
+                if failures >= MAX_RETRIES:
+                    raise
+                time.sleep(min(1.5**failures, 30.0))
+                continue
+            failures = 0
+            saw_state = False
+            for m in messages:
+                if m["type"] == "RECORD":
+                    self._emit(m["record"]["data"])
+                elif m["type"] == "STATE":
+                    self.tracker.observe(m["state"])
+                    saw_state = True
+                    if self.source.sync_mode == INCREMENTAL_SYNC_MODE:
+                        self._checkpoint()
+            if self.source.sync_mode == FULL_REFRESH_SYNC_MODE:
+                self._retract_absent()
+            if not saw_state or self.source.sync_mode == FULL_REFRESH_SYNC_MODE:
+                self._checkpoint()
+            if self.mode == "static":
+                return
+            if self.stopped:
+                return
+            # poll cadence; wake early when the run is shutting down
+            deadline = started + self.refresh_interval
+            while time.monotonic() < deadline:
+                if self.stopped:
+                    return
+                time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
+
+    def on_stop(self) -> None:
+        self.source.on_stop()
+
+
+def _load_source_config(config: Any) -> dict:
+    if isinstance(config, dict):
+        return config
+    with open(config) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        try:
+            import yaml
+
+            return yaml.safe_load(text)
+        except ImportError as e:
+            raise ValueError(
+                "config file is not JSON and pyyaml is unavailable"
+            ) from e
+
+
+def read(
+    config_file_path: Any,
+    streams: Sequence[str],
+    *,
+    execution_type: str = "local",
+    mode: str = "streaming",
+    env_vars: dict[str, str] | None = None,
+    refresh_interval_ms: int = 60000,
+    enforce_method: str | None = None,
+    state_path: str | None = None,
+    command: Sequence[str] | None = None,
+    catalog: dict | None = None,
+    name: str = "airbyte",
+    persistent_id: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    """Read a table through an Airbyte-protocol connector.
+
+    ``config_file_path`` is a config dict or a JSON/YAML file whose
+    ``source`` section holds the connector settings.  The executable is
+    taken from ``command`` (argv prefix) or the config's
+    ``source.command``; Docker images / PyPI venvs / remote GCP jobs
+    (the reference's launchers) need a container runtime / egress that
+    this environment lacks and raise the original gating error.  See the
+    module docstring for the protocol/state semantics.
+    """
+    cfg = _load_source_config(config_file_path)
+    source_cfg = cfg.get("source", cfg)
+    cmd = list(command) if command else source_cfg.get("command")
+    if not cmd:
+        from pathway_tpu.io._gated import gated_reader
+
+        if execution_type != "local" or source_cfg.get("docker_image"):
+            gated_reader("airbyte", "airbyte_serverless", "docker")()
+        raise ValueError(
+            "airbyte: provide `command=[...]` (a local Airbyte-protocol "
+            "executable) or a config with source.command; docker/pypi "
+            "launchers need a container runtime unavailable here"
+        )
+    source = ExecutableAirbyteSource(
+        cmd,
+        config=source_cfg.get("config"),
+        streams=streams,
+        catalog=catalog,
+        env_vars=env_vars,
+    )
+    subject = _AirbyteSubject(
+        source,
+        mode=mode,
+        refresh_interval_ms=refresh_interval_ms,
+        state_path=state_path,
+    )
+    schema = sch.schema_from_types(data=dict)
+    return python_read(subject, schema=schema, name=name, **kwargs)

@@ -87,7 +87,11 @@ class BaseRestServer:
 
                 async def invoke(self, **kwargs: Any) -> dict:
                     kwargs = {
-                        k: (v.value if isinstance(v, Json) else v)
+                        k: (
+                            v.value
+                            if isinstance(v, (Json, pw.PyObjectWrapper))
+                            else v
+                        )
                         for k, v in kwargs.items()
                     }
                     return {"result": await async_fn(**kwargs)}

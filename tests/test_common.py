@@ -763,3 +763,51 @@ def test_await_futures_unwraps_dtypes():
     from tests.utils import run_to_rows as _rows
 
     assert _rows(out.select(out.a)) == [(1,)]
+
+
+def test_py_object_wrapper_through_pipeline():
+    """pw.PyObjectWrapper flows through select/groupby/UDFs (reference
+    Value::PyObjectWrapper, engine.pyi:895)."""
+
+    class Blob:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def __eq__(self, other):
+            return isinstance(other, Blob) and other.tag == self.tag
+
+        def __hash__(self):
+            return hash(self.tag)
+
+    rows = [
+        (1, pw.wrap_py_object(Blob("x"))),
+        (2, pw.PyObjectWrapper(Blob("x"))),
+        (3, pw.wrap_py_object(Blob("y"))),
+    ]
+    t = pw.debug.table_from_rows(pw.schema_from_types(a=int, o=object), rows)
+    # UDF receives the wrapper and can unwrap it
+    tagged = t.select(
+        t.a, tag=pw.apply(lambda o: o.value.tag, t.o), o=t.o
+    )
+    g = tagged.groupby(tagged.o).reduce(
+        n=pw.reducers.count(), tag=pw.reducers.unique(tagged.tag)
+    )
+    rows_out = sorted(run_to_rows(g))
+    assert rows_out == [(1, "y"), (2, "x")]
+    # pickle round trip (persistence path) preserves payload equality
+    import pickle
+
+    w = pw.wrap_py_object(Blob("z"))
+    assert pickle.loads(pickle.dumps(w)) == w
+    # custom serializer is honored
+    class Ser:
+        @staticmethod
+        def dumps(o):
+            return o.tag.encode()
+
+        @staticmethod
+        def loads(b):
+            return Blob(b.decode() + "!")
+
+    w2 = pw.wrap_py_object(Blob("q"), serializer=Ser)
+    assert pickle.loads(pickle.dumps(w2)).value.tag == "q!"
