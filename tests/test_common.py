@@ -765,19 +765,33 @@ def test_await_futures_unwraps_dtypes():
     assert _rows(out.select(out.a)) == [(1,)]
 
 
+class _Blob:
+    def __init__(self, tag):
+        self.tag = tag
+
+    def __eq__(self, other):
+        return isinstance(other, _Blob) and other.tag == self.tag
+
+    def __hash__(self):
+        return hash(self.tag)
+
+
+class _BlobSer:
+    @staticmethod
+    def dumps(o):
+        return o.tag.encode()
+
+    @staticmethod
+    def loads(b):
+        return _Blob(b.decode() + "!")
+
+
 def test_py_object_wrapper_through_pipeline():
     """pw.PyObjectWrapper flows through select/groupby/UDFs (reference
     Value::PyObjectWrapper, engine.pyi:895)."""
+    Blob = _Blob
 
-    class Blob:
-        def __init__(self, tag):
-            self.tag = tag
-
-        def __eq__(self, other):
-            return isinstance(other, Blob) and other.tag == self.tag
-
-        def __hash__(self):
-            return hash(self.tag)
+    from tests.utils import run_to_rows
 
     rows = [
         (1, pw.wrap_py_object(Blob("x"))),
@@ -800,14 +814,5 @@ def test_py_object_wrapper_through_pipeline():
     w = pw.wrap_py_object(Blob("z"))
     assert pickle.loads(pickle.dumps(w)) == w
     # custom serializer is honored
-    class Ser:
-        @staticmethod
-        def dumps(o):
-            return o.tag.encode()
-
-        @staticmethod
-        def loads(b):
-            return Blob(b.decode() + "!")
-
-    w2 = pw.wrap_py_object(Blob("q"), serializer=Ser)
+    w2 = pw.wrap_py_object(Blob("q"), serializer=_BlobSer)
     assert pickle.loads(pickle.dumps(w2)).value.tag == "q!"
