@@ -430,14 +430,19 @@ PyObject* py_scan_lines(PyObject*, PyObject* arg) {
 // through the Python-level __new__ (same trick as namedtuple._make).
 PyObject* make_update_obj(PyObject* cls, PyObject* key, PyObject* values,
                           PyObject* diff) {
-    PyObject* inner = PyTuple_Pack(3, key, values, diff);
-    if (inner == nullptr) return nullptr;
-    PyObject* args = PyTuple_Pack(1, inner);
-    Py_DECREF(inner);
-    if (args == nullptr) return nullptr;
-    PyObject* u = PyTuple_Type.tp_new(reinterpret_cast<PyTypeObject*>(cls),
-                                      args, nullptr);
-    Py_DECREF(args);
+    // Update is a NamedTuple: no state beyond the tuple items, and its
+    // generated __new__ is a Python function — allocate the tuple
+    // subclass directly (what tuple.__new__ itself does) instead of
+    // calling it
+    PyTypeObject* t = reinterpret_cast<PyTypeObject*>(cls);
+    PyObject* u = t->tp_alloc(t, 3);
+    if (u == nullptr) return nullptr;
+    Py_INCREF(key);
+    Py_INCREF(values);
+    Py_INCREF(diff);
+    PyTuple_SET_ITEM(u, 0, key);
+    PyTuple_SET_ITEM(u, 1, values);
+    PyTuple_SET_ITEM(u, 2, diff);
     return u;
 }
 
@@ -3605,6 +3610,510 @@ PyObject* py_hnsw_len(PyObject*, PyObject* cap) {
     return PyLong_FromSize_t(H->n_alive);
 }
 
+// ---------------------------------------------------------------------------
+// Binary update framing for the inter-process exchange.
+//
+// The reference exchanges rows between worker processes as typed binary
+// frames (timely's exchange channels serialize records with abomonation,
+// external/timely-dataflow/communication/); the first TPU-build cluster
+// shipped pickled (key, values, diff) lists instead, which made the
+// 2-process wordcount *slower* than 1 process: pickling a Pointer
+// int-subclass goes through copyreg per object, and the receive side
+// rebuilt Update/Pointer objects in a per-row Python loop.  pack_updates
+// / unpack_updates replace that with a tagged-scalar wire format written
+// and parsed entirely in C++: 16 bytes of key, a zigzag-varint diff, and
+// one tag byte per value (int64 / double / utf8 / bytes / bool / None /
+// Pointer / nested tuple); anything outside the tag set (datetime,
+// ndarray, Json, wrapped objects) is embedded as a single-object pickle,
+// so the frame is always complete.
+
+PyObject* g_update_type = nullptr;   // engine.stream.Update (NamedTuple)
+PyObject* g_pickle_dumps = nullptr;  // pickle.dumps / loads for the
+PyObject* g_pickle_loads = nullptr;  // out-of-tag-set value fallback
+
+PyObject* py_set_update_type(PyObject*, PyObject* cls) {
+    Py_XDECREF(g_update_type);
+    Py_INCREF(cls);
+    g_update_type = cls;
+    if (g_pickle_dumps == nullptr) {
+        PyObject* pickle = PyImport_ImportModule("pickle");
+        if (pickle == nullptr) return nullptr;
+        g_pickle_dumps = PyObject_GetAttrString(pickle, "dumps");
+        g_pickle_loads = PyObject_GetAttrString(pickle, "loads");
+        Py_DECREF(pickle);
+        if (g_pickle_dumps == nullptr || g_pickle_loads == nullptr)
+            return nullptr;
+    }
+    Py_RETURN_NONE;
+}
+
+enum : uint8_t {
+    WT_NONE = 0,
+    WT_TRUE = 1,
+    WT_FALSE = 2,
+    WT_I64 = 3,     // 8 bytes LE
+    WT_F64 = 4,     // 8 bytes LE
+    WT_STR = 5,     // u32 len + utf8
+    WT_BYTES = 6,   // u32 len + raw
+    WT_POINTER = 7, // u8 len + unsigned LE
+    WT_TUPLE = 8,   // u8 arity + nested values
+    WT_PICKLE = 9,  // u32 len + pickle bytes
+};
+
+inline void wf_put_u32(std::string& b, uint32_t v) {
+    b.append(reinterpret_cast<const char*>(&v), 4);
+}
+inline void wf_put_u64(std::string& b, uint64_t v) {
+    b.append(reinterpret_cast<const char*>(&v), 8);
+}
+inline void wf_put_varint(std::string& b, long long sv) {
+    // zigzag + LEB128 (diffs are almost always ±1: one byte)
+    unsigned long long v =
+        (static_cast<unsigned long long>(sv) << 1) ^
+        static_cast<unsigned long long>(sv >> 63);
+    while (v >= 0x80) {
+        b.push_back(static_cast<char>(v | 0x80));
+        v >>= 7;
+    }
+    b.push_back(static_cast<char>(v));
+}
+
+bool wf_pack_value(std::string& buf, PyObject* v);  // fwd (tuples recurse)
+
+// u32 length fields cap any single value at 4 GiB; bigger ones abort the
+// pack (the cluster layer falls back to whole-frame pickle) instead of
+// writing a silently corrupt frame
+constexpr size_t kWfMaxLen = 0xFFFFFFFFu;
+
+bool wf_pack_pickled(std::string& buf, PyObject* v) {
+    if (g_pickle_dumps == nullptr) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "pack_updates: pickle fallback unregistered");
+        return false;
+    }
+    PyObject* data = PyObject_CallFunctionObjArgs(g_pickle_dumps, v, nullptr);
+    if (data == nullptr) return false;
+    char* p;
+    Py_ssize_t n;
+    if (PyBytes_AsStringAndSize(data, &p, &n) < 0) {
+        Py_DECREF(data);
+        return false;
+    }
+    if (static_cast<size_t>(n) > kWfMaxLen) {
+        Py_DECREF(data);
+        PyErr_SetString(PyExc_ValueError, "value too large for update frame");
+        return false;
+    }
+    buf.push_back(static_cast<char>(WT_PICKLE));
+    wf_put_u32(buf, static_cast<uint32_t>(n));
+    buf.append(p, static_cast<size_t>(n));
+    Py_DECREF(data);
+    return true;
+}
+
+bool wf_pack_value(std::string& buf, PyObject* v) {
+    if (v == Py_None) {
+        buf.push_back(static_cast<char>(WT_NONE));
+    } else if (v == Py_True) {
+        buf.push_back(static_cast<char>(WT_TRUE));
+    } else if (v == Py_False) {
+        buf.push_back(static_cast<char>(WT_FALSE));
+    } else if (g_pointer_type != nullptr &&
+               PyObject_TypeCheck(
+                   v, reinterpret_cast<PyTypeObject*>(g_pointer_type))) {
+        uint8_t kb[16];
+        if (pt_long_as_bytes_unsigned(v, kb, sizeof kb) < 0) {
+            PyErr_Clear();
+            return wf_pack_pickled(buf, v);
+        }
+        buf.push_back(static_cast<char>(WT_POINTER));
+        buf.push_back(static_cast<char>(sizeof kb));
+        buf.append(reinterpret_cast<const char*>(kb), sizeof kb);
+    } else if (PyLong_CheckExact(v)) {
+        int overflow = 0;
+        long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (overflow != 0 || (x == -1 && PyErr_Occurred())) {
+            PyErr_Clear();
+            return wf_pack_pickled(buf, v);  // >64-bit int: rare
+        }
+        buf.push_back(static_cast<char>(WT_I64));
+        wf_put_u64(buf, static_cast<uint64_t>(x));
+    } else if (PyFloat_CheckExact(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        buf.push_back(static_cast<char>(WT_F64));
+        uint64_t bits;
+        std::memcpy(&bits, &d, 8);
+        wf_put_u64(buf, bits);
+    } else if (PyUnicode_CheckExact(v)) {
+        Py_ssize_t n;
+        const char* s = PyUnicode_AsUTF8AndSize(v, &n);
+        if (s == nullptr) return false;
+        if (static_cast<size_t>(n) > kWfMaxLen) {
+            PyErr_SetString(PyExc_ValueError,
+                            "value too large for update frame");
+            return false;
+        }
+        buf.push_back(static_cast<char>(WT_STR));
+        wf_put_u32(buf, static_cast<uint32_t>(n));
+        buf.append(s, static_cast<size_t>(n));
+    } else if (PyBytes_CheckExact(v)) {
+        char* p;
+        Py_ssize_t n;
+        if (PyBytes_AsStringAndSize(v, &p, &n) < 0) return false;
+        if (static_cast<size_t>(n) > kWfMaxLen) {
+            PyErr_SetString(PyExc_ValueError,
+                            "value too large for update frame");
+            return false;
+        }
+        buf.push_back(static_cast<char>(WT_BYTES));
+        wf_put_u32(buf, static_cast<uint32_t>(n));
+        buf.append(p, static_cast<size_t>(n));
+    } else if (PyTuple_CheckExact(v) && PyTuple_GET_SIZE(v) < 255) {
+        buf.push_back(static_cast<char>(WT_TUPLE));
+        buf.push_back(static_cast<char>(PyTuple_GET_SIZE(v)));
+        for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(v); i++) {
+            if (!wf_pack_value(buf, PyTuple_GET_ITEM(v, i))) return false;
+        }
+    } else {
+        return wf_pack_pickled(buf, v);  // datetime/ndarray/Json/...
+    }
+    return true;
+}
+
+PyObject* py_pack_updates(PyObject*, PyObject* batch) {
+    PyObject* seq = PySequence_Fast(batch, "pack_updates expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    std::string buf;
+    buf.reserve(static_cast<size_t>(n) * 48 + 8);
+    wf_put_u32(buf, static_cast<uint32_t>(n));
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        PyObject* key = PyTuple_GET_ITEM(u, 0);
+        PyObject* values = PyTuple_GET_ITEM(u, 1);
+        PyObject* diff = PyTuple_GET_ITEM(u, 2);
+        uint8_t kb[16];
+        if (pt_long_as_bytes_unsigned(key, kb, sizeof kb) < 0) {
+            Py_DECREF(seq);
+            return nullptr;  // keys are 128-bit non-negative by contract
+        }
+        buf.append(reinterpret_cast<const char*>(kb), sizeof kb);
+        long long d = PyLong_AsLongLong(diff);
+        if (d == -1 && PyErr_Occurred()) {
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        wf_put_varint(buf, d);
+        if (PyTuple_CheckExact(values) && PyTuple_GET_SIZE(values) < 255) {
+            buf.push_back(static_cast<char>(PyTuple_GET_SIZE(values)));
+            bool ok = true;
+            for (Py_ssize_t j = 0; ok && j < PyTuple_GET_SIZE(values); j++) {
+                ok = wf_pack_value(buf, PyTuple_GET_ITEM(values, j));
+            }
+            if (!ok) {
+                Py_DECREF(seq);
+                return nullptr;
+            }
+        } else {
+            // not a plain small tuple (Update.values is by contract, but
+            // stay total): whole-values pickle
+            buf.push_back(static_cast<char>(0xFF));
+            if (!wf_pack_pickled(buf, values)) {
+                Py_DECREF(seq);
+                return nullptr;
+            }
+        }
+    }
+    Py_DECREF(seq);
+    return PyBytes_FromStringAndSize(buf.data(),
+                                     static_cast<Py_ssize_t>(buf.size()));
+}
+
+struct WfReader {
+    const uint8_t* p;
+    const uint8_t* end;
+    bool fail = false;
+
+    bool need(size_t n) {
+        // sticky: a failed length read must poison the zero-length
+        // bytes() that follows it, or truncated frames decode as ''
+        if (fail || static_cast<size_t>(end - p) < n) {
+            fail = true;
+            return false;
+        }
+        return true;
+    }
+    uint32_t u32() {
+        if (!need(4)) return 0;
+        uint32_t v;
+        std::memcpy(&v, p, 4);
+        p += 4;
+        return v;
+    }
+    uint64_t u64() {
+        if (!need(8)) return 0;
+        uint64_t v;
+        std::memcpy(&v, p, 8);
+        p += 8;
+        return v;
+    }
+    uint8_t u8() {
+        if (!need(1)) return 0;
+        return *p++;
+    }
+    long long varint() {
+        unsigned long long v = 0;
+        int shift = 0;
+        while (true) {
+            if (!need(1)) return 0;
+            uint8_t b = *p++;
+            v |= static_cast<unsigned long long>(b & 0x7F) << shift;
+            if ((b & 0x80) == 0) break;
+            shift += 7;
+            if (shift > 63) {
+                fail = true;
+                return 0;
+            }
+        }
+        return static_cast<long long>(v >> 1) ^
+               -static_cast<long long>(v & 1);
+    }
+    const uint8_t* bytes(size_t n) {
+        if (!need(n)) return nullptr;
+        const uint8_t* q = p;
+        p += n;
+        return q;
+    }
+};
+
+PyObject* wf_unpack_value(WfReader& r) {
+    uint8_t tag = r.u8();
+    if (r.fail) {
+        PyErr_SetString(PyExc_ValueError, "truncated update frame");
+        return nullptr;
+    }
+    switch (tag) {
+        case WT_NONE:
+            Py_RETURN_NONE;
+        case WT_TRUE:
+            Py_RETURN_TRUE;
+        case WT_FALSE:
+            Py_RETURN_FALSE;
+        case WT_I64: {
+            uint64_t v = r.u64();
+            if (r.fail) break;
+            return PyLong_FromLongLong(static_cast<long long>(v));
+        }
+        case WT_F64: {
+            uint64_t bits = r.u64();
+            if (r.fail) break;
+            double d;
+            std::memcpy(&d, &bits, 8);
+            return PyFloat_FromDouble(d);
+        }
+        case WT_STR: {
+            uint32_t n = r.u32();
+            const uint8_t* s = r.bytes(n);
+            if (s == nullptr) break;
+            return PyUnicode_DecodeUTF8(reinterpret_cast<const char*>(s),
+                                        static_cast<Py_ssize_t>(n), nullptr);
+        }
+        case WT_BYTES: {
+            uint32_t n = r.u32();
+            const uint8_t* s = r.bytes(n);
+            if (s == nullptr) break;
+            return PyBytes_FromStringAndSize(
+                reinterpret_cast<const char*>(s), static_cast<Py_ssize_t>(n));
+        }
+        case WT_POINTER: {
+            uint8_t klen = r.u8();
+            const uint8_t* kb = r.bytes(klen);
+            if (kb == nullptr) break;
+            PyObject* num = pt_long_from_bytes_unsigned(kb, klen);
+            if (num == nullptr || g_pointer_type == nullptr) return num;
+            PyObject* ptr =
+                PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
+            Py_DECREF(num);
+            return ptr;
+        }
+        case WT_TUPLE: {
+            uint8_t arity = r.u8();
+            if (r.fail) break;
+            PyObject* t = PyTuple_New(arity);
+            if (t == nullptr) return nullptr;
+            for (uint8_t i = 0; i < arity; i++) {
+                PyObject* item = wf_unpack_value(r);
+                if (item == nullptr) {
+                    Py_DECREF(t);
+                    return nullptr;
+                }
+                PyTuple_SET_ITEM(t, i, item);
+            }
+            return t;
+        }
+        case WT_PICKLE: {
+            uint32_t n = r.u32();
+            const uint8_t* s = r.bytes(n);
+            if (s == nullptr || g_pickle_loads == nullptr) break;
+            PyObject* data = PyBytes_FromStringAndSize(
+                reinterpret_cast<const char*>(s), static_cast<Py_ssize_t>(n));
+            if (data == nullptr) return nullptr;
+            PyObject* v =
+                PyObject_CallFunctionObjArgs(g_pickle_loads, data, nullptr);
+            Py_DECREF(data);
+            return v;
+        }
+        default:
+            PyErr_Format(PyExc_ValueError, "bad value tag %d in frame",
+                         static_cast<int>(tag));
+            return nullptr;
+    }
+    PyErr_SetString(PyExc_ValueError, "truncated update frame");
+    return nullptr;
+}
+
+PyObject* py_unpack_updates(PyObject*, PyObject* arg) {
+    char* data;
+    Py_ssize_t nbytes;
+    if (PyBytes_AsStringAndSize(arg, &data, &nbytes) < 0) return nullptr;
+    if (g_update_type == nullptr || g_pointer_type == nullptr) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "unpack_updates: Update/Pointer types unregistered");
+        return nullptr;
+    }
+    WfReader r{reinterpret_cast<const uint8_t*>(data),
+               reinterpret_cast<const uint8_t*>(data) + nbytes};
+    uint32_t n = r.u32();
+    if (r.fail) {
+        PyErr_SetString(PyExc_ValueError, "truncated update frame");
+        return nullptr;
+    }
+    PyObject* out = PyList_New(static_cast<Py_ssize_t>(n));
+    if (out == nullptr) return nullptr;
+    for (uint32_t i = 0; i < n; i++) {
+        const uint8_t* kb = r.bytes(16);
+        long long diff = r.varint();
+        uint8_t nvals = r.u8();
+        if (kb == nullptr || r.fail) {
+            PyErr_SetString(PyExc_ValueError, "truncated update frame");
+            goto fail;
+        }
+        {
+            PyObject* values;
+            if (nvals == 0xFF) {
+                values = wf_unpack_value(r);  // whole-values pickle
+            } else {
+                values = PyTuple_New(nvals);
+                for (uint8_t j = 0; values != nullptr && j < nvals; j++) {
+                    PyObject* v = wf_unpack_value(r);
+                    if (v == nullptr) {
+                        Py_DECREF(values);
+                        values = nullptr;
+                        break;
+                    }
+                    PyTuple_SET_ITEM(values, j, v);
+                }
+            }
+            if (values == nullptr) goto fail;
+            PyObject* num = pt_long_from_bytes_unsigned(kb, 16);
+            if (num == nullptr) {
+                Py_DECREF(values);
+                goto fail;
+            }
+            PyObject* key =
+                PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
+            Py_DECREF(num);
+            if (key == nullptr) {
+                Py_DECREF(values);
+                goto fail;
+            }
+            PyObject* dobj = PyLong_FromLongLong(diff);
+            if (dobj == nullptr) {
+                Py_DECREF(values);
+                Py_DECREF(key);
+                goto fail;
+            }
+            // Update is a NamedTuple whose generated __new__ is a Python
+            // function — calling it per row costs more than the whole
+            // parse.  It adds no state beyond the tuple items, so
+            // allocate the tuple subclass directly (exactly what
+            // tuple.__new__ does) and steal the refs.
+            PyTypeObject* ut = reinterpret_cast<PyTypeObject*>(g_update_type);
+            PyObject* u = ut->tp_alloc(ut, 3);
+            if (u == nullptr) {
+                Py_DECREF(values);
+                Py_DECREF(key);
+                Py_DECREF(dobj);
+                goto fail;
+            }
+            PyTuple_SET_ITEM(u, 0, key);
+            PyTuple_SET_ITEM(u, 1, values);
+            PyTuple_SET_ITEM(u, 2, dobj);
+            PyList_SET_ITEM(out, static_cast<Py_ssize_t>(i), u);
+        }
+    }
+    return out;
+fail:
+    Py_DECREF(out);
+    return nullptr;
+}
+
+PyObject* py_capture_batch(PyObject*, PyObject* args) {
+    // CaptureNode epoch pass: stream.append((key, values, time, diff))
+    // and rows[key] = values / del rows[key] for every update, in one C
+    // loop — the per-row Python version dominates capture-terminated
+    // pipelines (the select+filter bench spent more time here than in
+    // the expression VM).
+    PyObject *stream, *rows, *batch, *time_obj;
+    if (!PyArg_ParseTuple(args, "OOOO", &stream, &rows, &batch, &time_obj))
+        return nullptr;
+    if (!PyList_Check(stream) || !PyDict_Check(rows)) {
+        PyErr_SetString(PyExc_TypeError, "capture state must be list+dict");
+        return nullptr;
+    }
+    PyObject* seq = PySequence_Fast(batch, "capture expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        PyObject* key = PyTuple_GET_ITEM(u, 0);
+        PyObject* values = PyTuple_GET_ITEM(u, 1);
+        PyObject* diff = PyTuple_GET_ITEM(u, 2);
+        PyObject* rec = PyTuple_Pack(4, key, values, time_obj, diff);
+        if (rec == nullptr || PyList_Append(stream, rec) < 0) {
+            Py_XDECREF(rec);
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        Py_DECREF(rec);
+        long long d = PyLong_AsLongLong(diff);
+        if (d == -1 && PyErr_Occurred()) {
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        if (d > 0) {
+            if (PyDict_SetItem(rows, key, values) < 0) {
+                Py_DECREF(seq);
+                return nullptr;
+            }
+        } else {
+            if (PyDict_DelItem(rows, key) < 0) PyErr_Clear();
+        }
+    }
+    Py_DECREF(seq);
+    Py_RETURN_NONE;
+}
+
 PyMethodDef kMethods[] = {
     {"ref_scalar", py_ref_scalar, METH_VARARGS,
      "128-bit key hash of the argument values"},
@@ -3642,6 +4151,14 @@ PyMethodDef kMethods[] = {
      "register the Pointer class for type-tagged hashing"},
     {"set_json_type", py_set_json_type, METH_O,
      "register the Json class for VM convert/get semantics"},
+    {"set_update_type", py_set_update_type, METH_O,
+     "register the Update class for binary exchange frames"},
+    {"pack_updates", py_pack_updates, METH_O,
+     "serialize an update batch to a tagged binary frame"},
+    {"capture_batch", py_capture_batch, METH_VARARGS,
+     "apply an update batch to capture state (stream list + rows dict)"},
+    {"unpack_updates", py_unpack_updates, METH_O,
+     "parse a tagged binary frame back into Update objects"},
     {"vm_compile", py_vm_compile, METH_VARARGS,
      "compile an expression bytecode program to a capsule"},
     {"vm_eval_batch", py_vm_eval_batch, METH_VARARGS,

@@ -35,6 +35,7 @@ import time as _time
 from typing import Any, Callable
 
 from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import native as _native_mod
 
 __all__ = ["Cluster", "stable_shard"]
 
@@ -220,25 +221,52 @@ class Cluster:
         self._barrier.wait()
         if thread_id == 0:
             local = self._local.pop(slot)
-            # remote: payload[src_tid][dst_tid] = updates as PLAIN
-            # (int_key, values, diff) tuples — pickling the Pointer
-            # int-subclass goes through per-object copyreg and measures
-            # ~6x slower to serialize; the receiver rewraps.  In-process
-            # workers share memory and skip all of this.
+            # remote frame: ("b", payload) with payload[src_tid][dst_tid]
+            # a binary update frame packed in one C++ pass (tagged
+            # scalars; see native pack_updates) — the reference's timely
+            # exchange serializes records in binary the same way
+            # (external/timely-dataflow/communication/).  Without the
+            # native module: ("p", nested lists of plain (int_key,
+            # values, diff) tuples) — pickling the Pointer int-subclass
+            # directly goes through per-object copyreg and measures ~6x
+            # slower.  In-process workers share memory and skip all of
+            # this.
             if self._links is not None:
+                native = _native_mod.load()
                 for peer in range(P):
                     if peer == self.process_id:
                         continue
-                    payload = [
-                        [
+                    payload: Any = None
+                    if native is not None:
+                        try:
+                            payload = (
+                                "b",
+                                [
+                                    [
+                                        native.pack_updates(
+                                            local[src_tid][peer * T + dst_tid]
+                                        )
+                                        for dst_tid in range(T)
+                                    ]
+                                    for src_tid in range(T)
+                                ],
+                            )
+                        except Exception:
+                            payload = None
+                    if payload is None:
+                        payload = (
+                            "p",
                             [
-                                (int(u[0]), u[1], u[2])
-                                for u in local[src_tid][peer * T + dst_tid]
-                            ]
-                            for dst_tid in range(T)
-                        ]
-                        for src_tid in range(T)
-                    ]
+                                [
+                                    [
+                                        (int(u[0]), u[1], u[2])
+                                        for u in local[src_tid][peer * T + dst_tid]
+                                    ]
+                                    for dst_tid in range(T)
+                                ]
+                                for src_tid in range(T)
+                            ],
+                        )
                     self._links.send(peer, slot, payload)
                 remote = self._links.recv_from_all(slot)
             else:
@@ -252,15 +280,34 @@ class Cluster:
                         for dst_tid in range(T):
                             merged[dst_tid].extend(boxes[base + dst_tid])
                     else:
-                        from pathway_tpu.engine.stream import Update
-                        from pathway_tpu.internals.keys import Pointer
+                        kind, payload = remote[src_pid]
+                        if kind == "b":
+                            native = _native_mod.load()
+                            if native is None:
+                                # peer packed binary frames we cannot parse
+                                # (native load failed only on THIS process,
+                                # e.g. a corrupted build cache): fail loudly
+                                # rather than AttributeError on None
+                                raise RuntimeError(
+                                    "cluster exchange: peer sent binary "
+                                    "frames but the native module is "
+                                    "unavailable in this process"
+                                )
+                            for dst_tid in range(T):
+                                merged[dst_tid].extend(
+                                    native.unpack_updates(
+                                        payload[src_tid][dst_tid]
+                                    )
+                                )
+                        else:
+                            from pathway_tpu.engine.stream import Update
+                            from pathway_tpu.internals.keys import Pointer
 
-                        payload = remote[src_pid]
-                        for dst_tid in range(T):
-                            merged[dst_tid].extend(
-                                Update(Pointer(k), v, d)
-                                for k, v, d in payload[src_tid][dst_tid]
-                            )
+                            for dst_tid in range(T):
+                                merged[dst_tid].extend(
+                                    Update(Pointer(k), v, d)
+                                    for k, v, d in payload[src_tid][dst_tid]
+                                )
             with self._lock:
                 self._merged[slot] = merged
         self._barrier.wait()

@@ -1413,6 +1413,10 @@ class CaptureNode(Node):
 
     def process(self, ctx, time, inbatches):
         st = ctx.state(self)
+        native = _native.load()
+        if native is not None:
+            native.capture_batch(st["stream"], st["rows"], inbatches[0], time)
+            return []
         for u in inbatches[0]:
             st["stream"].append((u.key, u.values, time, u.diff))
             if u.diff > 0:

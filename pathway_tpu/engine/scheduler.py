@@ -20,11 +20,12 @@ synchronous analogue of timely progress tracking.
 
 from __future__ import annotations
 
+import os as _os
 import queue
 import threading
 import time as _time
 from collections import defaultdict
-from typing import Any
+from typing import Any, Callable
 
 from pathway_tpu.engine.cluster import Cluster
 from pathway_tpu.engine.graph import EngineGraph, InputNode, Node, RunContext
@@ -32,6 +33,21 @@ from pathway_tpu.engine.stream import TIME_STEP, Batch, Update
 from pathway_tpu.internals import api
 from pathway_tpu.internals import native as _native
 from pathway_tpu.internals.keys import Pointer
+
+def _build_adds(rows: Any) -> list:
+    """Bulk ``Update(key, values, +1)`` construction (static-row injection
+    is a million-row listcomp of NamedTuple calls in big debug tables)."""
+    native = _native.load()
+    if native is not None:
+        try:
+            return native.build_adds(rows, Update)
+        except Exception:
+            pass
+    return [Update(k, v, 1) for k, v in rows]
+
+
+#: dev knob: per-round cluster trace on stderr (timing the epoch loop)
+_EPOCH_TRACE = _os.environ.get("PATHWAY_EPOCH_TRACE") == "1"
 
 
 class ConnectorEvents:
@@ -80,12 +96,7 @@ class ConnectorEvents:
         scheduler's epoch work."""
         if rows:
             self.stats["rows"] += len(rows)
-            native = _native.load()
-            if native is not None:
-                batch = native.build_adds(rows, Update)
-            else:
-                batch = [Update(k, v, 1) for k, v in rows]
-            self._q.put((self._node_id, "batch", batch, None))
+            self._q.put((self._node_id, "batch", _build_adds(rows), None))
 
     def commit(self) -> None:
         self.stats["commits"] += 1
@@ -121,6 +132,9 @@ class Scheduler:
         self._stop = threading.Event()
         #: persistence hooks (set by pathway_tpu.persistence.attach_persistence)
         self.persistence: Any = None
+        #: epoch-boundary GC sweep hook (set by internals.run._ManagedGc);
+        #: called between epochs when transient row data is already dead
+        self.gc_tick: Callable[[], None] | None = None
         #: per-worker wall time of the last operator snapshot (rate limit)
         self._last_snapshot_at: dict[int, float] = {}
         #: per-connector counters keyed by input name (monitoring)
@@ -417,9 +431,7 @@ class Scheduler:
         for node in self.graph.nodes:
             if isinstance(node, InputNode):
                 if node.static_rows:
-                    static_inject[node.id] = [
-                        Update(k, v, 1) for k, v in node.static_rows
-                    ]
+                    static_inject[node.id] = _build_adds(node.static_rows)
                 if node.subject is not None:
                     live_inputs.append(node)
 
@@ -605,6 +617,8 @@ class Scheduler:
                     consumed[nid] = consumed.get(nid, 0) + len(b)
                 self.run_epoch(t, inject)
                 t += TIME_STEP
+                if self.gc_tick is not None:
+                    self.gc_tick()
                 # post-epoch timestamp: the cut timer measures idle/buffer
                 # time, not epoch processing time
                 last_cut = _time.monotonic()
@@ -694,9 +708,7 @@ class Scheduler:
             if not isinstance(node, InputNode):
                 continue
             if node.static_rows and w == 0:
-                static_inject[node.id] = [
-                    Update(k, v, 1) for k, v in node.static_rows
-                ]
+                static_inject[node.id] = _build_adds(node.static_rows)
             if node.subject is None:
                 continue
             live_node_ids.add(node.id)
@@ -811,7 +823,17 @@ class Scheduler:
                 tuple(sorted(nid for nid, b in buffers.items() if b)),
                 snap_elapsed_ms,
             )
+            _tr0 = _time.monotonic()
             statuses = cluster.allgather(("s", round_no), tid, status)
+            if _EPOCH_TRACE:
+                import sys as _sys
+
+                _sys.stderr.write(
+                    f"[trace w{w}] round {round_no} allgather "
+                    f"{(_time.monotonic() - _tr0)*1e3:.1f}ms "
+                    f"buf={sum(len(b) for b in buffers.values())} "
+                    f"t={_time.monotonic():.3f}\n"
+                )
             round_no += 1
             any_data = any(s[0] for s in statuses)
             all_closed = all(s[1] == 0 for s in statuses)
@@ -841,6 +863,8 @@ class Scheduler:
                     active=self.active_closure(buffered_ids),
                 )
                 t += TIME_STEP
+                if tid == 0 and self.gc_tick is not None:
+                    self.gc_tick()  # gc is process-wide: one thread sweeps
                 last_cut = _time.monotonic()
                 if (
                     self.persistence is not None
@@ -856,8 +880,11 @@ class Scheduler:
             elif stop or (source_done and not any_data):
                 break
             else:
-                # pace the next status round: batch up to ~autocommit_ms
-                _time.sleep(self.autocommit_ms / 1000.0 / 5.0)
+                # pace the next status round: a status round is one small
+                # allgather (~sub-ms on localhost), so cap the idle sleep
+                # at 10ms — a 200ms autocommit must not add 40ms of
+                # latency to every drain step
+                _time.sleep(min(self.autocommit_ms / 5.0, 10.0) / 1000.0)
         ctx.time = t
         self._finish(
             ctx=ctx, cluster=cluster, tid=tid,

@@ -28,19 +28,46 @@ _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 def _compile() -> str | None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     so_path = os.path.join(_BUILD_DIR, "pathway_native.so")
-    if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(_SRC):
+    # cross-PROCESS build lock + atomic rename: spawned cluster workers
+    # all race through here on a cold cache; without it two g++ runs write
+    # the same .so and a third process dlopens the torn file
+    lock_path = so_path + ".lock"
+    import contextlib
+
+    @contextlib.contextmanager
+    def _build_lock():
+        try:
+            import fcntl
+
+            with open(lock_path, "w") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+        except ImportError:  # non-POSIX: best effort, rename is still atomic
+            yield
+
+    with _build_lock():
+        if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(_SRC):
+            return so_path
+        include = sysconfig.get_paths()["include"]
+        tmp_path = f"{so_path}.{os.getpid()}.tmp"
+        cmd = [
+            "g++", "-O3", "-march=native", "-funroll-loops", "-shared", "-fPIC",
+            "-std=c++17", f"-I{include}", _SRC, "-o", tmp_path,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp_path, so_path)
+        except Exception as e:  # noqa: BLE001
+            _logger.info("native build skipped: %r", e)
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+            return None
         return so_path
-    include = sysconfig.get_paths()["include"]
-    cmd = [
-        "g++", "-O3", "-march=native", "-funroll-loops", "-shared", "-fPIC",
-        "-std=c++17", f"-I{include}", _SRC, "-o", so_path,
-    ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-    except Exception as e:  # noqa: BLE001
-        _logger.info("native build skipped: %r", e)
-        return None
-    return so_path
 
 
 def load() -> Any:
@@ -76,6 +103,9 @@ def load() -> Any:
 
             mod.set_pointer_type(Pointer)
             mod.set_json_type(Json)
+            from pathway_tpu.engine.stream import Update
+
+            mod.set_update_type(Update)
             mod._json_registered = True
         except Exception:  # registration failure only disables fast paths
             mod._json_registered = False

@@ -127,7 +127,8 @@ def _run_inner(
     telemetry = get_telemetry()
     with telemetry.span(
         "graph_runner.run", operators=len(G.engine_graph.nodes)
-    ), _ManagedGc():
+    ), _ManagedGc() as mgc:
+        sched.gc_tick = mgc.maybe_sweep
         if threads * processes > 1:
             # multi-worker topology from the spawn env contract
             # (PATHWAY_THREADS × PATHWAY_PROCESSES, reference config.rs:86-120)
@@ -163,56 +164,80 @@ class _ManagedGc:
     rows/s on the 400k-line benchmark).  The reference engine has no such
     pauses — Rust frees rows deterministically (src/engine/dataflow.rs) —
     so the TPU build's host runtime disables *automatic* collection for
-    the duration of the run and sweeps young generations from a timed
-    caretaker thread instead: cycle garbage stays bounded, with no
-    per-allocation pauses.  Plain reference-counted garbage (the vast
-    majority of row data) is unaffected — it is freed immediately either
-    way.  Opt out with PATHWAY_GC_INTERVAL_S=0; a user who already
-    disabled gc keeps their setting untouched.
+    the duration of the run and sweeps at EPOCH BOUNDARIES instead (the
+    scheduler calls :meth:`maybe_sweep` after each epoch).  Mid-epoch
+    sweeps — the first design ran them from a timed caretaker thread —
+    walk every transient row tuple alive inside the epoch and hold the
+    GIL against the exchange reader threads, stalling peer processes; at
+    the boundary the transients are already refcount-freed, so a sweep
+    only walks live survivors (reducer state, buffers).  Startup objects
+    (modules, the graph, jax internals — ~1M containers) are frozen out
+    of the collector entirely for the run, and JAX's per-collection gc
+    callback is detached while automatic collection is off.  Plain
+    reference-counted garbage (the vast majority of row data) is freed
+    immediately either way.  Opt out with PATHWAY_GC_INTERVAL_S=0; a
+    user who already disabled gc keeps their setting untouched.
     """
 
     def __init__(self) -> None:
         import gc
         import os
+        import time
 
         self._gc = gc
+        self._time = time
         try:
-            self._interval = float(os.environ.get("PATHWAY_GC_INTERVAL_S", "1.5"))
+            self._interval = float(os.environ.get("PATHWAY_GC_INTERVAL_S", "2.0"))
         except ValueError:
-            self._interval = 1.5
+            self._interval = 2.0
         self._was_enabled = False
-        self._stop: Any = None
+        self._last_sweep = 0.0
+        self._sweeps = 0
+        self._detached_callbacks: list[Any] = []
 
     def __enter__(self) -> "_ManagedGc":
         if self._interval <= 0 or not self._gc.isenabled():
             return self
-        import threading
-
         self._was_enabled = True
         self._gc.disable()
-        self._stop = threading.Event()
-
-        def caretaker(stop: Any, gc: Any, interval: float) -> None:
-            sweeps = 0
-            while not stop.wait(interval):
-                sweeps += 1
-                # young generations every sweep; a full collection every
-                # 8th so gen-2 cycles (promoted survivors) cannot leak
-                # for the lifetime of a long streaming run
-                gc.collect(2 if sweeps % 8 == 0 else 1)
-
-        t = threading.Thread(
-            target=caretaker,
-            args=(self._stop, self._gc, self._interval),
-            name="pathway-gc",
-            daemon=True,
-        )
-        t.start()
+        # jax registers a gc callback that runs on every collection
+        # (measured ~125ms each on this host); with automatic collection
+        # off, our explicit sweeps don't need it either
+        for cb in list(self._gc.callbacks):
+            if "jax" in (getattr(cb, "__module__", "") or ""):
+                self._gc.callbacks.remove(cb)
+                self._detached_callbacks.append(cb)
+        # clean the YOUNG generations, then freeze everything into the
+        # permanent generation.  A full collect here walks gen-2 — with a
+        # million-row static table that is ~1s before the run even starts
+        # — for the sole benefit of not freezing old cyclic garbage; that
+        # garbage is bounded (startup imports) and unfreezes at exit.
+        self._gc.collect(1)
+        self._gc.freeze()
+        self._last_sweep = self._time.monotonic()
         return self
+
+    def maybe_sweep(self) -> None:
+        """Sweep cycles if the interval elapsed — called by the scheduler
+        between epochs, when transient row data is already dead."""
+        if not self._was_enabled:
+            return
+        now = self._time.monotonic()
+        if now - self._last_sweep < self._interval:
+            return
+        self._sweeps += 1
+        # young generations every sweep; a full collection every 8th so
+        # gen-2 cycles (promoted survivors) cannot leak over a long
+        # streaming run
+        self._gc.collect(2 if self._sweeps % 8 == 0 else 1)
+        self._last_sweep = self._time.monotonic()
 
     def __exit__(self, *exc: Any) -> None:
         if self._was_enabled:
-            self._stop.set()
+            self._gc.unfreeze()
+            for cb in self._detached_callbacks:
+                self._gc.callbacks.append(cb)
+            self._detached_callbacks.clear()
             self._gc.enable()
 
 
