@@ -30,8 +30,10 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <datetime.h>
 
 #include <algorithm>
+#include <cctype>
 #include <climits>
 #include <cmath>
 #include <cstdint>
@@ -1690,6 +1692,1039 @@ PyObject* py_set_json_type(PyObject*, PyObject* cls) {
     Py_RETURN_NONE;
 }
 
+// ---------------------------------------------------------------------------
+// Native namespace methods (.str / .dt / .num).
+//
+// The reference evaluates DateTime/Duration/String expression enums
+// entirely in Rust (src/engine/expression.rs:26-340); the first VM
+// shipped every namespace method as a per-row CALL_PY closure.  These
+// implementations move the high-traffic methods into the VM: Python
+// semantics are pinned by the closure lambdas in
+// internals/expressions.py and the differential tests in
+// tests/test_expr_vm.py — on any input outside a method's native domain
+// the op either falls through to calling the underlying Python method
+// on the single value, or produces ERROR exactly where the closure
+// would.
+
+enum VmMethod : int64_t {
+    M_STR_LOWER = 0, M_STR_UPPER, M_STR_SWAPCASE, M_STR_TITLE,
+    M_STR_REVERSED, M_STR_LEN,
+    M_STR_STRIP, M_STR_LSTRIP, M_STR_RSTRIP,   // arity 1 or 2
+    M_STR_COUNT, M_STR_FIND, M_STR_RFIND,      // find: arity 3 or 4
+    M_STR_STARTSWITH, M_STR_ENDSWITH,
+    M_STR_REPLACE, M_STR_SLICE,
+    M_STR_PARSE_INT, M_STR_PARSE_INT_OPT,
+    M_STR_PARSE_FLOAT, M_STR_PARSE_FLOAT_OPT,
+    M_STR_PARSE_BOOL, M_STR_PARSE_BOOL_OPT,
+    M_STR_PARSE_DATETIME,                      // (s, fmt)
+    M_DT_NANOSECOND, M_DT_MICROSECOND, M_DT_MILLISECOND,
+    M_DT_SECOND, M_DT_MINUTE, M_DT_HOUR,
+    M_DT_DAY, M_DT_MONTH, M_DT_YEAR,
+    M_DT_DAY_OF_WEEK, M_DT_DAY_OF_YEAR,
+    M_DT_TIMESTAMP,                            // (d, scale)
+    M_DT_STRFTIME,                             // (d, fmt)
+    M_DT_ROUND, M_DT_FLOOR,                    // (d, duration)
+    M_DUR_NANOSECONDS, M_DUR_MICROSECONDS, M_DUR_MILLISECONDS,
+    M_DUR_SECONDS, M_DUR_MINUTES, M_DUR_HOURS, M_DUR_DAYS, M_DUR_WEEKS,
+    M_NUM_ABS, M_NUM_FILL_NA,
+    M_METHOD_COUNT,
+};
+
+// Hinnant's civil-date algorithms (public domain): proleptic Gregorian
+// days since 1970-01-01.
+inline int64_t days_from_civil(int64_t y, int64_t m, int64_t d) {
+    y -= m <= 2;
+    const int64_t era = (y >= 0 ? y : y - 399) / 400;
+    const int64_t yoe = y - era * 400;
+    const int64_t doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+    const int64_t doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+    return era * 146097 + doe - 719468;
+}
+
+inline void civil_from_days(int64_t z, int64_t* y, int64_t* m, int64_t* d) {
+    z += 719468;
+    const int64_t era = (z >= 0 ? z : z - 146096) / 146097;
+    const int64_t doe = z - era * 146097;
+    const int64_t yoe = (doe - doe / 1460 + doe / 36524 - doe / 146096) / 365;
+    const int64_t yy = yoe + era * 400;
+    const int64_t doy = doe - (365 * yoe + yoe / 4 - yoe / 100);
+    const int64_t mp = (5 * doy + 2) / 153;
+    *d = doy - (153 * mp + 2) / 5 + 1;
+    *m = mp + (mp < 10 ? 3 : -9);
+    *y = yy + (*m <= 2);
+}
+
+inline bool is_leap(int64_t y) {
+    return (y % 4 == 0 && y % 100 != 0) || y % 400 == 0;
+}
+
+const int kDaysBeforeMonth[13] = {0, 0,   31,  59,  90,  120, 151,
+                                  181, 212, 243, 273, 304, 334};
+
+// timedelta.total_seconds() double formula, replicated bit-for-bit
+inline double td_total_seconds(int64_t days, int64_t secs, int64_t us) {
+    return ((double)(days * 86400 + secs) * 1e6 + (double)us) / 1e6;
+}
+
+PyObject* g_dt_module_cache = nullptr;  // datetime module (strptime fallback)
+PyObject* g_utc_singleton = nullptr;    // datetime.timezone.utc
+
+bool ensure_datetime_cache() {
+    if (g_dt_module_cache != nullptr) return true;
+    PyObject* mod = PyImport_ImportModule("datetime");
+    if (mod == nullptr) return false;
+    PyObject* tz = PyObject_GetAttrString(mod, "timezone");
+    if (tz == nullptr) {
+        Py_DECREF(mod);
+        return false;
+    }
+    g_utc_singleton = PyObject_GetAttrString(tz, "utc");
+    Py_DECREF(tz);
+    if (g_utc_singleton == nullptr) {
+        Py_DECREF(mod);
+        return false;
+    }
+    g_dt_module_cache = mod;
+    return true;
+}
+
+// ---- strptime (Python datetime.strptime semantics for the common
+// directives; anything else falls back to the Python function) ----------
+
+struct StrpResult {
+    int64_t year = 1900, month = 1, day = 1;
+    int64_t hour = 0, minute = 0, second = 0, us = 0;
+    int64_t yday = -1;      // %j
+    int hour12 = -1;        // %I
+    int ampm = -1;          // %p: 0 AM, 1 PM
+    bool has_tz = false;
+    int64_t tz_off_s = 0;   // %z seconds east
+    int64_t tz_off_us = 0;
+};
+
+// parse up to `maxd` ASCII digits (at least 1); returns count or 0
+inline int parse_digits(const char* p, const char* end, int maxd,
+                        int64_t* out) {
+    int n = 0;
+    int64_t v = 0;
+    while (n < maxd && p + n < end && p[n] >= '0' && p[n] <= '9') {
+        v = v * 10 + (p[n] - '0');
+        n++;
+    }
+    if (n == 0) return 0;
+    *out = v;
+    return n;
+}
+
+// Returns: 1 parsed, 0 format has an unsupported directive (caller falls
+// back to Python strptime), -1 value does not match (ValueError).
+int c_strptime(const char* s, Py_ssize_t slen, const char* f,
+               Py_ssize_t flen, StrpResult* R) {
+    const char* p = s;
+    const char* pe = s + slen;
+    const char* q = f;
+    const char* qe = f + flen;
+    while (q < qe) {
+        char c = *q++;
+        if (c != '%') {
+            if ((unsigned char)c >= 0x80)
+                return 0;  // non-ASCII literal: Unicode-aware IGNORECASE
+                           // matching is Python's business
+            if (c == ' ' || c == '\t' || c == '\n' || c == '\r' ||
+                c == '\f' || c == '\v') {
+                // Python compiles literal whitespace in the format to
+                // \s+ (Lib/_strptime.py TimeRE.pattern)
+                if (p >= pe || !isspace((unsigned char)*p)) return -1;
+                while (p < pe && isspace((unsigned char)*p)) p++;
+                while (q < qe && isspace((unsigned char)*q)) q++;
+                continue;
+            }
+            // _strptime compiles the pattern with re.IGNORECASE, so
+            // literal letters match either case
+            if (p >= pe ||
+                tolower((unsigned char)*p) != tolower((unsigned char)c))
+                return -1;
+            p++;
+            continue;
+        }
+        if (q >= qe) return 0;  // trailing % — let Python raise its error
+        char d = *q++;
+        int n;
+        switch (d) {
+            case 'Y':
+                n = parse_digits(p, pe, 4, &R->year);
+                if (n == 0) return -1;
+                p += n;
+                break;
+            case 'y':
+                n = parse_digits(p, pe, 2, &R->year);
+                if (n == 0) return -1;
+                p += n;
+                // Python 2-digit year rule (POSIX): 69-99 -> 1900s
+                R->year += (R->year <= 68) ? 2000 : 1900;
+                break;
+            case 'm':
+                n = parse_digits(p, pe, 2, &R->month);
+                if (n == 0 || R->month < 1 || R->month > 12) return -1;
+                p += n;
+                break;
+            case 'd':
+                n = parse_digits(p, pe, 2, &R->day);
+                if (n == 0 || R->day < 1 || R->day > 31) return -1;
+                p += n;
+                break;
+            case 'H':
+                n = parse_digits(p, pe, 2, &R->hour);
+                if (n == 0 || R->hour > 23) return -1;
+                p += n;
+                break;
+            case 'I': {
+                int64_t h;
+                n = parse_digits(p, pe, 2, &h);
+                if (n == 0 || h < 1 || h > 12) return -1;
+                R->hour12 = (int)h;
+                p += n;
+                break;
+            }
+            case 'M':
+                n = parse_digits(p, pe, 2, &R->minute);
+                if (n == 0 || R->minute > 59) return -1;
+                p += n;
+                break;
+            case 'S':
+                n = parse_digits(p, pe, 2, &R->second);
+                if (n == 0 || R->second > 61) return -1;
+                // leap seconds (60/61): let the Python implementation
+                // decide what to do with them
+                if (R->second > 59) return 0;
+                p += n;
+                break;
+            case 'f': {
+                int64_t v;
+                n = parse_digits(p, pe, 6, &v);
+                if (n == 0) return -1;
+                for (int i = n; i < 6; i++) v *= 10;
+                R->us = v;
+                p += n;
+                break;
+            }
+            case 'j':
+                n = parse_digits(p, pe, 3, &R->yday);
+                if (n == 0 || R->yday < 1 || R->yday > 366) return -1;
+                p += n;
+                break;
+            case 'p': {
+                if (p + 2 > pe) return -1;
+                char a = (char)tolower((unsigned char)p[0]);
+                char b = (char)tolower((unsigned char)p[1]);
+                if (b != 'm' || (a != 'a' && a != 'p')) return -1;
+                R->ampm = (a == 'p');
+                p += 2;
+                break;
+            }
+            case 'z': {
+                // _strptime's %z branch is (?-i:Z): uppercase only
+                if (p < pe && *p == 'Z') {
+                    R->has_tz = true;
+                    R->tz_off_s = 0;
+                    p++;
+                    break;
+                }
+                if (p >= pe || (*p != '+' && *p != '-')) return -1;
+                int sign = (*p == '-') ? -1 : 1;
+                p++;
+                int64_t hh, mm, ss = 0;
+                n = parse_digits(p, pe, 2, &hh);
+                if (n != 2) return -1;
+                p += n;
+                if (p < pe && *p == ':') p++;
+                n = parse_digits(p, pe, 2, &mm);
+                if (n != 2 || mm > 59) return -1;
+                p += n;
+                int64_t us = 0;
+                if (p < pe && (*p == ':' || (*p >= '0' && *p <= '9'))) {
+                    const char* save = p;
+                    if (*p == ':') p++;
+                    n = parse_digits(p, pe, 2, &ss);
+                    if (n == 2 && ss <= 59) {
+                        p += n;
+                        if (p < pe && *p == '.') {
+                            p++;
+                            int64_t fv;
+                            n = parse_digits(p, pe, 6, &fv);
+                            if (n == 0) return -1;
+                            for (int i = n; i < 6; i++) fv *= 10;
+                            us = fv;
+                            p += n;
+                        }
+                    } else {
+                        ss = 0;
+                        p = save;  // digits belong to a later directive
+                    }
+                }
+                R->has_tz = true;
+                R->tz_off_s = sign * (hh * 3600 + mm * 60 + ss);
+                R->tz_off_us = sign * us;
+                break;
+            }
+            case '%':
+                if (p >= pe || *p != '%') return -1;
+                p++;
+                break;
+            default:
+                return 0;  // %a/%A/%b/%B/%Z/%U/%W/%c/%x/%X/...: Python path
+        }
+    }
+    if (p != pe) return -1;  // unconverted data remains
+    return 1;
+}
+
+// build a datetime.timezone for an offset (Python strptime returns
+// timezone.utc for Z/+00:00, else timezone(timedelta(...)))
+PyObject* tz_from_offset(int64_t off_s, int64_t off_us) {
+    if (!ensure_datetime_cache()) return nullptr;
+    if (off_s == 0 && off_us == 0) {
+        Py_INCREF(g_utc_singleton);
+        return g_utc_singleton;
+    }
+    PyObject* delta = PyDelta_FromDSU(0, (int)off_s, (int)off_us);
+    if (delta == nullptr) return nullptr;
+    PyObject* tz_type = PyObject_GetAttrString(g_dt_module_cache, "timezone");
+    if (tz_type == nullptr) {
+        Py_DECREF(delta);
+        return nullptr;
+    }
+    PyObject* tz = PyObject_CallFunctionObjArgs(tz_type, delta, nullptr);
+    Py_DECREF(tz_type);
+    Py_DECREF(delta);
+    return tz;
+}
+
+// ---- strftime (numeric directives; names fall back to Python) ---------
+
+// Returns 1 on success (out filled), 0 when the format needs the Python
+// strftime (locale names), -1 on error (exception set).
+int c_strftime(PyObject* d, const char* f, Py_ssize_t flen,
+               std::string* out) {
+    if (!PyDateTime_Check(d)) return 0;
+    int64_t year = PyDateTime_GET_YEAR(d);
+    int mon = PyDateTime_GET_MONTH(d);
+    int day = PyDateTime_GET_DAY(d);
+    int hour = PyDateTime_DATE_GET_HOUR(d);
+    int minute = PyDateTime_DATE_GET_MINUTE(d);
+    int sec = PyDateTime_DATE_GET_SECOND(d);
+    int us = PyDateTime_DATE_GET_MICROSECOND(d);
+    char buf[32];
+    const char* q = f;
+    const char* qe = f + flen;
+    while (q < qe) {
+        char c = *q++;
+        if (c != '%') {
+            out->push_back(c);
+            continue;
+        }
+        if (q >= qe) {
+            out->push_back('%');
+            break;
+        }
+        char dd = *q++;
+        switch (dd) {
+            case 'Y':
+                // glibc does not zero-pad %Y (Python delegates to it)
+                snprintf(buf, sizeof buf, "%lld", (long long)year);
+                out->append(buf);
+                break;
+            case 'y':
+                snprintf(buf, sizeof buf, "%02lld",
+                         (long long)(((year % 100) + 100) % 100));
+                out->append(buf);
+                break;
+            case 'm':
+                snprintf(buf, sizeof buf, "%02d", mon);
+                out->append(buf);
+                break;
+            case 'd':
+                snprintf(buf, sizeof buf, "%02d", day);
+                out->append(buf);
+                break;
+            case 'H':
+                snprintf(buf, sizeof buf, "%02d", hour);
+                out->append(buf);
+                break;
+            case 'I': {
+                int h12 = hour % 12;
+                if (h12 == 0) h12 = 12;
+                snprintf(buf, sizeof buf, "%02d", h12);
+                out->append(buf);
+                break;
+            }
+            case 'p':
+                out->append(hour < 12 ? "AM" : "PM");
+                break;
+            case 'M':
+                snprintf(buf, sizeof buf, "%02d", minute);
+                out->append(buf);
+                break;
+            case 'S':
+                snprintf(buf, sizeof buf, "%02d", sec);
+                out->append(buf);
+                break;
+            case 'f':
+                snprintf(buf, sizeof buf, "%06d", us);
+                out->append(buf);
+                break;
+            case 'j': {
+                int yday = kDaysBeforeMonth[mon] + day +
+                           ((mon > 2 && is_leap(year)) ? 1 : 0);
+                snprintf(buf, sizeof buf, "%03d", yday);
+                out->append(buf);
+                break;
+            }
+            case '%':
+                out->push_back('%');
+                break;
+            default:
+                return 0;  // %a %A %b %B %Z %z %c %x %X %G %u %V ...
+        }
+    }
+    return 1;
+}
+
+// slice-style index clamp for str.find/slice
+inline Py_ssize_t clamp_index(PyObject* idx, Py_ssize_t len, Py_ssize_t dflt,
+                              bool* bad) {
+    if (idx == Py_None) return dflt;
+    if (!PyLong_Check(idx)) {
+        *bad = true;
+        return 0;
+    }
+    Py_ssize_t v = PyLong_AsSsize_t(idx);
+    if (v == -1 && PyErr_Occurred()) {
+        PyErr_Clear();
+        // magnitude beyond Py_ssize_t clamps like a slice bound; compare
+        // against zero for the sign (a >1e308 int also overflows the
+        // double conversion, so the sign must not go through it)
+        static PyObject* zero = nullptr;
+        if (zero == nullptr) zero = PyLong_FromLong(0);
+        int neg =
+            zero != nullptr ? PyObject_RichCompareBool(idx, zero, Py_LT) : 0;
+        if (neg < 0) {
+            PyErr_Clear();
+            neg = 0;
+        }
+        return neg == 1 ? 0 : len;
+    }
+    if (v < 0) {
+        v += len;
+        if (v < 0) v = 0;
+    } else if (v > len) {
+        v = len;
+    }
+    return v;
+}
+
+// whitespace / chars-set strip over any PyUnicode kind
+PyObject* str_strip_impl(PyObject* s, PyObject* chars, int left, int right) {
+    if (PyUnicode_READY(s) < 0) return nullptr;
+    Py_ssize_t len = PyUnicode_GET_LENGTH(s);
+    int kind = PyUnicode_KIND(s);
+    const void* data = PyUnicode_DATA(s);
+    Py_ssize_t lo = 0, hi = len;
+    if (chars == nullptr) {
+        while (left && lo < hi &&
+               Py_UNICODE_ISSPACE(PyUnicode_READ(kind, data, lo)))
+            lo++;
+        while (right && hi > lo &&
+               Py_UNICODE_ISSPACE(PyUnicode_READ(kind, data, hi - 1)))
+            hi--;
+    } else {
+        if (PyUnicode_READY(chars) < 0) return nullptr;
+        Py_ssize_t clen = PyUnicode_GET_LENGTH(chars);
+        int ckind = PyUnicode_KIND(chars);
+        const void* cdata = PyUnicode_DATA(chars);
+        auto in_set = [&](Py_UCS4 ch) {
+            for (Py_ssize_t i = 0; i < clen; i++)
+                if (PyUnicode_READ(ckind, cdata, i) == ch) return true;
+            return false;
+        };
+        while (left && lo < hi && in_set(PyUnicode_READ(kind, data, lo))) lo++;
+        while (right && hi > lo && in_set(PyUnicode_READ(kind, data, hi - 1)))
+            hi--;
+    }
+    if (lo == 0 && hi == len && PyUnicode_CheckExact(s)) {
+        Py_INCREF(s);
+        return s;
+    }
+    return PyUnicode_Substring(s, lo, hi);
+}
+
+// ASCII-only case transforms; returns nullptr with no error set when the
+// string needs the full Unicode algorithm (caller calls the method)
+PyObject* str_ascii_case(PyObject* s, int64_t mid) {
+    if (PyUnicode_READY(s) < 0) return nullptr;
+    if (!PyUnicode_IS_ASCII(s)) return nullptr;
+    Py_ssize_t len = PyUnicode_GET_LENGTH(s);
+    const char* src = (const char*)PyUnicode_1BYTE_DATA(s);
+    PyObject* out = PyUnicode_New(len, 127);
+    if (out == nullptr) return nullptr;
+    char* dst = (char*)PyUnicode_1BYTE_DATA(out);
+    bool prev_cased = false;
+    for (Py_ssize_t i = 0; i < len; i++) {
+        char c = src[i];
+        switch (mid) {
+            case M_STR_LOWER:
+                dst[i] = (char)tolower((unsigned char)c);
+                break;
+            case M_STR_UPPER:
+                dst[i] = (char)toupper((unsigned char)c);
+                break;
+            case M_STR_SWAPCASE:
+                dst[i] = islower((unsigned char)c)
+                             ? (char)toupper((unsigned char)c)
+                             : (islower((unsigned char)c) == 0 &&
+                                        isupper((unsigned char)c)
+                                    ? (char)tolower((unsigned char)c)
+                                    : c);
+                break;
+            case M_STR_TITLE: {
+                bool cased = isalpha((unsigned char)c) != 0;
+                if (cased && !prev_cased)
+                    dst[i] = (char)toupper((unsigned char)c);
+                else if (cased)
+                    dst[i] = (char)tolower((unsigned char)c);
+                else
+                    dst[i] = c;
+                prev_cased = cased;
+                break;
+            }
+            default:
+                dst[i] = c;
+        }
+    }
+    return out;
+}
+
+// method call fallback for inputs outside a native fast path: the
+// single-value Python method, same result the closure lambda produces
+PyObject* vm_method_pyfallback(const char* name, PyObject* self) {
+    return PyObject_CallMethod(self, name, nullptr);
+}
+
+// Evaluates method `mid` over `args[0..nargs)`.  Returns a NEW reference;
+// nullptr with an exception set = treat as the closure's `except` path
+// (caller converts to ERROR).
+PyObject* vm_method_eval(int64_t mid, PyObject** args, int64_t nargs) {
+    PyObject* a0 = args[0];
+    switch (mid) {
+        // ---- str -----------------------------------------------------
+        case M_STR_LOWER:
+        case M_STR_UPPER:
+        case M_STR_SWAPCASE:
+        case M_STR_TITLE: {
+            if (!PyUnicode_Check(a0)) {
+                PyErr_SetString(PyExc_TypeError, "expected str");
+                return nullptr;
+            }
+            PyObject* r = str_ascii_case(a0, mid);
+            if (r != nullptr || PyErr_Occurred()) return r;
+            const char* nm = mid == M_STR_LOWER     ? "lower"
+                             : mid == M_STR_UPPER   ? "upper"
+                             : mid == M_STR_SWAPCASE ? "swapcase"
+                                                     : "title";
+            return vm_method_pyfallback(nm, a0);
+        }
+        case M_STR_REVERSED: {
+            if (!PyUnicode_Check(a0) || PyUnicode_READY(a0) < 0) {
+                if (!PyErr_Occurred())
+                    PyErr_SetString(PyExc_TypeError, "expected str");
+                return nullptr;
+            }
+            Py_ssize_t len = PyUnicode_GET_LENGTH(a0);
+            int kind = PyUnicode_KIND(a0);
+            const void* data = PyUnicode_DATA(a0);
+            Py_UCS4 maxch = PyUnicode_MAX_CHAR_VALUE(a0);
+            PyObject* out = PyUnicode_New(len, maxch);
+            if (out == nullptr) return nullptr;
+            for (Py_ssize_t i = 0; i < len; i++)
+                PyUnicode_WRITE(PyUnicode_KIND(out), PyUnicode_DATA(out), i,
+                                PyUnicode_READ(kind, data, len - 1 - i));
+            return out;
+        }
+        case M_STR_LEN: {
+            Py_ssize_t n = PyObject_Length(a0);
+            if (n < 0) return nullptr;
+            return PyLong_FromSsize_t(n);
+        }
+        case M_STR_STRIP:
+        case M_STR_LSTRIP:
+        case M_STR_RSTRIP: {
+            if (!PyUnicode_Check(a0)) {
+                PyErr_SetString(PyExc_TypeError, "expected str");
+                return nullptr;
+            }
+            PyObject* chars = nargs >= 2 ? args[1] : nullptr;
+            if (chars != nullptr && !PyUnicode_Check(chars)) {
+                PyErr_SetString(PyExc_TypeError, "strip chars must be str");
+                return nullptr;
+            }
+            return str_strip_impl(a0, chars, mid != M_STR_RSTRIP,
+                                  mid != M_STR_LSTRIP);
+        }
+        case M_STR_COUNT: {
+            if (!PyUnicode_Check(a0) || !PyUnicode_Check(args[1])) {
+                PyErr_SetString(PyExc_TypeError, "expected str");
+                return nullptr;
+            }
+            Py_ssize_t n =
+                PyUnicode_Count(a0, args[1], 0, PY_SSIZE_T_MAX);
+            if (n < 0) return nullptr;
+            return PyLong_FromSsize_t(n);
+        }
+        case M_STR_FIND:
+        case M_STR_RFIND: {
+            if (!PyUnicode_Check(a0) || !PyUnicode_Check(args[1])) {
+                PyErr_SetString(PyExc_TypeError, "expected str");
+                return nullptr;
+            }
+            Py_ssize_t len = PyUnicode_GET_LENGTH(a0);
+            bool bad = false;
+            Py_ssize_t start = clamp_index(args[1 + 1], len, 0, &bad);
+            Py_ssize_t end =
+                nargs >= 4 ? clamp_index(args[3], len, len, &bad) : len;
+            if (bad) {
+                PyErr_SetString(PyExc_TypeError, "indices must be ints");
+                return nullptr;
+            }
+            Py_ssize_t r = PyUnicode_Find(a0, args[1], start, end,
+                                          mid == M_STR_FIND ? 1 : -1);
+            if (r == -2) return nullptr;
+            return PyLong_FromSsize_t(r);
+        }
+        case M_STR_STARTSWITH:
+        case M_STR_ENDSWITH: {
+            if (!PyUnicode_Check(a0) || !PyUnicode_Check(args[1])) {
+                // tuple prefixes etc.: defer to the Python method
+                return PyObject_CallMethod(
+                    a0, mid == M_STR_STARTSWITH ? "startswith" : "endswith",
+                    "O", args[1]);
+            }
+            Py_ssize_t r = PyUnicode_Tailmatch(
+                a0, args[1], 0, PY_SSIZE_T_MAX,
+                mid == M_STR_STARTSWITH ? -1 : 1);
+            if (r < 0) return nullptr;
+            return PyBool_FromLong(r != 0);
+        }
+        case M_STR_REPLACE: {
+            if (!PyUnicode_Check(a0) || !PyUnicode_Check(args[1]) ||
+                !PyUnicode_Check(args[2]) || !PyLong_Check(args[3])) {
+                PyErr_SetString(PyExc_TypeError, "bad replace arguments");
+                return nullptr;
+            }
+            Py_ssize_t cnt = PyLong_AsSsize_t(args[3]);
+            if (cnt == -1 && PyErr_Occurred()) return nullptr;
+            return PyUnicode_Replace(a0, args[1], args[2], cnt);
+        }
+        case M_STR_SLICE: {
+            if (!PyUnicode_Check(a0)) {
+                PyErr_SetString(PyExc_TypeError, "expected str");
+                return nullptr;
+            }
+            Py_ssize_t len = PyUnicode_GET_LENGTH(a0);
+            bool bad = false;
+            Py_ssize_t lo = clamp_index(args[1], len, 0, &bad);
+            Py_ssize_t hi = clamp_index(args[2], len, len, &bad);
+            if (bad) {
+                PyErr_SetString(PyExc_TypeError,
+                                "slice indices must be integers");
+                return nullptr;
+            }
+            if (hi < lo) hi = lo;
+            return PyUnicode_Substring(a0, lo, hi);
+        }
+        case M_STR_PARSE_INT:
+        case M_STR_PARSE_INT_OPT: {
+            // int(s): the closure also accepts non-str (int(3.5) == 3)
+            PyObject* r = PyUnicode_Check(a0)
+                              ? PyLong_FromUnicodeObject(a0, 10)
+                              : PyNumber_Long(a0);
+            if (r == nullptr && mid == M_STR_PARSE_INT_OPT &&
+                PyErr_ExceptionMatches(PyExc_ValueError)) {
+                PyErr_Clear();
+                Py_RETURN_NONE;
+            }
+            return r;
+        }
+        case M_STR_PARSE_FLOAT:
+        case M_STR_PARSE_FLOAT_OPT: {
+            PyObject* r = PyUnicode_Check(a0) ? PyFloat_FromString(a0)
+                                              : PyNumber_Float(a0);
+            if (r == nullptr && mid == M_STR_PARSE_FLOAT_OPT &&
+                PyErr_ExceptionMatches(PyExc_ValueError)) {
+                PyErr_Clear();
+                Py_RETURN_NONE;
+            }
+            return r;
+        }
+        case M_STR_PARSE_BOOL:
+        case M_STR_PARSE_BOOL_OPT: {
+            // (s, true_values, false_values) — tuples of lowercase strs
+            PyObject* low = PyObject_CallMethod(a0, "lower", nullptr);
+            if (low == nullptr) return nullptr;
+            int hit = PySequence_Contains(args[1], low);
+            if (hit < 0) {
+                Py_DECREF(low);
+                return nullptr;
+            }
+            if (hit) {
+                Py_DECREF(low);
+                Py_RETURN_TRUE;
+            }
+            hit = PySequence_Contains(args[2], low);
+            Py_DECREF(low);
+            if (hit < 0) return nullptr;
+            if (hit) Py_RETURN_FALSE;
+            if (mid == M_STR_PARSE_BOOL_OPT) Py_RETURN_NONE;
+            PyErr_Format(PyExc_ValueError, "Cannot parse %R as bool", a0);
+            return nullptr;
+        }
+        case M_STR_PARSE_DATETIME: {
+            Py_ssize_t slen, flen;
+            const char* s = PyUnicode_AsUTF8AndSize(a0, &slen);
+            if (s == nullptr) return nullptr;
+            const char* f = PyUnicode_AsUTF8AndSize(args[1], &flen);
+            if (f == nullptr) return nullptr;
+            StrpResult R;
+            int rc = c_strptime(s, slen, f, flen, &R);
+            if (rc <= 0) {
+                // unsupported directive (rc==0) OR native mismatch
+                // (rc<0): both defer to the real datetime.strptime.  The
+                // mismatch deferral is what guarantees parity — Python's
+                // regex backtracks where the native parser is greedy
+                // (e.g. "%H%M" over "29" parses as H=2, M=9), and \d
+                // matches non-ASCII Unicode digits; rows the native
+                // parser cannot handle get Python's verdict, whatever
+                // it is
+                if (!ensure_datetime_cache()) return nullptr;
+                PyObject* dt_type =
+                    PyObject_GetAttrString(g_dt_module_cache, "datetime");
+                if (dt_type == nullptr) return nullptr;
+                PyObject* r = PyObject_CallMethod(dt_type, "strptime", "OO",
+                                                  a0, args[1]);
+                Py_DECREF(dt_type);
+                return r;
+            }
+            if (R.hour12 >= 0) {
+                int h = R.hour12 % 12;
+                if (R.ampm == 1) h += 12;
+                R.hour = h;
+            }
+            if (R.yday > 0) {
+                int64_t doy = R.yday;
+                int64_t maxd = is_leap(R.year) ? 366 : 365;
+                if (doy > maxd) {
+                    PyErr_SetString(PyExc_ValueError,
+                                    "day of year out of range");
+                    return nullptr;
+                }
+                int64_t m = 1;
+                while (m < 12) {
+                    int64_t dim = kDaysBeforeMonth[m + 1] +
+                                  ((m + 1 > 2 && is_leap(R.year)) ? 1 : 0);
+                    if (doy <= dim) break;
+                    m++;
+                }
+                R.month = m;
+                R.day = doy - kDaysBeforeMonth[m] -
+                        ((m > 2 && is_leap(R.year)) ? 1 : 0);
+            }
+            PyObject* tz = nullptr;
+            if (R.has_tz) {
+                tz = tz_from_offset(R.tz_off_s, R.tz_off_us);
+                if (tz == nullptr) return nullptr;
+            }
+            PyObject* r = PyDateTimeAPI->DateTime_FromDateAndTime(
+                (int)R.year, (int)R.month, (int)R.day, (int)R.hour,
+                (int)R.minute, (int)R.second, (int)R.us,
+                tz == nullptr ? Py_None : tz, PyDateTimeAPI->DateTimeType);
+            Py_XDECREF(tz);
+            return r;
+        }
+        // ---- datetime fields ----------------------------------------
+        case M_DT_NANOSECOND:
+        case M_DT_MICROSECOND:
+        case M_DT_MILLISECOND:
+        case M_DT_SECOND:
+        case M_DT_MINUTE:
+        case M_DT_HOUR:
+        case M_DT_DAY:
+        case M_DT_MONTH:
+        case M_DT_YEAR:
+        case M_DT_DAY_OF_WEEK:
+        case M_DT_DAY_OF_YEAR: {
+            if (!PyDateTime_Check(a0)) {
+                PyErr_SetString(PyExc_TypeError, "expected datetime");
+                return nullptr;
+            }
+            long long v;
+            switch (mid) {
+                case M_DT_NANOSECOND:
+                    v = (long long)PyDateTime_DATE_GET_MICROSECOND(a0) * 1000;
+                    break;
+                case M_DT_MICROSECOND:
+                    v = PyDateTime_DATE_GET_MICROSECOND(a0);
+                    break;
+                case M_DT_MILLISECOND:
+                    v = PyDateTime_DATE_GET_MICROSECOND(a0) / 1000;
+                    break;
+                case M_DT_SECOND:
+                    v = PyDateTime_DATE_GET_SECOND(a0);
+                    break;
+                case M_DT_MINUTE:
+                    v = PyDateTime_DATE_GET_MINUTE(a0);
+                    break;
+                case M_DT_HOUR:
+                    v = PyDateTime_DATE_GET_HOUR(a0);
+                    break;
+                case M_DT_DAY:
+                    v = PyDateTime_GET_DAY(a0);
+                    break;
+                case M_DT_MONTH:
+                    v = PyDateTime_GET_MONTH(a0);
+                    break;
+                case M_DT_YEAR:
+                    v = PyDateTime_GET_YEAR(a0);
+                    break;
+                case M_DT_DAY_OF_WEEK: {
+                    int64_t z = days_from_civil(PyDateTime_GET_YEAR(a0),
+                                                PyDateTime_GET_MONTH(a0),
+                                                PyDateTime_GET_DAY(a0));
+                    v = (long long)(((z % 7) + 10) % 7);  // 1970-01-01 = Thu
+                    break;
+                }
+                default: {  // day of year
+                    int m = PyDateTime_GET_MONTH(a0);
+                    v = kDaysBeforeMonth[m] + PyDateTime_GET_DAY(a0) +
+                        ((m > 2 && is_leap(PyDateTime_GET_YEAR(a0))) ? 1 : 0);
+                }
+            }
+            return PyLong_FromLongLong(v);
+        }
+        case M_DT_TIMESTAMP: {
+            // (d, scale_float): naive treated as UTC (expressions.py ts())
+            if (!PyDateTime_Check(a0) || !PyFloat_Check(args[1])) {
+                PyErr_SetString(PyExc_TypeError, "expected datetime");
+                return nullptr;
+            }
+            PyObject* tzinfo = PyDateTime_DATE_GET_TZINFO(a0);
+            int64_t off_us = 0;
+            if (tzinfo != Py_None) {
+                // non-trivial tz: ask Python for the offset
+                PyObject* off =
+                    PyObject_CallMethod(a0, "utcoffset", nullptr);
+                if (off == nullptr) return nullptr;
+                if (off != Py_None) {
+                    if (!PyDelta_Check(off)) {
+                        Py_DECREF(off);
+                        PyErr_SetString(PyExc_TypeError, "bad utcoffset");
+                        return nullptr;
+                    }
+                    off_us = ((int64_t)PyDateTime_DELTA_GET_DAYS(off) * 86400 +
+                              PyDateTime_DELTA_GET_SECONDS(off)) *
+                                 1000000 +
+                             PyDateTime_DELTA_GET_MICROSECONDS(off);
+                }
+                Py_DECREF(off);
+            }
+            int64_t days = days_from_civil(PyDateTime_GET_YEAR(a0),
+                                           PyDateTime_GET_MONTH(a0),
+                                           PyDateTime_GET_DAY(a0));
+            int64_t secs = (int64_t)PyDateTime_DATE_GET_HOUR(a0) * 3600 +
+                           PyDateTime_DATE_GET_MINUTE(a0) * 60 +
+                           PyDateTime_DATE_GET_SECOND(a0);
+            int64_t us_total = (days * 86400 + secs) * 1000000 +
+                               PyDateTime_DATE_GET_MICROSECOND(a0) - off_us;
+            // (d - epoch).total_seconds() bit-exact: split into the
+            // timedelta fields Python would hold, then its double formula
+            int64_t td_days = us_total >= 0
+                                  ? us_total / 86400000000LL
+                                  : -((-us_total + 86399999999LL) /
+                                      86400000000LL);
+            int64_t rem_us = us_total - td_days * 86400000000LL;
+            double ts = td_total_seconds(td_days, rem_us / 1000000,
+                                         rem_us % 1000000);
+            return PyFloat_FromDouble(ts * PyFloat_AS_DOUBLE(args[1]));
+        }
+        case M_DT_STRFTIME: {
+            if (!PyUnicode_Check(args[1])) {
+                PyErr_SetString(PyExc_TypeError, "format must be str");
+                return nullptr;
+            }
+            Py_ssize_t flen;
+            const char* f = PyUnicode_AsUTF8AndSize(args[1], &flen);
+            if (f == nullptr) return nullptr;
+            std::string out;
+            out.reserve((size_t)flen + 16);
+            int rc = c_strftime(a0, f, flen, &out);
+            if (rc < 0) return nullptr;
+            if (rc == 0)
+                return PyObject_CallMethod(a0, "strftime", "O", args[1]);
+            return PyUnicode_FromStringAndSize(out.data(),
+                                               (Py_ssize_t)out.size());
+        }
+        case M_DT_ROUND:
+        case M_DT_FLOOR: {
+            // replicate _round_dt/_floor_dt double math exactly
+            if (!PyDateTime_Check(a0) || !PyDelta_Check(args[1])) {
+                PyErr_SetString(PyExc_TypeError, "expected datetime+duration");
+                return nullptr;
+            }
+            PyObject* tzinfo = PyDateTime_DATE_GET_TZINFO(a0);
+            double delta;
+            PyObject* epoch = nullptr;  // aware path only
+            if (tzinfo == Py_None) {
+                int64_t days = days_from_civil(PyDateTime_GET_YEAR(a0),
+                                               PyDateTime_GET_MONTH(a0),
+                                               PyDateTime_GET_DAY(a0));
+                int64_t secs =
+                    (int64_t)PyDateTime_DATE_GET_HOUR(a0) * 3600 +
+                    PyDateTime_DATE_GET_MINUTE(a0) * 60 +
+                    PyDateTime_DATE_GET_SECOND(a0);
+                delta = td_total_seconds(
+                    days, secs, PyDateTime_DATE_GET_MICROSECOND(a0));
+            } else {
+                // aware: (d - epoch(tz)).total_seconds() must go through
+                // the real subtraction — a zoneinfo tz can have different
+                // utcoffsets at d and at the epoch
+                epoch = PyDateTimeAPI->DateTime_FromDateAndTime(
+                    1970, 1, 1, 0, 0, 0, 0, tzinfo,
+                    PyDateTimeAPI->DateTimeType);
+                if (epoch == nullptr) return nullptr;
+                PyObject* diff = PyNumber_Subtract(a0, epoch);
+                if (diff == nullptr || !PyDelta_Check(diff)) {
+                    Py_XDECREF(diff);
+                    Py_DECREF(epoch);
+                    if (!PyErr_Occurred())
+                        PyErr_SetString(PyExc_TypeError, "bad subtraction");
+                    return nullptr;
+                }
+                delta = td_total_seconds(
+                    PyDateTime_DELTA_GET_DAYS(diff),
+                    PyDateTime_DELTA_GET_SECONDS(diff),
+                    PyDateTime_DELTA_GET_MICROSECONDS(diff));
+                Py_DECREF(diff);
+            }
+            double step =
+                td_total_seconds(PyDateTime_DELTA_GET_DAYS(args[1]),
+                                 PyDateTime_DELTA_GET_SECONDS(args[1]),
+                                 PyDateTime_DELTA_GET_MICROSECONDS(args[1]));
+            if (step == 0.0) {
+                Py_XDECREF(epoch);
+                PyErr_SetString(PyExc_ZeroDivisionError, "zero duration");
+                return nullptr;
+            }
+            double q = delta / step;
+            double steps = mid == M_DT_FLOOR ? std::floor(q)
+                                             : std::nearbyint(q);
+            double result_s = steps * step;
+            // timedelta(seconds=result_s) microsecond rounding: integer
+            // part exact, fractional part round-half-even (datetime.c
+            // accum()/delta_new)
+            double ipart;
+            double fpart = std::modf(result_s, &ipart);
+            if (!(ipart >= -9.0e15 && ipart <= 9.0e15)) {
+                Py_XDECREF(epoch);
+                PyErr_SetString(PyExc_OverflowError, "duration too large");
+                return nullptr;
+            }
+            int64_t total_us = (int64_t)ipart * 1000000 +
+                               (int64_t)std::nearbyint(fpart * 1e6);
+            if (epoch != nullptr) {
+                // aware: epoch + timedelta via the datetime type itself
+                int64_t rdays = total_us >= 0
+                                    ? total_us / 86400000000LL
+                                    : -((-total_us + 86399999999LL) /
+                                        86400000000LL);
+                int64_t rem = total_us - rdays * 86400000000LL;
+                PyObject* td = PyDelta_FromDSU(
+                    (int)rdays, (int)(rem / 1000000), (int)(rem % 1000000));
+                if (td == nullptr) {
+                    Py_DECREF(epoch);
+                    return nullptr;
+                }
+                PyObject* r = PyNumber_Add(epoch, td);
+                Py_DECREF(td);
+                Py_DECREF(epoch);
+                return r;
+            }
+            int64_t rdays = total_us >= 0
+                                ? total_us / 86400000000LL
+                                : -((-total_us + 86399999999LL) /
+                                    86400000000LL);
+            int64_t rem = total_us - rdays * 86400000000LL;
+            int64_t y, mo, dd;
+            civil_from_days(rdays, &y, &mo, &dd);
+            if (y < 1 || y > 9999) {
+                PyErr_SetString(PyExc_OverflowError, "date out of range");
+                return nullptr;
+            }
+            return PyDateTimeAPI->DateTime_FromDateAndTime(
+                (int)y, (int)mo, (int)dd, (int)(rem / 3600000000LL),
+                (int)(rem / 60000000 % 60), (int)(rem / 1000000 % 60),
+                (int)(rem % 1000000), Py_None, PyDateTimeAPI->DateTimeType);
+        }
+        // ---- duration accessors -------------------------------------
+        case M_DUR_NANOSECONDS:
+        case M_DUR_MICROSECONDS:
+        case M_DUR_MILLISECONDS:
+        case M_DUR_SECONDS:
+        case M_DUR_MINUTES:
+        case M_DUR_HOURS:
+        case M_DUR_DAYS:
+        case M_DUR_WEEKS: {
+            if (!PyDelta_Check(a0)) {
+                PyErr_SetString(PyExc_TypeError, "expected duration");
+                return nullptr;
+            }
+            int64_t days = PyDateTime_DELTA_GET_DAYS(a0);
+            if (mid == M_DUR_DAYS) return PyLong_FromLongLong(days);
+            if (mid == M_DUR_WEEKS) {
+                int64_t w = days >= 0 ? days / 7 : -((-days + 6) / 7);
+                return PyLong_FromLongLong(w);
+            }
+            double ts = td_total_seconds(days, PyDateTime_DELTA_GET_SECONDS(a0),
+                                         PyDateTime_DELTA_GET_MICROSECONDS(a0));
+            double scaled;
+            switch (mid) {
+                case M_DUR_NANOSECONDS: scaled = ts * 1e9; break;
+                case M_DUR_MICROSECONDS: scaled = ts * 1e6; break;
+                case M_DUR_MILLISECONDS: scaled = ts * 1e3; break;
+                case M_DUR_SECONDS: scaled = ts; break;
+                case M_DUR_MINUTES: scaled = std::floor(ts / 60.0); break;
+                default: scaled = std::floor(ts / 3600.0); break;
+            }
+            // int(double): PyLong_FromDouble truncates toward zero and
+            // handles magnitudes beyond int64 as a big int, exactly like
+            // the closure's int(...)
+            return PyLong_FromDouble(scaled);
+        }
+        // ---- num ----------------------------------------------------
+        case M_NUM_ABS:
+            return PyNumber_Absolute(a0);
+        case M_NUM_FILL_NA: {
+            PyObject* r = a0;
+            if (a0 == Py_None ||
+                (PyFloat_Check(a0) && std::isnan(PyFloat_AS_DOUBLE(a0))))
+                r = args[1];
+            Py_INCREF(r);
+            return r;
+        }
+        default:
+            PyErr_Format(PyExc_SystemError, "bad method id %lld",
+                         (long long)mid);
+            return nullptr;
+    }
+}
+
 enum VmOp : int64_t {
     VM_LOAD_COL = 1,    // (pos)            push values[pos]
     VM_LOAD_KEY = 2,    //                  push key
@@ -1711,6 +2746,7 @@ enum VmOp : int64_t {
     VM_MAKE_TUPLE = 18, // (n)
     VM_GET = 19,        // (strict, end_t)  pop idx, obj
     VM_POINTER = 20,    // (n, opt, rs_idx) pop n args -> Pointer key
+    VM_METHOD = 21,     // (mid, nargs, propagate_none) namespace method
 };
 
 enum VmBin : int64_t {
@@ -1746,7 +2782,7 @@ inline int vm_n_operands(int64_t op) {
             return 1;
         case VM_BRANCH: case VM_CONVERT: case VM_GET:
             return 2;
-        case VM_POINTER:
+        case VM_POINTER: case VM_METHOD:
             return 3;
         default:
             return -1;
@@ -2254,6 +3290,42 @@ PyObject* vm_eval(VmProgram* P, PyObject* key, PyObject* values,
             rowfail_ptr:
                 goto rowfail;
             }
+            case VM_METHOD: {
+                int64_t mid = code[ip], n = code[ip + 1],
+                        prop_none = code[ip + 2];
+                ip += 3;
+                PyObject** base = &stack[sp - n];
+                // closure contract (MethodCallExpression._compile run()):
+                // any ERROR arg -> ERROR; any None arg -> None when the
+                // method propagates None; an exception -> ERROR
+                bool any_err = false, any_none = false;
+                for (int64_t j = 0; j < n; j++) {
+                    if (base[j] == error_obj) any_err = true;
+                    if (base[j] == Py_None) any_none = true;
+                }
+                PyObject* r;
+                if (any_err) {
+                    Py_INCREF(error_obj);
+                    r = error_obj;
+                } else if (prop_none && any_none) {
+                    Py_INCREF(Py_None);
+                    r = Py_None;
+                } else {
+                    r = vm_method_eval(mid, base, n);
+                    if (r == nullptr) {
+                        if (PyErr_ExceptionMatches(PyExc_SystemError) ||
+                            PyErr_ExceptionMatches(PyExc_MemoryError))
+                            goto rowfail;
+                        PyErr_Clear();
+                        Py_INCREF(error_obj);
+                        r = error_obj;
+                    }
+                }
+                for (int64_t j = 0; j < n; j++) Py_DECREF(base[j]);
+                sp -= (size_t)n;
+                stack[sp++] = r;
+                break;
+            }
             default:
                 PyErr_SetString(PyExc_SystemError, "bad VM opcode");
                 goto rowfail;
@@ -2428,6 +3500,12 @@ PyObject* py_vm_compile(PyObject*, PyObject* args) {
                          (size_t)o[2] < P->consts.size() &&
                          flow(next, d - (int)o[0] + 1);
                     nd = d - (int)o[0] + 1;
+                    break;
+                case VM_METHOD:
+                    ok = o[0] >= 0 && o[0] < M_METHOD_COUNT && o[1] >= 1 &&
+                         o[1] <= 8 && (int64_t)d >= o[1] &&
+                         flow(next, d - (int)o[1] + 1);
+                    nd = d - (int)o[1] + 1;
                     break;
                 default:
                     ok = false;
@@ -4184,6 +5262,8 @@ PyModuleDef kModule = {PyModuleDef_HEAD_INIT, "pathway_native",
 }  // namespace
 
 PyMODINIT_FUNC PyInit_pathway_native(void) {
+    PyDateTime_IMPORT;  // .dt namespace methods use the C datetime API
+    if (PyDateTimeAPI == nullptr) return nullptr;
     PyObject* m = PyModule_Create(&kModule);
     if (m == nullptr) return nullptr;
     g_unsupported =

@@ -9,10 +9,13 @@ emits a flat postfix program for the C++ VM in
 
 Lazy constructs (``if_else``/``coalesce``/``fill_error``/``get`` default)
 compile to jump-based code so only the taken branch evaluates — the same
-observable behaviour as the Python closures.  Subtrees with no native
-lowering (UDF ``apply``, ``.dt``/``.str``/``.num`` namespace methods)
-fall back to their ordinary ``_compile`` closure, embedded as a single
-``CALL_PY`` instruction; the rest of the expression still runs native.
+observable behaviour as the Python closures.  High-traffic
+``.dt``/``.str``/``.num`` namespace methods lower to ``OP_METHOD`` with a
+native implementation per method (reference evaluates these enums in Rust,
+``src/engine/expression.rs:26-340``); subtrees with no native lowering
+(UDF ``apply``, zoneinfo conversions, ``str.split``) fall back to their
+ordinary ``_compile`` closure, embedded as a single ``CALL_PY``
+instruction; the rest of the expression still runs native.
 
 Every op's behaviour is pinned to the Python closure semantics by the
 differential tests in ``tests/test_expr_vm.py`` (native program vs pure
@@ -49,6 +52,69 @@ OP_CONVERT = 17
 OP_MAKE_TUPLE = 18
 OP_GET = 19
 OP_POINTER = 20
+OP_METHOD = 21
+
+# (method name, operand count) -> native method id — must mirror enum
+# VmMethod in native/pathway_native.cpp.  Methods not listed here (split,
+# to_utc, to_naive_in_timezone, from_timestamp, num.round, ...) run as
+# CALL_PY closures: either they need the zoneinfo database, or exact
+# float-rounding parity with the Python builtin is not worth replicating.
+_METHOD_IDS = {
+    ("str.lower", 1): 0,
+    ("str.upper", 1): 1,
+    ("str.swapcase", 1): 2,
+    ("str.title", 1): 3,
+    ("str.reversed", 1): 4,
+    ("str.len", 1): 5,
+    ("str.strip", 1): 6,
+    ("str.strip", 2): 6,
+    ("str.lstrip", 1): 7,
+    ("str.lstrip", 2): 7,
+    ("str.rstrip", 1): 8,
+    ("str.rstrip", 2): 8,
+    ("str.count", 2): 9,
+    ("str.find", 3): 10,
+    ("str.find", 4): 10,
+    ("str.rfind", 3): 11,
+    ("str.rfind", 4): 11,
+    ("str.startswith", 2): 12,
+    ("str.endswith", 2): 13,
+    ("str.replace", 4): 14,
+    ("str.slice", 3): 15,
+    ("str.parse_int", 1): 16,
+    ("str.parse_int_opt", 1): 17,
+    ("str.parse_float", 1): 18,
+    ("str.parse_float_opt", 1): 19,
+    ("str.parse_bool", 3): 20,
+    ("str.parse_bool_opt", 3): 21,
+    ("str.parse_datetime", 2): 22,
+    ("dt.strptime", 2): 22,
+    ("dt.nanosecond", 1): 23,
+    ("dt.microsecond", 1): 24,
+    ("dt.millisecond", 1): 25,
+    ("dt.second", 1): 26,
+    ("dt.minute", 1): 27,
+    ("dt.hour", 1): 28,
+    ("dt.day", 1): 29,
+    ("dt.month", 1): 30,
+    ("dt.year", 1): 31,
+    ("dt.day_of_week", 1): 32,
+    ("dt.day_of_year", 1): 33,
+    ("dt.timestamp", 2): 34,
+    ("dt.strftime", 2): 35,
+    ("dt.round", 2): 36,
+    ("dt.floor", 2): 37,
+    ("dt.nanoseconds", 1): 38,
+    ("dt.microseconds", 1): 39,
+    ("dt.milliseconds", 1): 40,
+    ("dt.seconds", 1): 41,
+    ("dt.minutes", 1): 42,
+    ("dt.hours", 1): 43,
+    ("dt.days", 1): 44,
+    ("dt.weeks", 1): 45,
+    ("num.abs", 1): 46,
+    ("num.fill_na", 2): 47,
+}
 
 # binary op ids — must mirror enum VmBin
 BIN_IDS = {
@@ -235,8 +301,20 @@ def _lower(e: ex.ColumnExpression, asm: _Asm) -> None:
         )
         asm.native_ops += 1
         return
-    # ApplyExpression (+async variants), MethodCallExpression, and any
-    # future node types run as their ordinary Python closure
+    if t is ex.MethodCallExpression:
+        mid = _METHOD_IDS.get((e._method_name, len(e._args)))
+        if mid is None:
+            asm.fallback(e)
+            return
+        for a in e._args:
+            _lower(a, asm)
+        asm.emit(
+            OP_METHOD, mid, len(e._args), 1 if e._propagate_none else 0
+        )
+        asm.native_ops += 1
+        return
+    # ApplyExpression (+async variants) and any future node types run as
+    # their ordinary Python closure
     asm.fallback(e)
 
 

@@ -101,6 +101,10 @@ class StringNamespace(_Namespace):
     def slice(self, start: Any, end: Any) -> ColumnExpression:
         return self._m("str.slice", lambda s, a, b: s[a:b], dt.STR, start, end)
 
+    # NOTE: the ``_opt`` method-name suffix and the extra const operands
+    # (true/false value sets, datetime format, timestamp scale) exist so
+    # the expression VM can lower these by (name, arity) — see
+    # expr_vm._METHOD_IDS; the lambdas remain the semantic ground truth.
     def parse_int(self, optional: bool = False) -> ColumnExpression:
         def parse(s: str) -> int | None:
             try:
@@ -110,7 +114,8 @@ class StringNamespace(_Namespace):
                     return None
                 raise
 
-        return self._m("str.parse_int", parse, dt.Optional(dt.INT) if optional else dt.INT)
+        name = "str.parse_int_opt" if optional else "str.parse_int"
+        return self._m(name, parse, dt.Optional(dt.INT) if optional else dt.INT)
 
     def parse_float(self, optional: bool = False) -> ColumnExpression:
         def parse(s: str) -> float | None:
@@ -121,30 +126,32 @@ class StringNamespace(_Namespace):
                     return None
                 raise
 
-        return self._m("str.parse_float", parse, dt.Optional(dt.FLOAT) if optional else dt.FLOAT)
+        name = "str.parse_float_opt" if optional else "str.parse_float"
+        return self._m(name, parse, dt.Optional(dt.FLOAT) if optional else dt.FLOAT)
 
     def parse_bool(self, true_values: Any = ("on", "true", "yes", "1"), false_values: Any = ("off", "false", "no", "0"), optional: bool = False) -> ColumnExpression:
         tv = tuple(v.lower() for v in true_values)
         fv = tuple(v.lower() for v in false_values)
 
-        def parse(s: str) -> bool | None:
+        def parse(s: str, tvs: tuple, fvs: tuple) -> bool | None:
             low = s.lower()
-            if low in tv:
+            if low in tvs:
                 return True
-            if low in fv:
+            if low in fvs:
                 return False
             if optional:
                 return None
             raise ValueError(f"Cannot parse {s!r} as bool")
 
-        return self._m("str.parse_bool", parse, dt.Optional(dt.BOOL) if optional else dt.BOOL)
+        name = "str.parse_bool_opt" if optional else "str.parse_bool"
+        return self._m(name, parse, dt.Optional(dt.BOOL) if optional else dt.BOOL, tv, fv)
 
     def parse_datetime(self, fmt: str, contains_timezone: bool = False) -> ColumnExpression:
-        def parse(s: str) -> _dtm.datetime:
-            return _dtm.datetime.strptime(s, fmt)
-
         return self._m(
-            "str.parse_datetime", parse, dt.DATE_TIME_UTC if contains_timezone else dt.DATE_TIME_NAIVE
+            "str.parse_datetime",
+            lambda s, f: _dtm.datetime.strptime(s, f),
+            dt.DATE_TIME_UTC if contains_timezone else dt.DATE_TIME_NAIVE,
+            fmt,
         )
 
 
@@ -206,12 +213,12 @@ class DateTimeNamespace(_Namespace):
     def timestamp(self, unit: str = "s") -> ColumnExpression:
         scale = {"ns": 1e9, "us": 1e6, "ms": 1e3, "s": 1.0}[unit]
 
-        def ts(d: _dtm.datetime) -> float:
+        def ts(d: _dtm.datetime, sc: float) -> float:
             if d.tzinfo is None:
                 d = d.replace(tzinfo=_UTC)
-            return d.timestamp() * scale
+            return d.timestamp() * sc
 
-        return self._m("dt.timestamp", ts, dt.FLOAT)
+        return self._m("dt.timestamp", ts, dt.FLOAT, scale)
 
     def strftime(self, fmt: Any) -> ColumnExpression:
         return self._m("dt.strftime", lambda d, f: d.strftime(f), dt.STR, fmt)

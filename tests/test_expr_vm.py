@@ -415,3 +415,273 @@ def test_end_to_end_pipeline_matches_disable_native():
     assert a.returncode == 0, a.stderr
     assert b.returncode == 0, b.stderr
     assert a.stdout == b.stdout
+
+
+# ---------------------------------------------------------------------------
+# .str / .dt / .num namespace methods: OP_METHOD native implementations vs
+# the closure lambdas (reference evaluates these enums in Rust,
+# src/engine/expression.rs:26-340)
+
+
+def _assert_parity_rows(native, exprs, rows, *, expect_native=True):
+    """_assert_parity over a custom row matrix."""
+    batch = [Update(_key(i), r, 1) for i, r in enumerate(rows)]
+    progs = expr_vm.lower_programs(list(exprs), LAYOUT)
+    if expect_native:
+        assert progs is not None, "expected a native lowering"
+    if progs is None:
+        return
+    out = native.vm_eval_batch(batch, progs, Update, api.ERROR, lambda x: None)
+    closures = [e._compile(LAYOUT.resolver) for e in exprs]
+    for u_in, u_out in zip(batch, out):
+        expected = []
+        row_raised = False
+        for c in closures:
+            try:
+                expected.append(c((u_in.key, u_in.values)))
+            except Exception:
+                row_raised = True
+                break
+        if row_raised:
+            expected = [api.ERROR]
+        got = list(u_out.values)
+        assert [_canon(g) for g in got] == [_canon(e) for e in expected], (
+            u_in.values,
+            got,
+            expected,
+        )
+
+
+_STR_ROWS = [
+    ("  Hello World  ", "l", 0),
+    ("csv,data,123", ",", 0),
+    ("", "", 0),
+    ("ÜniCödé Στρ", "ö", 0),          # non-ASCII: Unicode fallback paths
+    ("MiXeD cAsE", "c", 0),
+    ("don't stop", "o", 0),           # title() apostrophe rule
+    ("aaa", "aa", 0),                 # overlapping count
+    ("\t spaced \n", " ", 0),
+    ("x" * 300, "x", 0),
+    (None, "a", 0),                   # propagate_none
+    (E, "a", 0),                      # propagate ERROR
+    (123, "a", 0),                    # non-str -> closure raises -> ERROR
+]
+
+
+def test_method_str_simple_parity(native):
+    exprs = [
+        X.str.lower(), X.str.upper(), X.str.swapcase(), X.str.title(),
+        X.str.reversed(), X.str.len(), X.str.strip(), X.str.lstrip(),
+        X.str.rstrip(), X.str.strip(" dH\t\n"), X.str.lstrip("x"),
+        X.str.rstrip("  "),
+    ]
+    _assert_parity_rows(native, exprs, _STR_ROWS)
+
+
+def test_method_str_search_parity(native):
+    exprs = [
+        X.str.count("a"), X.str.count(""), X.str.find("o"),
+        X.str.find("o", 3), X.str.find("o", 1, 9), X.str.find("o", -4),
+        X.str.rfind("o"), X.str.rfind("o", 2, -1),
+        X.str.startswith("  H"), X.str.endswith("  "),
+        X.str.startswith(""), X.str.replace("a", "A"),
+        X.str.replace("a", "A", 1), X.str.slice(2, 7),
+        X.str.slice(-5, -1), X.str.slice(4, 2), X.str.slice(0, 10**30),
+    ]
+    _assert_parity_rows(native, exprs, _STR_ROWS)
+
+
+def test_method_str_parse_parity(native):
+    rows = [
+        ("42", 0, 0), ("  -17  ", 0, 0), ("3.5", 0, 0), ("1_000", 0, 0),
+        ("0x1f", 0, 0), ("", 0, 0), ("inf", 0, 0), ("-2.5e3", 0, 0),
+        ("nan", 0, 0), ("yes", 0, 0), ("NO", 0, 0), ("True", 0, 0),
+        ("on", 0, 0), ("junk", 0, 0), ("2" * 40, 0, 0),
+        (None, 0, 0), (E, 0, 0),
+    ]
+    exprs = [
+        X.str.parse_int(), X.str.parse_int(optional=True),
+        X.str.parse_float(), X.str.parse_float(optional=True),
+        X.str.parse_bool(optional=True),
+        X.str.parse_bool(true_values=("yes",), false_values=("no",),
+                         optional=True),
+    ]
+    _assert_parity_rows(native, exprs, rows)
+    # non-optional parse_bool raises per row -> whole-row ERROR parity
+    _assert_parity_rows(native, [X.str.parse_bool()], rows)
+
+
+_FMTS = [
+    "%Y-%m-%d %H:%M:%S",
+    "%Y-%m-%dT%H:%M:%S.%f",
+    "%d/%m/%y %H:%M",
+    "%Y%m%d%H%M%S",
+    "%I:%M %p",
+    "%Y-%m-%d %H:%M:%S %z",
+    "%Y-%j",
+    "%d %b %Y",        # %b: month names -> Python strptime fallback
+]
+
+
+def test_method_strptime_parity(native):
+    samples = [
+        "2020-03-04 10:20:30", "2020-03-04T10:20:30.123456",
+        "2020-03-04T10:20:30.5", "04/03/99 23:59", "04/03/69 00:00",
+        "20200304102030", "11:30 PM", "11:30 am", "12:01 AM",
+        "2020-03-04 10:20:30 +0530", "2020-03-04 10:20:30 Z",
+        "2020-03-04 10:20:30 -07:00", "2020-03-04 10:20:30 +053015",
+        "2020-366", "2020-060", "2019-365", "04 Mar 2020",
+        "not a date", "2020-13-04 10:20:30", "2020-03-04", "",
+    ]
+    rows = [(s, 0, 0) for s in samples] + [(None, 0, 0), (E, 0, 0)]
+    for fmt in _FMTS:
+        _assert_parity_rows(
+            native, [X.str.parse_datetime(fmt), X.dt.strptime(fmt)], rows
+        )
+
+
+def test_method_strftime_parity(native):
+    import datetime as dtm
+
+    from pathway_tpu.internals.dtype import DateTimeNaive, DateTimeUtc
+
+    rows = [
+        (DateTimeNaive(2020, 3, 4, 10, 20, 30, 123456), 0, 0),
+        (DateTimeNaive(1969, 12, 31, 23, 59, 59), 0, 0),
+        (DateTimeNaive(50, 1, 2), 0, 0),            # no %Y zero-pad (glibc)
+        (DateTimeNaive(2024, 2, 29, 0, 0, 1), 0, 0),
+        (dtm.datetime(2020, 3, 4, 13, 1, 2, tzinfo=dtm.timezone.utc), 0, 0),
+        (None, 0, 0),
+        (E, 0, 0),
+    ]
+    for fmt in ["%Y-%m-%d %H:%M:%S", "%y/%j %I%p", "%H:%M:%S.%f", "%% %d",
+                "%A %d %B"]:  # %A/%B -> Python fallback
+        _assert_parity_rows(native, [X.dt.strftime(fmt)], rows)
+
+
+def test_method_dt_fields_parity(native):
+    import datetime as dtm
+
+    from pathway_tpu.internals.dtype import DateTimeNaive, DateTimeUtc
+
+    rows = [
+        (DateTimeNaive(2020, 3, 4, 10, 20, 30, 123456), 0, 0),
+        (DateTimeNaive(1969, 12, 31, 23, 59, 59, 999999), 0, 0),
+        (DateTimeNaive(2024, 2, 29), 0, 0),
+        (DateTimeNaive(2024, 12, 31), 0, 0),
+        (DateTimeNaive(1, 1, 1), 0, 0),
+        (dtm.datetime(2020, 1, 1, tzinfo=dtm.timezone.utc), 0, 0),
+        (DateTimeUtc(2021, 6, 15, 12, tzinfo=dtm.timezone.utc), 0, 0),
+        (None, 0, 0),
+        (E, 0, 0),
+        ("not a date", 0, 0),
+    ]
+    exprs = [
+        X.dt.nanosecond(), X.dt.microsecond(), X.dt.millisecond(),
+        X.dt.second(), X.dt.minute(), X.dt.hour(), X.dt.day(),
+        X.dt.month(), X.dt.year(), X.dt.day_of_week(), X.dt.day_of_year(),
+    ]
+    _assert_parity_rows(native, exprs, rows)
+    for unit in ("s", "ms", "us", "ns"):
+        _assert_parity_rows(native, [X.dt.timestamp(unit=unit)], rows)
+
+
+def test_method_dt_round_floor_parity(native):
+    import datetime as dtm
+    from zoneinfo import ZoneInfo
+
+    from pathway_tpu.internals.dtype import DateTimeNaive, Duration
+
+    rows = [
+        (DateTimeNaive(2020, 3, 4, 10, 20, 30, 123456), Duration(minutes=15), 0),
+        (DateTimeNaive(2020, 3, 4, 10, 7, 30), Duration(minutes=15), 0),
+        (DateTimeNaive(1969, 12, 31, 23, 59, 59), Duration(hours=1), 0),
+        (DateTimeNaive(2020, 3, 4, 10, 20, 30), Duration(seconds=7), 0),
+        (DateTimeNaive(2020, 3, 4), Duration(days=1), 0),
+        (DateTimeNaive(2020, 3, 4, 12), Duration(days=1), 0),  # .5 ties
+        (DateTimeNaive(2020, 3, 5, 12), Duration(days=1), 0),
+        (dtm.datetime(2020, 3, 4, 10, 20, tzinfo=dtm.timezone.utc),
+         Duration(minutes=30), 0),
+        (dtm.datetime(2020, 3, 8, 2, 30,
+                      tzinfo=ZoneInfo("America/New_York")),
+         Duration(hours=1), 0),                       # DST-gap wall time
+        (DateTimeNaive(2020, 3, 4), Duration(0), 0),  # zero step -> ERROR
+        (None, Duration(minutes=1), 0),
+        (E, Duration(minutes=1), 0),
+    ]
+    _assert_parity_rows(native, [X.dt.round(Y), X.dt.floor(Y)], rows)
+
+
+def test_method_duration_parity(native):
+    from pathway_tpu.internals.dtype import Duration
+
+    rows = [
+        (Duration(days=3, hours=5, minutes=7, seconds=11, microseconds=13), 0, 0),
+        (Duration(days=-3, hours=-5), 0, 0),
+        (Duration(0), 0, 0),
+        (Duration(microseconds=1), 0, 0),
+        (Duration(days=10**5), 0, 0),
+        (Duration(weeks=-1, days=3), 0, 0),
+        (None, 0, 0),
+        (E, 0, 0),
+        (5, 0, 0),  # non-duration -> closure raises -> ERROR
+    ]
+    exprs = [
+        X.dt.nanoseconds(), X.dt.microseconds(), X.dt.milliseconds(),
+        X.dt.seconds(), X.dt.minutes(), X.dt.hours(), X.dt.days(),
+        X.dt.weeks(),
+    ]
+    _assert_parity_rows(native, exprs, rows)
+
+
+def test_method_num_parity(native):
+    rows = [
+        (5, 3, 0), (-5, 0, 0), (2.5, 1, 0), (-2.5, 2, 0),
+        (float("nan"), 9, 0), (float("-inf"), 0, 0), (2**100, 0, 0),
+        (-(2**100), 0, 0), (True, 0, 0), (None, 7, 0), (E, 7, 0),
+        ("x", 0, 0),
+    ]
+    _assert_parity_rows(
+        native,
+        [X.num.abs(), X.num.fill_na(-1), X.num.fill_na(Y)],
+        rows,
+    )
+
+
+def test_method_fallbacks_still_lower(native):
+    """Methods outside the native set embed as CALL_PY but the program
+    still compiles (mixed native + fallback in one select)."""
+    from pathway_tpu.internals.dtype import DateTimeNaive
+
+    rows = [(DateTimeNaive(2020, 3, 4, 10, 20, 30), 2.0, 0), (None, 1.0, 0)]
+    exprs = [
+        X.dt.to_utc("Europe/Paris"),
+        X.dt.to_naive_in_timezone("Asia/Tokyo"),
+        Y.num.round(1),
+    ]
+    _assert_parity_rows(native, exprs, rows, expect_native=False)
+
+
+def test_method_strptime_matches_python_over_format_grid(native):
+    """Round-trip grid: strftime(fmt) then strptime(fmt) through BOTH
+    paths over a set of datetimes x formats."""
+    import datetime as dtm
+
+    base = [
+        dtm.datetime(2020, 3, 4, 10, 20, 30, 123456),
+        dtm.datetime(1999, 12, 31, 23, 59, 59),
+        dtm.datetime(2024, 2, 29, 0, 0, 1),
+        dtm.datetime(1970, 1, 1),
+    ]
+    for fmt in ["%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S.%f",
+                "%d/%m/%Y %I:%M %p", "%Y%m%d %H%M%S"]:
+        rows = [(d.strftime(fmt), 0, 0) for d in base]
+        _assert_parity_rows(native, [X.str.parse_datetime(fmt)], rows)
+        # and the parsed values are the true datetimes
+        batch = [Update(_key(i), r, 1) for i, r in enumerate(rows)]
+        progs = expr_vm.lower_programs([X.str.parse_datetime(fmt)], LAYOUT)
+        out = native.vm_eval_batch(batch, progs, Update, api.ERROR,
+                                   lambda x: None)
+        for d, u in zip(base, out):
+            expected = dtm.datetime.strptime(d.strftime(fmt), fmt)
+            assert u.values[0] == expected
