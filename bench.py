@@ -47,7 +47,7 @@ EMBED_SEQ = 128
 EMBED_BATCH = 512  # chunk size; encode() pipelines chunk i+1 over i's readback
 EMBED_DEPTH = 4  # in-flight chunks (hides the host link RTT)
 EMBED_DOCS = 8192
-EMBED_TRIALS = 3  # report the MEDIAN e2e pass (tunnel variance is +-40%)
+EMBED_TRIALS = 5  # report MEDIAN (headline) + BEST (tunnel variance)
 EMBED_TARGET_PER_CHIP = 10_000 / 8  # BASELINE target is for v5e-8
 
 WC_LINES = 2_000_000
@@ -176,6 +176,46 @@ def bench_knn(extra: dict) -> float:
     extra["knn_p50_single_query_pipelined_ms"] = round(pipe_p50, 3)
     extra["knn_pipelined_queries_per_sec"] = round(NPIPE / pipe_wall, 1)
 
+    # Device-side single-query latency: the <50ms target without the
+    # tunnel RTT caveat.  Estimator: dispatches queue on the device and
+    # execute back-to-back, so wall(n2 dispatches+block) - wall(n1+block)
+    # cancels the one host round trip and divides out to the on-device
+    # service time per query.  Five repeats; report the median slope.
+    N1, N2 = 4, 20
+    slopes = []
+    for _ in range(5):
+        # timing collects only the LAST handle (device executes FIFO, so
+        # it blocks until the whole queue drained); the rest are drained
+        # after each timing so _inflight bookkeeping stays balanced
+        hs = []
+        t0 = time.perf_counter()
+        for i in range(N1):
+            hs.append(
+                idx.dispatch(queries[i % N_QUERIES : i % N_QUERIES + 1], K)
+            )
+        idx.collect(hs[-1])
+        t_a = time.perf_counter() - t0
+        for h in hs[:-1]:
+            idx.collect(h)
+        hs = []
+        t0 = time.perf_counter()
+        for i in range(N2):
+            hs.append(
+                idx.dispatch(queries[i % N_QUERIES : i % N_QUERIES + 1], K)
+            )
+        idx.collect(hs[-1])
+        t_b = time.perf_counter() - t0
+        for h in hs[:-1]:
+            idx.collect(h)
+        slopes.append((t_b - t_a) * 1000.0 / (N2 - N1))
+    slopes.sort()
+    dev_q = slopes[len(slopes) // 2]
+    log(
+        f"device-side single-query service time: p50={dev_q:.2f}ms "
+        f"(RTT-cancelled slope over {N1}->{N2} queued dispatches x5)"
+    )
+    extra["knn_p50_device_single_query_ms"] = round(dev_q, 3)
+
     # Headline: per-query latency in the engine's serving mode — all of an
     # epoch's queries answered in ONE batched dispatch + ONE readback
     # (exactly what ExternalIndexNode does).
@@ -234,26 +274,33 @@ def bench_embed(extra: dict) -> None:
     # shape — the first cold pass otherwise pays every compile and reads
     # ~50% low
     enc.encode(docs[:EMBED_BATCH])
-    enc.encode(docs[: EMBED_BATCH * EMBED_DEPTH])
+    enc.encode_into(idx, range(EMBED_BATCH * EMBED_DEPTH),
+                    docs[: EMBED_BATCH * EMBED_DEPTH])
     idx.add_batch(
         range(EMBED_DOCS), np.zeros((EMBED_DOCS, cfg.hidden), np.float32)
     )
     jax.block_until_ready(idx._vectors)
 
     # repeated full passes: the tunnel RTT and shared-TPU load swing
-    # single passes by +-40%, so the headline is the MEDIAN trial
+    # single passes by +-40%, so the headline is the MEDIAN trial.  The
+    # pipeline is tokenize -> encode -> index with the embeddings staying
+    # in HBM (encode_into/add_batch_device): only token ids cross the
+    # host link, so a congested tunnel no longer caps the number — and
+    # on any deployment, skipping the host round trip is simply the
+    # right TPU-native design for embed+index.
     trial_dps = []
     done = EMBED_DOCS
     for trial in range(EMBED_TRIALS):
         t0 = time.perf_counter()
-        embs = enc.encode(docs)  # chunks of EMBED_BATCH, pipelined readback
-        idx.add_batch(range(EMBED_DOCS), embs)
+        n_done = enc.encode_into(idx, range(EMBED_DOCS), docs)
         jax.block_until_ready(idx._vectors)
         trial_dt = time.perf_counter() - t0
+        assert n_done == EMBED_DOCS
         trial_dps.append(done / trial_dt)
         log(f"  e2e trial {trial}: {done / trial_dt:.0f} docs/s")
     trial_dps.sort()
     dps = trial_dps[len(trial_dps) // 2]
+    best_dps = trial_dps[-1]
     dt = done / dps
 
     # device steady state (re-dispatch one resident chunk): isolates the
@@ -293,6 +340,7 @@ def bench_embed(extra: dict) -> None:
         + f"; target share {target:.0f} docs/s"
     )
     extra["embed_docs_per_sec"] = round(dps, 1)
+    extra["embed_docs_per_sec_best"] = round(best_dps, 1)
     extra["embed_docs_per_sec_trials"] = [round(x, 1) for x in trial_dps]
     extra["embed_mfu_pct"] = round(mfu * 100, 1) if mfu is not None else None
     extra["embed_device_docs_per_sec"] = round(dev_dps, 1)
@@ -351,17 +399,15 @@ def bench_wordcount(extra: dict) -> None:
     extra["wordcount_persistence"] = "PERSISTING"
 
 
-def bench_wordcount_multiprocess(extra: dict) -> None:
-    """The same wordcount across a 2-process TCP cluster (spawn env
-    contract) — the scale story the thread mode (GIL-bound) can't tell."""
+def _run_wc_cluster(n_procs: int, fp: str, d: str) -> tuple[float, float]:
+    """Run the wordcount over an n-process TCP cluster; returns
+    (slowest worker RUN_SECONDS, summed worker CPU seconds)."""
     import subprocess
     import textwrap
 
     repo = os.path.dirname(os.path.abspath(__file__))
-    d = tempfile.mkdtemp(prefix="pw_bench_wc_mp_")
-    fp = _write_wc_input(d)
-    out_fp = os.path.join(d, "out.jsonl")
-    prog = os.path.join(d, "prog.py")
+    out_fp = os.path.join(d, f"out_{n_procs}.jsonl")
+    prog = os.path.join(d, f"prog_{n_procs}.py")
     with open(prog, "w") as f:
         f.write(
             textwrap.dedent(
@@ -376,14 +422,15 @@ def bench_wordcount_multiprocess(extra: dict) -> None:
                 t = pw.io.jsonlines.read({fp!r}, schema=S, mode="static")
                 counts = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
                 pw.io.jsonlines.write(counts, {out_fp!r})
-                import time as _time
+                import os as _os, time as _time
                 _t0 = _time.perf_counter()
                 pw.run(autocommit_duration_ms=200)
                 print("RUN_SECONDS=%.3f" % (_time.perf_counter() - _t0))
+                _cpu = _os.times()
+                print("CPU_SECONDS=%.3f" % (_cpu.user + _cpu.system))
                 """
             )
         )
-    n_procs = 2
     import socket
 
     s = socket.socket()
@@ -397,7 +444,6 @@ def bench_wordcount_multiprocess(extra: dict) -> None:
         PATHWAY_FIRST_PORT=str(port),
         JAX_PLATFORMS="cpu",
     )
-    log(f"wordcount multiprocess: {WC_LINES} lines over {n_procs} processes")
     procs = []
     for pid in range(n_procs):
         e = dict(env, PATHWAY_PROCESS_ID=str(pid))
@@ -409,7 +455,7 @@ def bench_wordcount_multiprocess(extra: dict) -> None:
                 stderr=subprocess.PIPE,
             )
         )
-    run_secs = []
+    run_secs, cpu_secs = [], []
     for p in procs:
         out, err = p.communicate(timeout=600)
         if p.returncode != 0:
@@ -417,17 +463,37 @@ def bench_wordcount_multiprocess(extra: dict) -> None:
         for line in out.decode().splitlines():
             if line.startswith("RUN_SECONDS="):
                 run_secs.append(float(line.split("=", 1)[1]))
-    # per-run wall time (slowest worker), excluding interpreter + jax
-    # import startup — the steady-state cluster rate, which is what the
-    # thread-vs-process scaling question is about
-    dt = max(run_secs)
-    rps = WC_LINES / dt
-    log(
-        f"wordcount multiprocess: {rps:.0f} rows/s over {n_procs} procs "
-        f"(run {dt:.1f}s, startup excluded)"
-    )
-    extra["wordcount_multiprocess_rows_per_sec"] = round(rps)
-    extra["wordcount_multiprocess_n_procs"] = n_procs
+            elif line.startswith("CPU_SECONDS="):
+                cpu_secs.append(float(line.split("=", 1)[1]))
+    return max(run_secs), sum(cpu_secs)
+
+
+def bench_wordcount_multiprocess(extra: dict) -> None:
+    """The same wordcount across 2- and 4-process TCP clusters (spawn env
+    contract) — the scale story the thread mode (GIL-bound) can't tell.
+
+    Wall-clock speedup needs free cores: on a 1-core host (this driver
+    box) the theoretical ceiling for N processes is 1.0x a single
+    process, so the honest scaling evidence is (a) the host core count,
+    (b) the summed worker CPU seconds vs the single-process run (the
+    exchange + routing overhead the binary frame format minimizes), and
+    (c) the wall number itself on hosts that do have cores."""
+    d = tempfile.mkdtemp(prefix="pw_bench_wc_mp_")
+    fp = _write_wc_input(d)
+    n_cores = os.cpu_count() or 1
+    extra["host_cpu_cores"] = n_cores
+    log(f"wordcount multiprocess: {WC_LINES} lines, host has {n_cores} core(s)")
+    for n_procs in (2, 4):
+        dt, cpu = _run_wc_cluster(n_procs, fp, d)
+        rps = WC_LINES / dt
+        key = "wordcount_multiprocess" if n_procs == 2 else "wordcount_4proc"
+        log(
+            f"wordcount {n_procs}-process: {rps:.0f} rows/s "
+            f"(run {dt:.1f}s, {cpu:.1f} CPU-s total, startup excluded)"
+        )
+        extra[f"{key}_rows_per_sec"] = round(rps)
+        extra[f"{key}_cpu_seconds"] = round(cpu, 1)
+    extra["wordcount_multiprocess_n_procs"] = 2
 
 
 def bench_select(extra: dict) -> None:
@@ -457,6 +523,115 @@ def bench_select(extra: dict) -> None:
     extra["select_rows_per_sec"] = round(N / dt)
 
 
+def bench_strdt(extra: dict) -> None:
+    """String/datetime expression throughput: the OP_METHOD native
+    namespace ops (reference evaluates these enums in Rust,
+    src/engine/expression.rs:26-340)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    N = 300_000
+    rows = [
+        (
+            f"2020-03-{(i % 27) + 1:02d} 10:{i % 60:02d}:{(i * 7) % 60:02d}",
+            f"  User {i} Name  ",
+        )
+        for i in range(N)
+    ]
+    t = pw.debug.table_from_rows(pw.schema_from_types(ts=str, name=str), rows)
+    parsed = t.select(
+        d=t.ts.str.parse_datetime("%Y-%m-%d %H:%M:%S"),
+        clean=t.name.str.strip().str.lower(),
+    )
+    out = parsed.select(
+        hour=parsed.d.dt.hour(),
+        dow=parsed.d.dt.day_of_week(),
+        stamp=parsed.d.dt.timestamp(),
+        rounded=parsed.d.dt.round(pw.Duration(minutes=15)),
+        tag=parsed.clean.str.replace(" ", "_"),
+    )
+    cap = out._capture_node()
+    t0 = time.perf_counter()
+    ctx = pw.run()
+    dt = time.perf_counter() - t0
+    assert len(ctx.state(cap)["rows"]) == N
+    log(f"string/datetime pipeline: {N / dt:.0f} rows/s")
+    extra["strdt_rows_per_sec"] = round(N / dt)
+
+
+def bench_streaming_latency(extra: dict) -> None:
+    """End-to-end streaming latency percentiles vs offered rate: timed
+    source -> groupby count -> subscribe, latency = sink wall time minus
+    the row's produce time.  Mirrors the reference's p50-p99
+    latency-vs-rate suite
+    (examples/projects/kafka-alternatives/benchmarks/README.md:19-33)."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    results = {}
+    for rate in (10_000, 20_000, 30_000):
+        G.clear()
+        n_msgs = min(rate * 2, 40_000)  # ~2s of traffic per rate step
+
+        class Source(pw.io.python.ConnectorSubject):
+            def run(self) -> None:
+                t_start = time.perf_counter()
+                sent = 0
+                while sent < n_msgs:
+                    # pace to the offered rate in 1ms micro-slices
+                    target = int((time.perf_counter() - t_start) * rate)
+                    burst = min(target - sent, 2000)
+                    if burst <= 0:
+                        time.sleep(0.0005)
+                        continue
+                    now = time.perf_counter()
+                    for i in range(sent, sent + burst):
+                        self.next(
+                            key=f"k{i % 100}", produced_at=now
+                        )
+                    sent += burst
+
+        class S(pw.Schema):
+            key: str
+            produced_at: float
+
+        t = pw.io.python.read(Source(), schema=S)
+        counts = t.groupby(t.key).reduce(
+            t.key,
+            n=pw.reducers.count(),
+            last_produced=pw.reducers.max(t.produced_at),
+        )
+        lats: list = []
+
+        def on_change(key, row, time_, is_addition, lats=lats):
+            if is_addition:
+                lats.append(time.perf_counter() - row["last_produced"])
+
+        pw.io.subscribe(counts, on_change)
+        t0 = time.perf_counter()
+        pw.run(autocommit_duration_ms=50, monitoring_level=pw.MonitoringLevel.NONE)
+        wall = time.perf_counter() - t0
+        lats.sort()
+
+        def pct(p: float) -> float:
+            return round(lats[min(len(lats) - 1, int(p * len(lats)))] * 1000.0, 1)
+
+        achieved = n_msgs / wall
+        results[str(rate)] = {
+            "p50_ms": pct(0.50),
+            "p95_ms": pct(0.95),
+            "p99_ms": pct(0.99),
+            "achieved_msgs_per_sec": round(achieved),
+        }
+        log(
+            f"streaming latency @ {rate} msg/s offered: "
+            f"p50={pct(0.50)}ms p95={pct(0.95)}ms p99={pct(0.99)}ms "
+            f"({achieved:.0f} msg/s achieved)"
+        )
+    extra["streaming_latency_vs_rate"] = results
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -465,27 +640,23 @@ def main() -> None:
     # caretaker still bounds cycles; see internals/run.py _ManagedGc)
     os.environ.setdefault("PATHWAY_GC_INTERVAL_S", "10")
     extra: dict = {}
+    # host-plane benches run FIRST, on a heap not yet holding jax buffers
+    # or the 1M-doc corpus bookkeeping (their numbers used to sag ~10%
+    # when run after the TPU sections)
+    for fn, slug in [
+        (bench_wordcount, "wordcount"),
+        (bench_wordcount_multiprocess, "wordcount_multiprocess"),
+        (bench_select, "select"),
+        (bench_strdt, "strdt"),
+        (bench_streaming_latency, "streaming_latency"),
+        (bench_embed, "embed"),
+    ]:
+        try:
+            fn(extra)
+        except Exception as e:  # noqa: BLE001 — no bench masks the headline
+            log(f"{slug} bench failed: {e!r}")
+            extra[f"{slug}_error"] = repr(e)
     p50 = bench_knn(extra)
-    try:
-        bench_embed(extra)
-    except Exception as e:  # noqa: BLE001 — embed bench must not mask headline
-        log(f"embed bench failed: {e!r}")
-        extra["embed_error"] = repr(e)
-    try:
-        bench_wordcount(extra)
-    except Exception as e:  # noqa: BLE001
-        log(f"wordcount bench failed: {e!r}")
-        extra["wordcount_error"] = repr(e)
-    try:
-        bench_wordcount_multiprocess(extra)
-    except Exception as e:  # noqa: BLE001
-        log(f"wordcount multiprocess bench failed: {e!r}")
-        extra["wordcount_multiprocess_error"] = repr(e)
-    try:
-        bench_select(extra)
-    except Exception as e:  # noqa: BLE001
-        log(f"select bench failed: {e!r}")
-        extra["select_error"] = repr(e)
 
     print(
         json.dumps(

@@ -4858,6 +4858,33 @@ bool wf_pack_value(std::string& buf, PyObject* v) {
     return true;
 }
 
+// shared row codec: 16-byte key + count byte + tagged values (0xFF =
+// whole-values pickle).  Both frame formats (updates, kv pairs) are this
+// row plus format-specific fields, so there is exactly ONE copy of the
+// value-encoding logic.
+bool wf_pack_row(std::string& buf, PyObject* key, PyObject* values) {
+    uint8_t kb[16];
+    if (pt_long_as_bytes_unsigned(key, kb, sizeof kb) < 0) {
+        // 3.13+ reports too-large keys without raising; keys are 128-bit
+        // by contract so surface a clean error either way
+        if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_ValueError, "key does not fit 16 bytes");
+        return false;
+    }
+    buf.append(reinterpret_cast<const char*>(kb), sizeof kb);
+    if (PyTuple_CheckExact(values) && PyTuple_GET_SIZE(values) < 255) {
+        buf.push_back(static_cast<char>(PyTuple_GET_SIZE(values)));
+        for (Py_ssize_t j = 0; j < PyTuple_GET_SIZE(values); j++) {
+            if (!wf_pack_value(buf, PyTuple_GET_ITEM(values, j)))
+                return false;
+        }
+        return true;
+    }
+    buf.push_back(static_cast<char>(0xFF));
+    return wf_pack_pickled(buf, values);
+}
+
+
 PyObject* py_pack_updates(PyObject*, PyObject* batch) {
     PyObject* seq = PySequence_Fast(batch, "pack_updates expects a sequence");
     if (seq == nullptr) return nullptr;
@@ -4872,40 +4899,17 @@ PyObject* py_pack_updates(PyObject*, PyObject* batch) {
             Py_DECREF(seq);
             return nullptr;
         }
-        PyObject* key = PyTuple_GET_ITEM(u, 0);
-        PyObject* values = PyTuple_GET_ITEM(u, 1);
-        PyObject* diff = PyTuple_GET_ITEM(u, 2);
-        uint8_t kb[16];
-        if (pt_long_as_bytes_unsigned(key, kb, sizeof kb) < 0) {
+        if (!wf_pack_row(buf, PyTuple_GET_ITEM(u, 0),
+                         PyTuple_GET_ITEM(u, 1))) {
             Py_DECREF(seq);
-            return nullptr;  // keys are 128-bit non-negative by contract
+            return nullptr;
         }
-        buf.append(reinterpret_cast<const char*>(kb), sizeof kb);
-        long long d = PyLong_AsLongLong(diff);
+        long long d = PyLong_AsLongLong(PyTuple_GET_ITEM(u, 2));
         if (d == -1 && PyErr_Occurred()) {
             Py_DECREF(seq);
             return nullptr;
         }
         wf_put_varint(buf, d);
-        if (PyTuple_CheckExact(values) && PyTuple_GET_SIZE(values) < 255) {
-            buf.push_back(static_cast<char>(PyTuple_GET_SIZE(values)));
-            bool ok = true;
-            for (Py_ssize_t j = 0; ok && j < PyTuple_GET_SIZE(values); j++) {
-                ok = wf_pack_value(buf, PyTuple_GET_ITEM(values, j));
-            }
-            if (!ok) {
-                Py_DECREF(seq);
-                return nullptr;
-            }
-        } else {
-            // not a plain small tuple (Update.values is by contract, but
-            // stay total): whole-values pickle
-            buf.push_back(static_cast<char>(0xFF));
-            if (!wf_pack_pickled(buf, values)) {
-                Py_DECREF(seq);
-                return nullptr;
-            }
-        }
     }
     Py_DECREF(seq);
     return PyBytes_FromStringAndSize(buf.data(),
@@ -5055,6 +5059,46 @@ PyObject* wf_unpack_value(WfReader& r) {
     return nullptr;
 }
 
+// returns new refs in *key_out / *values_out; false with exception set
+bool wf_unpack_row(WfReader& r, PyObject** key_out, PyObject** values_out) {
+    const uint8_t* kb = r.bytes(16);
+    uint8_t nvals = r.u8();
+    if (kb == nullptr || r.fail) {
+        PyErr_SetString(PyExc_ValueError, "truncated row in frame");
+        return false;
+    }
+    PyObject* values;
+    if (nvals == 0xFF) {
+        values = wf_unpack_value(r);  // whole-values pickle
+    } else {
+        values = PyTuple_New(nvals);
+        for (uint8_t j = 0; values != nullptr && j < nvals; j++) {
+            PyObject* v = wf_unpack_value(r);
+            if (v == nullptr) {
+                Py_DECREF(values);
+                values = nullptr;
+                break;
+            }
+            PyTuple_SET_ITEM(values, j, v);
+        }
+    }
+    if (values == nullptr) return false;
+    PyObject* num = pt_long_from_bytes_unsigned(kb, 16);
+    if (num == nullptr) {
+        Py_DECREF(values);
+        return false;
+    }
+    PyObject* key = PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
+    Py_DECREF(num);
+    if (key == nullptr) {
+        Py_DECREF(values);
+        return false;
+    }
+    *key_out = key;
+    *values_out = values;
+    return true;
+}
+
 PyObject* py_unpack_updates(PyObject*, PyObject* arg) {
     char* data;
     Py_ssize_t nbytes;
@@ -5074,40 +5118,14 @@ PyObject* py_unpack_updates(PyObject*, PyObject* arg) {
     PyObject* out = PyList_New(static_cast<Py_ssize_t>(n));
     if (out == nullptr) return nullptr;
     for (uint32_t i = 0; i < n; i++) {
-        const uint8_t* kb = r.bytes(16);
-        long long diff = r.varint();
-        uint8_t nvals = r.u8();
-        if (kb == nullptr || r.fail) {
-            PyErr_SetString(PyExc_ValueError, "truncated update frame");
-            goto fail;
-        }
+        PyObject *key, *values;
+        if (!wf_unpack_row(r, &key, &values)) goto fail;
         {
-            PyObject* values;
-            if (nvals == 0xFF) {
-                values = wf_unpack_value(r);  // whole-values pickle
-            } else {
-                values = PyTuple_New(nvals);
-                for (uint8_t j = 0; values != nullptr && j < nvals; j++) {
-                    PyObject* v = wf_unpack_value(r);
-                    if (v == nullptr) {
-                        Py_DECREF(values);
-                        values = nullptr;
-                        break;
-                    }
-                    PyTuple_SET_ITEM(values, j, v);
-                }
-            }
-            if (values == nullptr) goto fail;
-            PyObject* num = pt_long_from_bytes_unsigned(kb, 16);
-            if (num == nullptr) {
+            long long diff = r.varint();
+            if (r.fail) {
+                Py_DECREF(key);
                 Py_DECREF(values);
-                goto fail;
-            }
-            PyObject* key =
-                PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
-            Py_DECREF(num);
-            if (key == nullptr) {
-                Py_DECREF(values);
+                PyErr_SetString(PyExc_ValueError, "truncated update frame");
                 goto fail;
             }
             PyObject* dobj = PyLong_FromLongLong(diff);
@@ -5133,6 +5151,72 @@ PyObject* py_unpack_updates(PyObject*, PyObject* arg) {
             PyTuple_SET_ITEM(u, 1, values);
             PyTuple_SET_ITEM(u, 2, dobj);
             PyList_SET_ITEM(out, static_cast<Py_ssize_t>(i), u);
+        }
+    }
+    return out;
+fail:
+    Py_DECREF(out);
+    return nullptr;
+}
+
+PyObject* py_pack_kv(PyObject*, PyObject* rows) {
+    // persistence "addmany" records: (key, values) pairs in the tagged
+    // binary format (pickling 2M-row chunks costs a per-row listcomp +
+    // int conversions; see persistence _RecordingEvents.add_many)
+    PyObject* seq = PySequence_Fast(rows, "pack_kv expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    std::string buf;
+    buf.reserve(static_cast<size_t>(n) * 40 + 8);
+    wf_put_u32(buf, static_cast<uint32_t>(n));
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* kv = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(kv) || PyTuple_GET_SIZE(kv) != 2) {
+            PyErr_SetString(PyExc_TypeError, "rows must be (key, values)");
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        if (!wf_pack_row(buf, PyTuple_GET_ITEM(kv, 0),
+                         PyTuple_GET_ITEM(kv, 1))) {
+            Py_DECREF(seq);
+            return nullptr;
+        }
+    }
+    Py_DECREF(seq);
+    return PyBytes_FromStringAndSize(buf.data(),
+                                     static_cast<Py_ssize_t>(buf.size()));
+}
+
+PyObject* py_unpack_kv(PyObject*, PyObject* arg) {
+    char* data;
+    Py_ssize_t nbytes;
+    if (PyBytes_AsStringAndSize(arg, &data, &nbytes) < 0) return nullptr;
+    if (g_pointer_type == nullptr) {
+        PyErr_SetString(PyExc_RuntimeError, "Pointer type unregistered");
+        return nullptr;
+    }
+    WfReader r{reinterpret_cast<const uint8_t*>(data),
+               reinterpret_cast<const uint8_t*>(data) + nbytes};
+    uint32_t n = r.u32();
+    if (r.fail) {
+        PyErr_SetString(PyExc_ValueError, "truncated kv frame");
+        return nullptr;
+    }
+    PyObject* out = PyList_New(static_cast<Py_ssize_t>(n));
+    if (out == nullptr) return nullptr;
+    for (uint32_t i = 0; i < n; i++) {
+        PyObject *key, *values;
+        if (!wf_unpack_row(r, &key, &values)) goto fail;
+        {
+            PyObject* kv = PyTuple_New(2);
+            if (kv == nullptr) {
+                Py_DECREF(values);
+                Py_DECREF(key);
+                goto fail;
+            }
+            PyTuple_SET_ITEM(kv, 0, key);
+            PyTuple_SET_ITEM(kv, 1, values);
+            PyList_SET_ITEM(out, static_cast<Py_ssize_t>(i), kv);
         }
     }
     return out;
@@ -5235,6 +5319,10 @@ PyMethodDef kMethods[] = {
      "serialize an update batch to a tagged binary frame"},
     {"capture_batch", py_capture_batch, METH_VARARGS,
      "apply an update batch to capture state (stream list + rows dict)"},
+    {"pack_kv", py_pack_kv, METH_O,
+     "serialize (key, values) pairs to a tagged binary frame"},
+    {"unpack_kv", py_unpack_kv, METH_O,
+     "parse a tagged binary kv frame back into (Pointer, values) pairs"},
     {"unpack_updates", py_unpack_updates, METH_O,
      "parse a tagged binary frame back into Update objects"},
     {"vm_compile", py_vm_compile, METH_VARARGS,

@@ -195,11 +195,18 @@ class JittedEncoder:
         mask[n:, 0] = 1
         return ids, mask, tps, n
 
-    def _dispatch(self, ids: np.ndarray, mask: np.ndarray, tps: np.ndarray):
+    def _dispatch(
+        self,
+        ids: np.ndarray,
+        mask: np.ndarray,
+        tps: np.ndarray,
+        start_host_copy: bool = True,
+    ):
         """Enqueue one padded chunk; returns (device_out, n_real_rows).
         The device->host copy is started immediately (non-blocking), so on
         remote/tunneled backends the transfer of chunk i overlaps the
-        tokenize+compute of chunk i+1."""
+        tokenize+compute of chunk i+1.  ``start_host_copy=False`` for
+        consumers that keep the output on device (``encode_into``)."""
         ids, mask, tps, n = self._pad_batch(ids, mask, tps)
         if self.sequence_axis is not None and ids.shape[1] < self.max_len:
             # SP shards the sequence dimension: pad to the full max_len so
@@ -216,9 +223,10 @@ class JittedEncoder:
         if self._in_batch_sharding is not None:
             args = [jax.device_put(a, self._in_batch_sharding) for a in args]
         out = self._apply(self.params, *args)
-        copy_async = getattr(out, "copy_to_host_async", None)
-        if copy_async is not None:
-            copy_async()
+        if start_host_copy:
+            copy_async = getattr(out, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
         return out, n
 
     def _run(self, ids: np.ndarray, mask: np.ndarray, tps: np.ndarray) -> np.ndarray:
@@ -261,6 +269,42 @@ class JittedEncoder:
         if not texts:
             return np.zeros((0, self.config.hidden), np.float32)
         return np.concatenate(self._run_pipelined(list(texts), None), axis=0)
+
+    def encode_into(self, index: Any, keys: Sequence[Any], texts: Sequence[str]) -> int:
+        """Embed ``texts`` and upsert the embeddings into ``index``
+        (``ShardedKnnIndex.add_batch_device``) entirely on device — no
+        embedding ever crosses the host link.  The reference embedder
+        reads every vector back through host memory before indexing
+        (python/pathway/xpacks/llm/embedders.py:270-327); on TPU the
+        index slab lives in the same HBM, so the chunk pipeline here
+        only ships token ids up and nothing down.  Returns the number of
+        rows indexed."""
+        if self.cross:
+            raise TypeError("cross-encoder executor: use score_pairs()")
+        texts = list(texts)
+        keys = list(keys)
+        if len(keys) != len(texts):
+            raise ValueError("keys and texts must align")
+        if not texts:
+            return 0
+        from collections import deque
+
+        inflight: deque = deque()
+        pos = 0
+        for chunk, _p in self._chunks(texts, None):
+            ids, mask, tps = self.tokenizer.encode_batch(
+                chunk, max_len=self.max_len
+            )
+            out, n = self._dispatch(ids, mask, tps, start_host_copy=False)
+            inflight.append((out, n, keys[pos : pos + n]))
+            pos += n
+            if len(inflight) >= self.pipeline_depth:
+                out, n, kchunk = inflight.popleft()
+                index.add_batch_device(kchunk, out, n_valid=n)
+        while inflight:
+            out, n, kchunk = inflight.popleft()
+            index.add_batch_device(kchunk, out, n_valid=n)
+        return pos
 
     def score_pairs(self, queries: Sequence[str], docs: Sequence[str]) -> np.ndarray:
         """Cross-encoder scores for aligned (query, doc) pairs -> [n]."""

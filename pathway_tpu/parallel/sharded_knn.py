@@ -116,6 +116,44 @@ class ShardedKnnIndex:
     def _scatter_clear(valid, slots):
         return valid.at[slots].set(0.0, mode="drop")
 
+    @staticmethod
+    @functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4,))
+    def _scatter_set_device(vectors, valid, slots, vals, normalize):
+        # normalize/cast on device: the device-resident ingest path never
+        # moves the embeddings across the host link
+        vals = vals.astype(jnp.float32)
+        if normalize:
+            n = jnp.linalg.norm(vals, axis=1, keepdims=True)
+            vals = vals / jnp.maximum(n, 1e-30)
+        vals = vals.astype(vectors.dtype)
+        vectors = vectors.at[slots].set(vals, mode="drop")
+        valid = valid.at[slots].set(1.0, mode="drop")
+        return vectors, valid
+
+    def _assign_slots(self, keys: Sequence[Any], pad_to: int) -> np.ndarray:
+        """Slot per key (allocating new slots as needed, growing the slab
+        when full); rows beyond ``len(keys)`` pad with ``capacity`` so the
+        scatter's mode="drop" ignores them.  The ONE copy of the
+        free-list/cursor bookkeeping, shared by the host and device
+        ingest paths."""
+        slot_of = self._slot_of
+        n_new = sum(1 for key in keys if key not in slot_of)
+        while len(slot_of) + n_new > self.capacity:
+            self._grow()
+        slots = np.full(pad_to, self.capacity, np.int32)
+        key_of = self._key_of
+        free = self._free
+        for i, key in enumerate(keys):
+            slot = slot_of.get(key)
+            if slot is None:
+                slot = free.pop() if free else self._cursor
+                if slot == self._cursor:
+                    self._cursor += 1
+                slot_of[key] = slot
+                key_of[slot] = key
+            slots[i] = slot
+        return slots
+
     def add(self, items: Sequence[tuple[Any, np.ndarray]]) -> None:
         """Upsert (key, vector) pairs; one donated scatter per epoch batch."""
         if not items:
@@ -140,33 +178,46 @@ class ShardedKnnIndex:
             raise ValueError(f"{n} keys vs {vectors.shape[0]} vectors")
         if n == 0:
             return
-        slot_of = self._slot_of
-        n_new = sum(1 for key in keys if key not in slot_of)
-        while len(slot_of) + n_new > self.capacity:
-            self._grow()
-        slots = np.empty(n, np.int32)
-        key_of = self._key_of
-        free = self._free
-        for i, key in enumerate(keys):
-            slot = slot_of.get(key)
-            if slot is None:
-                slot = free.pop() if free else self._cursor
-                if slot == self._cursor:
-                    self._cursor += 1
-                slot_of[key] = slot
-                key_of[slot] = key
-            slots[i] = slot
+        b = bucket_size(n)
+        slots = self._assign_slots(keys, pad_to=b)
         if self.metric == "cos":
             norms = np.linalg.norm(vectors, axis=1, keepdims=True)
             np.maximum(norms, 1e-30, out=norms)
             vectors = vectors / norms
         vals = vectors.astype(np.dtype(self.dtype), copy=False)
-        b = bucket_size(n)
-        # pad slots with capacity (out of range -> dropped by scatter)
-        slots = pad_rows(slots, b, fill=self.capacity)
         vals = pad_rows(vals, b)
         self._vectors, self._valid = self._scatter_set(
             self._vectors, self._valid, jnp.asarray(slots), jnp.asarray(vals)
+        )
+
+    def add_batch_device(
+        self, keys: Sequence[Any], vectors: Any, n_valid: int | None = None
+    ) -> None:
+        """Upsert from a DEVICE array [b, dim] (an encoder's output)
+        without reading the embeddings back to the host: slot assignment
+        is the only host work; normalization, dtype cast and the scatter
+        all run on device.  Rows at index >= len(keys) (encoder padding)
+        scatter to an out-of-range slot and are dropped.
+
+        The reference's embed+index pipeline round-trips every embedding
+        through host memory (python/pathway/xpacks/llm/embedders.py:
+        270-327 -> index add); on a TPU the vector store lives in the
+        same HBM the encoder writes to, so the round trip is pure waste
+        — and on a tunneled link it dominates the pipeline.
+        """
+        n = len(keys) if n_valid is None else n_valid
+        b = int(vectors.shape[0])
+        if int(vectors.shape[1]) != self.dim:
+            raise ValueError(f"vectors dim {vectors.shape[1]} != {self.dim}")
+        if n > b:
+            raise ValueError(f"{n} keys but only {b} vector rows")
+        slots = self._assign_slots(keys, pad_to=b)
+        self._vectors, self._valid = self._scatter_set_device(
+            self._vectors,
+            self._valid,
+            jnp.asarray(slots),
+            vectors,
+            self.metric == "cos",
         )
 
     def remove(self, keys: Sequence[Any]) -> None:

@@ -30,6 +30,8 @@ import threading
 import time as _time
 from typing import Any
 
+from pathway_tpu.internals import native as _native_mod
+
 __all__ = [
     "Backend",
     "CachedObjectStorage",
@@ -499,13 +501,21 @@ class _RecordingEvents:
             rows = rows[skip:]
         if not rows:
             return
-        self._impl.append(
-            self._stream,
-            pickle.dumps(
+        blob = None
+        native = _native_mod.load()
+        if native is not None:
+            try:
+                # one C pass over the chunk (tagged binary frame) instead
+                # of a per-row int()/tuple listcomp + pickle — the log
+                # write must not bound ingest throughput
+                blob = pickle.dumps(("addmany_b", native.pack_kv(rows), None))
+            except Exception:
+                blob = None
+        if blob is None:
+            blob = pickle.dumps(
                 ("addmany", [(int(k), v) for k, v in rows], None)
-            ),
-            durable=False,
-        )
+            )
+        self._impl.append(self._stream, blob, durable=False)
         self._dirty = True
         self._inner.add_many(rows)
 
@@ -691,7 +701,15 @@ class PersistenceHooks:
 
         out: list[tuple[str, Any, Any]] = []
         for kind, k, v in records[: last_commit + 1]:
-            if kind == "addmany":  # chunked record: expand to per-row events
+            if kind == "addmany_b":  # binary chunked record (native frame)
+                native = _native_mod.load()
+                if native is None:
+                    raise RuntimeError(
+                        "snapshot log holds binary addmany records but the "
+                        "native module is unavailable"
+                    )
+                out.extend(("add", kk, vv) for kk, vv in native.unpack_kv(k))
+            elif kind == "addmany":  # chunked record: expand to per-row events
                 out.extend(("add", Pointer(kk), vv) for kk, vv in k)
             elif kind in ("add", "remove"):
                 # rewrap logged int keys (see _record_and_forward): derived-

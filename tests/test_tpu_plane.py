@@ -235,6 +235,28 @@ def test_jitted_encoder_batches(mesh8):
     np.testing.assert_allclose(out, out2, atol=1e-5)
 
 
+def test_encode_into_device_matches_host_path(mesh8):
+    """encode_into keeps embeddings on device (add_batch_device); search
+    results must be identical to encode() + add_batch through the host."""
+    enc = JittedEncoder(TINY, mesh=None, max_batch=8, pipeline_depth=2)
+    docs = [f"doc number {i} about topic{i % 7}" for i in range(21)]
+    host_idx = ShardedKnnIndex(64, metric="cos", capacity=64)
+    embs = enc.encode(docs)
+    host_idx.add_batch(list(range(21)), embs)
+    dev_idx = ShardedKnnIndex(64, metric="cos", capacity=64)
+    assert enc.encode_into(dev_idx, list(range(21)), docs) == 21
+    assert len(dev_idx) == 21
+    for qi in (0, 7, 20):
+        ra = host_idx.search(embs[qi : qi + 1], 5)[0]
+        rb = dev_idx.search(embs[qi : qi + 1], 5)[0]
+        assert [k for k, _ in ra] == [k for k, _ in rb]
+        for (_, da), (_, db) in zip(ra, rb):
+            assert abs(da - db) < 1e-2
+    # upsert through the device path replaces, not duplicates
+    assert enc.encode_into(dev_idx, [3], [docs[3]]) == 1
+    assert len(dev_idx) == 21
+
+
 def test_jitted_encoder_tp_dp():
     mesh = best_mesh(model_parallel=2)
     enc = JittedEncoder(TINY, mesh=mesh)
