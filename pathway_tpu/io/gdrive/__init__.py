@@ -1,26 +1,468 @@
 """``pw.io.gdrive`` — Google Drive reader (reference
-``python/pathway/io/gdrive``).
+``python/pathway/io/gdrive/__init__.py``).
 
-Intentionally gated, not implemented: the reference connector is a thin
-loop over the authenticated Google Drive v3 REST client
-(``files().list`` by folder id + ``files().get_media`` downloads), and
-every interesting behavior — OAuth2 service-account flow, token refresh,
-export of Google-native docs, 404-on-revoked-share handling — lives
-inside ``googleapiclient`` + live Google endpoints that are unreachable
-from this environment (zero egress, no credentials).  A fake-client
-"implementation" would test nothing beyond what ``pw.io.pyfilesystem``
-(which accepts ANY PyFilesystem, including a Drive-backed one) and
-``pw.io.s3``'s injectable-client pattern already prove.  The API
-surface matches the reference so code written against it ports; calls
-raise ``MissingDependency`` until ``googleapiclient`` is installed.
+Folder listing with pagination, recursive directory walk, glob/size
+filters, Google-native document export, incremental streaming sync by
+``modifiedTime`` with deleted-file retraction — the same polling tree
+diff the reference runs (``_GDriveTree.new_and_changed_files`` /
+``removed_files``, reference ``:237-259``).
+
+The Drive v3 service object is injectable (``service=...``): anything
+implementing the four calls the connector makes —
+``files().list(...).execute()``, ``files().get(...)``,
+``files().get_media(...)``, ``files().export_media(...)`` — works, which
+is how the connector is tested hermetically (``tests/test_gdrive.py``
+drives adds/updates/deletes through a fake service).  Without an
+injected service, ``googleapiclient`` + a service-account credentials
+file are required, exactly like the reference.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import fnmatch
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
 
-from pathway_tpu.io._gated import gated_reader
-
-read = gated_reader("gdrive", "googleapiclient")
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import schema as sch
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io._connector import coerce_row, input_table
+from pathway_tpu.io.python import ConnectorSubject
 
 __all__ = ["read"]
+
+SCOPES = ["https://www.googleapis.com/auth/drive.readonly"]
+MIME_TYPE_FOLDER = "application/vnd.google-apps.folder"
+FILE_FIELDS = (
+    "id, name, mimeType, parents, modifiedTime, thumbnailLink, "
+    "lastModifyingUser, trashed, size"
+)
+
+STATUS_DOWNLOADED = "downloaded"
+STATUS_SIZE_LIMIT_EXCEEDED = "size_limit_exceeded"
+STATUS_SYMLINKS_NOT_SUPPORTED = "symlinks_not_supported"
+
+#: Google-native docs have no binary content; they export to office
+#: formats (reference DEFAULT_MIME_TYPE_MAPPING)
+DEFAULT_MIME_TYPE_MAPPING: dict[str, str] = {
+    "application/vnd.google-apps.document": (
+        "application/vnd.openxmlformats-officedocument."
+        "wordprocessingml.document"
+    ),
+    "application/vnd.google-apps.spreadsheet": (
+        "application/vnd.openxmlformats-officedocument."
+        "spreadsheetml.sheet"
+    ),
+    "application/vnd.google-apps.presentation": (
+        "application/vnd.openxmlformats-officedocument."
+        "presentationml.presentation"
+    ),
+}
+
+GDriveFile = dict
+
+_logger = logging.getLogger("pathway_tpu.io.gdrive")
+
+
+_ERROR_TYPES: tuple | None = None
+
+
+def _http_error_types() -> tuple:
+    """Exception types treated as transient Drive API failures (computed
+    once — a failed googleapiclient import is not negatively cached by
+    Python, and this runs on every poll of every file)."""
+    global _ERROR_TYPES
+    if _ERROR_TYPES is None:
+        try:
+            from googleapiclient.errors import HttpError  # type: ignore
+
+            _ERROR_TYPES = (HttpError, ConnectionError, TimeoutError)
+        except ImportError:
+            _ERROR_TYPES = (ConnectionError, TimeoutError)
+    return _ERROR_TYPES
+
+
+def extend_metadata(metadata: GDriveFile) -> GDriveFile:
+    metadata = add_url(metadata)
+    metadata = add_path(metadata)
+    metadata = add_seen_at(metadata)
+    metadata = add_status(metadata)
+    return metadata
+
+
+def add_seen_at(metadata: GDriveFile) -> GDriveFile:
+    metadata["seen_at"] = int(time.time())
+    return metadata
+
+
+def add_url(metadata: GDriveFile) -> GDriveFile:
+    id = metadata["id"]
+    metadata["url"] = f"https://drive.google.com/file/d/{id}/"
+    return metadata
+
+
+def add_path(metadata: GDriveFile) -> GDriveFile:
+    metadata["path"] = metadata["name"]
+    return metadata
+
+
+def add_status(metadata: GDriveFile) -> GDriveFile:
+    metadata["status"] = STATUS_DOWNLOADED
+    return metadata
+
+
+class _GDriveClient:
+    """Listing + download over an injectable Drive v3 service object."""
+
+    def __init__(
+        self,
+        service: Any,
+        object_size_limit: int | None = None,
+        file_name_pattern: list | str | None = None,
+    ) -> None:
+        self.drive = service
+        self.export_type_mapping = DEFAULT_MIME_TYPE_MAPPING
+        self.object_size_limit = object_size_limit
+        self.file_name_pattern = file_name_pattern
+
+    def _query(self, q: str = "") -> list:
+        """files().list with nextPageToken pagination (reference _query)."""
+        items: list = []
+        page_token = None
+        while True:
+            response = (
+                self.drive.files()
+                .list(
+                    q=q,
+                    pageSize=10,
+                    supportsAllDrives=True,
+                    includeItemsFromAllDrives=True,
+                    fields=f"nextPageToken, files({FILE_FIELDS})",
+                    pageToken=page_token,
+                )
+                .execute()
+            )
+            items.extend(response.get("files", []))
+            page_token = response.get("nextPageToken", None)
+            if page_token is None:
+                break
+        return items
+
+    def _get(self, file_id: str) -> GDriveFile | None:
+        """Metadata for one object, or None when gone/trashed."""
+        errors = _http_error_types()
+        try:
+            file = (
+                self.drive.files()
+                .get(
+                    fileId=file_id,
+                    fields=FILE_FIELDS,
+                    supportsAllDrives=True,
+                )
+                .execute()
+            )
+        except errors as e:
+            _logger.warning("cannot stat gdrive object %s: %s", file_id, e)
+            return None
+        if file is None or file.get("trashed"):
+            return None
+        return file
+
+    def _ls(self, id: str) -> list[GDriveFile]:
+        """Recursive listing rooted at a folder or single-file id."""
+        root = self._get(id)
+        if root is None:
+            return []
+        if root["mimeType"] != MIME_TYPE_FOLDER:
+            return [extend_metadata(root)]
+        subitems = self._query(f"'{id}' in parents and trashed=false")
+        files = [i for i in subitems if i["mimeType"] != MIME_TYPE_FOLDER]
+        files = self._apply_filters(files)
+        out = [extend_metadata(file) for file in files]
+        for subdir in (i for i in subitems if i["mimeType"] == MIME_TYPE_FOLDER):
+            out.extend(self._ls(subdir["id"]))
+        return out
+
+    def _apply_filters(self, files: list[GDriveFile]) -> list[GDriveFile]:
+        return self._filter_by_pattern(self._filter_by_size(files))
+
+    def _filter_by_pattern(self, files: list[GDriveFile]) -> list[GDriveFile]:
+        pattern = self.file_name_pattern
+        if pattern is None:
+            return files
+        patterns = [pattern] if isinstance(pattern, str) else list(pattern)
+        return [
+            f
+            for f in files
+            if any(fnmatch.fnmatch(f["name"], p) for p in patterns)
+        ]
+
+    def _filter_by_size(self, files: list[GDriveFile]) -> list[GDriveFile]:
+        if self.object_size_limit is None:
+            return files
+        # folder listings DROP oversized files (reference _filter_by_size,
+        # :148-168); only a single-file root reaches download()'s
+        # size_limit_exceeded marking.  Size-less objects (Google-native
+        # docs) always pass.
+        return [
+            f
+            for f in files
+            if f.get("size") is None
+            or int(f["size"]) <= self.object_size_limit
+        ]
+
+    def _prepare_download_request(self, file: GDriveFile) -> Any:
+        export_type = self.export_type_mapping.get(file["mimeType"])
+        if export_type is not None:
+            return self.drive.files().export_media(
+                fileId=file["id"], mimeType=export_type
+            )
+        return self.drive.files().get_media(fileId=file["id"])
+
+    def download(self, file: GDriveFile) -> bytes | None:
+        is_symlink = (
+            file.get("size") is None
+            and file["mimeType"] not in self.export_type_mapping
+        )
+        is_too_large = (
+            self.object_size_limit is not None
+            and int(file.get("size", "0")) > self.object_size_limit
+        )
+        if is_symlink:
+            file["status"] = STATUS_SYMLINKS_NOT_SUPPORTED
+            return b""
+        if is_too_large:
+            file["status"] = STATUS_SIZE_LIMIT_EXCEEDED
+            return b""
+        errors = _http_error_types()
+        try:
+            request = self._prepare_download_request(file)
+            try:
+                import io as _io
+
+                from googleapiclient.http import (  # type: ignore
+                    MediaIoBaseDownload,
+                )
+
+                response = _io.BytesIO()
+                downloader = MediaIoBaseDownload(response, request)
+                done = False
+                while not done:
+                    _progress, done = downloader.next_chunk()
+                return response.getvalue()
+            except ImportError:
+                # injected fake service: the request object serves the
+                # payload directly
+                return request.execute()
+        except errors as e:
+            _logger.warning(
+                "cannot fetch gdrive file %s: %s", file["id"], e
+            )
+            file["status"] = "download_error"
+            return None
+
+    def tree(self, root_id: str) -> "_GDriveTree":
+        return _GDriveTree({file["id"]: file for file in self._ls(root_id)})
+
+
+@dataclass(frozen=True)
+class _GDriveTree:
+    """One poll's snapshot; diffs against the previous poll drive the
+    streaming upserts/retractions (reference _GDriveTree:237-259)."""
+
+    files: dict[str, GDriveFile]
+
+    def _diff(self, other: "_GDriveTree") -> list[GDriveFile]:
+        return [f for f in self.files.values() if f["id"] not in other.files]
+
+    def _modified_files(self, previous: "_GDriveTree") -> list[GDriveFile]:
+        return [
+            f
+            for f in self.files.values()
+            if (prev := previous.files.get(f["id"])) is not None
+            and f["modifiedTime"] > prev["modifiedTime"]
+        ]
+
+    def removed_files(self, previous: "_GDriveTree") -> list[GDriveFile]:
+        return previous._diff(self)
+
+    def new_and_changed_files(self, previous: "_GDriveTree") -> list[GDriveFile]:
+        return self._diff(previous) + self._modified_files(previous)
+
+
+class _GDriveSubject(ConnectorSubject):
+    """Polling subject: rows are keyed by the Drive file id, so a
+    re-download of a changed file overwrites (upsert session) and a
+    vanished id retracts."""
+
+    def __init__(
+        self,
+        *,
+        service_factory: Callable[[], Any],
+        root: str,
+        refresh_interval: float,
+        mode: str,
+        with_metadata: bool,
+        object_size_limit: int | None,
+        file_name_pattern: list | str | None,
+    ) -> None:
+        super().__init__(datasource_name="gdrive")
+        assert mode in ("streaming", "static")
+        self._service_factory = service_factory
+        self._root = root
+        self._refresh_interval = refresh_interval
+        self._mode = mode
+        self._append_metadata = with_metadata
+        self._object_size_limit = object_size_limit
+        self._file_name_pattern = file_name_pattern
+
+    def run(self) -> None:
+        client = _GDriveClient(
+            self._service_factory(),
+            self._object_size_limit,
+            self._file_name_pattern,
+        )
+        errors = _http_error_types()
+        prev = _GDriveTree({})
+        while True:
+            try:
+                tree = client.tree(self._root)
+            except errors as e:
+                _logger.error(
+                    "failed to query gdrive: %s; retrying in %ss",
+                    e,
+                    self._refresh_interval,
+                )
+            else:
+                failed: set[str] = set()
+                for file in tree.removed_files(prev):
+                    self.remove(file)
+                for file in tree.new_and_changed_files(prev):
+                    payload = client.download(file)
+                    if payload is not None:
+                        self.upsert(file, payload)
+                    else:
+                        failed.add(file["id"])
+                self.commit()
+                if self._mode == "static":
+                    return
+                # a transiently failed download must NOT enter prev: the
+                # file would read as already-synced and never retry
+                prev = _GDriveTree(
+                    {id: f for id, f in tree.files.items() if id not in failed}
+                )
+            # responsive sleep: a stopping scheduler must not wait out a
+            # long refresh interval
+            deadline = time.monotonic() + self._refresh_interval
+            while time.monotonic() < deadline:
+                if self.stopped:
+                    return
+                time.sleep(min(0.1, self._refresh_interval))
+
+    def _row(self, file: GDriveFile, payload: bytes) -> dict:
+        values: dict[str, Any] = {"data": payload}
+        if self._append_metadata:
+            values["_metadata"] = dict(file)
+        return values
+
+    def upsert(self, file: GDriveFile, payload: bytes) -> None:
+        key = K.ref_scalar(file["id"])
+        self._events.add(key, coerce_row(self._row(file, payload), self._schema))
+
+    def remove(self, file: GDriveFile) -> None:
+        key = K.ref_scalar(file["id"])
+        self._events.remove(key, coerce_row(self._row(file, b""), self._schema))
+
+
+def read(
+    object_id: str,
+    *,
+    mode: str = "streaming",
+    object_size_limit: int | None = None,
+    refresh_interval: float = 30,
+    service_user_credentials_file: str | None = None,
+    with_metadata: bool = False,
+    file_name_pattern: list | str | None = None,
+    service: Any = None,
+    name: str = "gdrive",
+    **kwargs: Any,
+) -> Table:
+    """Read a Google Drive directory or file as a table with one ``data``
+    column of file payloads (reference ``pw.io.gdrive.read``,
+    ``python/pathway/io/gdrive/__init__.py:336``).
+
+    Args:
+        object_id: id of a directory or file; directories scan recursively.
+        mode: "streaming" polls for adds/updates/deletes every
+            ``refresh_interval`` seconds; "static" ingests once.
+        object_size_limit: max file size in bytes, or None.  Oversized
+            files are dropped from folder listings (reference
+            ``_filter_by_size``); a single-file ``object_id`` over the
+            limit yields an empty payload with
+            ``status == "size_limit_exceeded"`` in the metadata.
+        refresh_interval: seconds between scans in streaming mode.
+        service_user_credentials_file: Google service-account JSON file
+            (requires ``googleapiclient``).
+        with_metadata: add a ``_metadata`` column (id, name, mimeType,
+            modifiedTime, url, path, status, ...).
+        file_name_pattern: glob pattern (or list) filtering by file name.
+        service: injectable Drive v3 service object — any object with the
+            ``files().list/get/get_media/export_media`` surface; replaces
+            the credentials flow entirely (tests, alternative transports).
+    """
+    if mode not in ("streaming", "static"):
+        raise ValueError(f"Unrecognized connector mode: {mode}")
+    if service is not None:
+        service_factory = lambda: service  # noqa: E731
+    elif service_user_credentials_file is not None:
+
+        def service_factory() -> Any:
+            try:
+                from google.oauth2.service_account import (  # type: ignore
+                    Credentials as ServiceCredentials,
+                )
+                from googleapiclient.discovery import build  # type: ignore
+            except ImportError as e:
+                raise ImportError(
+                    "pw.io.gdrive.read needs googleapiclient + "
+                    "google-auth for the credentials flow; alternatively "
+                    "pass service=... with a Drive-v3-compatible object"
+                ) from e
+            credentials = ServiceCredentials.from_service_account_file(
+                service_user_credentials_file, scopes=SCOPES
+            )
+            return build(
+                "drive", "v3", credentials=credentials, num_retries=3
+            )
+
+    else:
+        raise ValueError(
+            "pw.io.gdrive.read requires service_user_credentials_file "
+            "(live Google API) or service=... (injected client)"
+        )
+    if with_metadata:
+        schema = sch.schema_from_types(data=bytes, _metadata=dict)
+    else:
+        schema = sch.schema_from_types(data=bytes)
+    subject = _GDriveSubject(
+        service_factory=service_factory,
+        root=object_id,
+        refresh_interval=refresh_interval,
+        mode=mode,
+        with_metadata=with_metadata,
+        object_size_limit=object_size_limit,
+        file_name_pattern=file_name_pattern,
+    )
+    from pathway_tpu.io.python import _SubjectAdapter
+
+    adapter = _SubjectAdapter(subject, schema)
+    return input_table(
+        adapter,
+        schema,
+        name=name,
+        # streaming re-downloads overwrite by file id (reference
+        # SessionType.UPSERT); static ingests exactly once (NATIVE)
+        upsert=mode == "streaming",
+    )
