@@ -121,11 +121,18 @@ class _GDriveClient:
         service: Any,
         object_size_limit: int | None = None,
         file_name_pattern: list | str | None = None,
+        injected: bool = False,
     ) -> None:
         self.drive = service
         self.export_type_mapping = DEFAULT_MIME_TYPE_MAPPING
         self.object_size_limit = object_size_limit
         self.file_name_pattern = file_name_pattern
+        #: injected services serve payloads via request.execute();
+        #: googleapiclient requests stream through MediaIoBaseDownload.
+        #: Keyed on HOW the service arrived, not on which packages are
+        #: importable — a fake must keep working when googleapiclient
+        #: happens to be installed.
+        self.injected = injected
 
     def _query(self, q: str = "") -> list:
         """files().list with nextPageToken pagination (reference _query)."""
@@ -177,12 +184,18 @@ class _GDriveClient:
             return []
         if root["mimeType"] != MIME_TYPE_FOLDER:
             return [extend_metadata(root)]
-        subitems = self._query(f"'{id}' in parents and trashed=false")
+        return self._ls_folder(id)
+
+    def _ls_folder(self, folder_id: str) -> list[GDriveFile]:
+        # the parent listing already carried each subfolder's metadata
+        # (and the query filters trashed), so recursion lists children
+        # directly — no per-folder re-stat against the rate limit
+        subitems = self._query(f"'{folder_id}' in parents and trashed=false")
         files = [i for i in subitems if i["mimeType"] != MIME_TYPE_FOLDER]
         files = self._apply_filters(files)
         out = [extend_metadata(file) for file in files]
         for subdir in (i for i in subitems if i["mimeType"] == MIME_TYPE_FOLDER):
-            out.extend(self._ls(subdir["id"]))
+            out.extend(self._ls_folder(subdir["id"]))
         return out
 
     def _apply_filters(self, files: list[GDriveFile]) -> list[GDriveFile]:
@@ -239,23 +252,20 @@ class _GDriveClient:
         errors = _http_error_types()
         try:
             request = self._prepare_download_request(file)
-            try:
-                import io as _io
-
-                from googleapiclient.http import (  # type: ignore
-                    MediaIoBaseDownload,
-                )
-
-                response = _io.BytesIO()
-                downloader = MediaIoBaseDownload(response, request)
-                done = False
-                while not done:
-                    _progress, done = downloader.next_chunk()
-                return response.getvalue()
-            except ImportError:
-                # injected fake service: the request object serves the
-                # payload directly
+            if self.injected:
                 return request.execute()
+            import io as _io
+
+            from googleapiclient.http import (  # type: ignore
+                MediaIoBaseDownload,
+            )
+
+            response = _io.BytesIO()
+            downloader = MediaIoBaseDownload(response, request)
+            done = False
+            while not done:
+                _progress, done = downloader.next_chunk()
+            return response.getvalue()
         except errors as e:
             _logger.warning(
                 "cannot fetch gdrive file %s: %s", file["id"], e
@@ -307,6 +317,7 @@ class _GDriveSubject(ConnectorSubject):
         with_metadata: bool,
         object_size_limit: int | None,
         file_name_pattern: list | str | None,
+        service_injected: bool = False,
     ) -> None:
         super().__init__(datasource_name="gdrive")
         assert mode in ("streaming", "static")
@@ -317,12 +328,14 @@ class _GDriveSubject(ConnectorSubject):
         self._append_metadata = with_metadata
         self._object_size_limit = object_size_limit
         self._file_name_pattern = file_name_pattern
+        self._service_injected = service_injected
 
     def run(self) -> None:
         client = _GDriveClient(
             self._service_factory(),
             self._object_size_limit,
             self._file_name_pattern,
+            injected=self._service_injected,
         )
         errors = _http_error_types()
         prev = _GDriveTree({})
@@ -448,6 +461,7 @@ def read(
         schema = sch.schema_from_types(data=bytes)
     subject = _GDriveSubject(
         service_factory=service_factory,
+        service_injected=service is not None,
         root=object_id,
         refresh_interval=refresh_interval,
         mode=mode,

@@ -251,12 +251,74 @@ class SlideParser(ImageParser):
     license-gated there; here simply ImageParser over rendered pages)."""
 
 
-class OpenParse(_GatedParser):
-    """reference ``parsers.py:235`` (openparse)"""
+class OpenParse(UDF):
+    """Layout-aware PDF chunking (reference ``parsers.py:235`` wrapping
+    the ``openparse`` package + ``openparse_utils.py``: bbox-positioned
+    nodes, heading/table detection, chunk merging).
 
-    _pkg = "openparse"
+    Backed by the built-in layout engine in ``_layout.py`` — spans from
+    the PDF text matrix, column splitting, font-size heading detection,
+    x-aligned-run table detection with ``" | "`` cell separators, and
+    bbox-merged chunks where headings open a section and tables are
+    never split.  ``table_args={"parsing_algorithm": "llm"}`` (the
+    reference's vision-LLM table path) additionally runs ``llm`` over
+    each detected table's text to reshape it.
+
+    Args:
+        max_chars: chunk budget (a table larger than this still stays
+            one chunk — cells are never split).
+        table_args: ``{"parsing_algorithm": "native" | "llm"}``;
+            "native" (default) emits detected tables as pipe-separated
+            rows; "llm" requires ``llm=``.
+        llm: chat UDF used when ``parsing_algorithm == "llm"``.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_chars: int = 1500,
+        table_args: dict | None = None,
+        llm: Any = None,
+        **kwargs: Any,
+    ):
+        super().__init__()
+        self.max_chars = max_chars
+        self.table_args = table_args or {"parsing_algorithm": "native"}
+        algorithm = self.table_args.get("parsing_algorithm", "native")
+        if algorithm not in ("native", "llm"):
+            raise ValueError(
+                f"unknown table parsing_algorithm {algorithm!r}"
+            )
+        if algorithm == "llm" and llm is None:
+            raise ValueError("parsing_algorithm='llm' requires llm=...")
+        self.llm = llm
 
     def __wrapped__(self, contents: bytes, **kwargs: Any) -> list[tuple[str, dict]]:
-        raise NotImplementedError(
-            "openparse is unavailable in this environment"
-        )
+        from pathway_tpu.xpacks.llm._layout import chunk_pdf_layout
+
+        chunks = chunk_pdf_layout(contents, max_chars=self.max_chars)
+        if self.table_args.get("parsing_algorithm") == "llm":
+            # rewrite ONLY each detected table's rows in place — the
+            # surrounding prose of a mixed chunk must pass through
+            # untouched
+            out = []
+            for text, meta in chunks:
+                for table_text in meta.get("tables", ()):
+                    rewritten = str(
+                        self.llm.__wrapped__(
+                            [
+                                {
+                                    "role": "user",
+                                    "content": (
+                                        "Rewrite this extracted table as "
+                                        "clean markdown, preserving every "
+                                        "cell:\n" + table_text
+                                    ),
+                                }
+                            ]
+                        )
+                    )
+                    text = text.replace(table_text, rewritten)
+                out.append((text, meta))
+            return out
+        return chunks

@@ -142,7 +142,7 @@ def _drive_with_tree() -> _FakeDrive:
 
 def test_client_recursive_listing_and_export():
     d = _drive_with_tree()
-    client = _GDriveClient(_Service(d))
+    client = _GDriveClient(_Service(d), injected=True)
     tree = client.tree("root")
     assert set(tree.files) == {"f1", "f2", "f3", "doc1"}
     meta = tree.files["f1"]
@@ -163,7 +163,7 @@ def test_client_pagination():
     d.put("root", "root", mime=MIME_TYPE_FOLDER, parents=())
     for i in range(25):  # pageSize=10 -> 3 pages
         d.put(f"f{i}", f"file{i:02d}.txt", b"x", parents=("root",))
-    client = _GDriveClient(_Service(d))
+    client = _GDriveClient(_Service(d), injected=True)
     tree = client.tree("root")
     assert len(tree.files) == 25
     assert d.pages_served >= 3
@@ -171,21 +171,21 @@ def test_client_pagination():
 
 def test_client_filters():
     d = _drive_with_tree()
-    only_txt = _GDriveClient(_Service(d), file_name_pattern="*.txt")
+    only_txt = _GDriveClient(_Service(d), file_name_pattern="*.txt", injected=True)
     assert set(only_txt.tree("root").files) == {"f1", "f3"}
-    multi = _GDriveClient(_Service(d), file_name_pattern=["*.pdf", "a.*"])
+    multi = _GDriveClient(_Service(d), file_name_pattern=["*.pdf", "a.*"], injected=True)
     assert set(multi.tree("root").files) == {"f1", "f2"}
     # size limit: oversized files drop from the listing (reference
     # _filter_by_size); Google-native docs (no size) always pass
     d.put("big", "big.bin", b"z" * 100, parents=("root",))
-    small = _GDriveClient(_Service(d), object_size_limit=10)
+    small = _GDriveClient(_Service(d), object_size_limit=10, injected=True)
     ids = set(small.tree("root").files)
     assert "big" not in ids and "doc1" in ids
 
 
 def test_client_missing_root_and_single_file():
     d = _drive_with_tree()
-    client = _GDriveClient(_Service(d))
+    client = _GDriveClient(_Service(d), injected=True)
     assert client.tree("nope").files == {}
     # a file id as root lists exactly that file
     assert set(client.tree("f1").files) == {"f1"}

@@ -193,3 +193,165 @@ def test_parse_html_docx_udfs():
     assert any(m["category"] == "ListItem" for _, m in html_blocks)
     docx_single = ParseDocx().__wrapped__(_minimal_docx())
     assert "Revenue grew by ten percent." in docx_single[0][0]
+
+
+# ---------------------------------------------------------------------------
+# layout-aware PDF chunking (reference openparse_utils.py; built-in
+# engine in xpacks/llm/_layout.py)
+
+
+def _layout_pdf() -> bytes:
+    """Two-column page: titles at 18pt, body at 10pt, and a 3x3 table in
+    the left column with x-aligned cells."""
+    content = (
+        # full-width title
+        b"BT /F1 18 Tf 72 760 Td (Quarterly Report) Tj ET "
+        # left column: heading + body + table
+        b"BT /F1 14 Tf 72 720 Td (Revenue) Tj ET "
+        b"BT /F1 10 Tf 72 700 Td (Revenue grew in every region this) Tj ET "
+        b"BT /F1 10 Tf 72 688 Td (quarter, led by the north.) Tj ET "
+        # table rows: cells at x = 72, 140, 210
+        b"BT /F1 10 Tf 1 0 0 1 72 660 Tm (Region) Tj 1 0 0 1 140 660 Tm (Q1) Tj "
+        b"1 0 0 1 210 660 Tm (Q2) Tj ET "
+        b"BT /F1 10 Tf 1 0 0 1 72 646 Tm (North) Tj 1 0 0 1 140 646 Tm (10) Tj "
+        b"1 0 0 1 210 646 Tm (14) Tj ET "
+        b"BT /F1 10 Tf 1 0 0 1 72 632 Tm (South) Tj 1 0 0 1 140 632 Tm (8) Tj "
+        b"1 0 0 1 210 632 Tm (9) Tj ET "
+        # right column (x=340): its own heading + body
+        b"BT /F1 14 Tf 340 720 Td (Outlook) Tj ET "
+        b"BT /F1 10 Tf 340 700 Td (Guidance remains unchanged for) Tj ET "
+        b"BT /F1 10 Tf 340 688 Td (the remainder of the year.) Tj ET"
+    )
+    return _minimal_pdf(content, compress=False)
+
+
+def test_layout_spans_positions():
+    from pathway_tpu.xpacks.llm._layout import extract_pdf_spans
+
+    pages = extract_pdf_spans(_layout_pdf())
+    assert len(pages) == 1
+    spans = pages[0]
+    by_text = {s.text: s for s in spans}
+    assert by_text["Quarterly Report"].size == 18.0
+    assert by_text["Region"].x == 72.0 and by_text["Q2"].x == 210.0
+    assert by_text["North"].y == 646.0
+
+
+def test_layout_nodes_headings_tables_columns():
+    from pathway_tpu.xpacks.llm._layout import pdf_layout_nodes
+
+    nodes = pdf_layout_nodes(_layout_pdf())
+    kinds = [(n.kind, n.text.split("\n")[0][:20]) for n in nodes]
+    headings = [n.text for n in nodes if n.kind == "heading"]
+    assert "Quarterly Report" in headings
+    assert "Revenue" in headings and "Outlook" in headings
+    tables = [n for n in nodes if n.kind == "table"]
+    assert len(tables) == 1, kinds
+    rows = tables[0].text.split("\n")
+    assert rows[0] == "Region | Q1 | Q2"
+    assert rows[1] == "North | 10 | 14"
+    assert rows[2] == "South | 8 | 9"
+    # reading order: left column (Revenue...) fully before right (Outlook)
+    order = [n.text.split("\n")[0] for n in nodes]
+    assert order.index("Revenue") < order.index("Outlook")
+    full_left = "\n".join(n.text for n in nodes)
+    assert full_left.index("led by the north") < full_left.index("Guidance")
+
+
+def test_layout_chunking_keeps_tables_intact():
+    from pathway_tpu.xpacks.llm._layout import chunk_pdf_layout
+
+    chunks = chunk_pdf_layout(_layout_pdf(), max_chars=60)
+    # the table never splits even under a tiny budget
+    table_chunks = [c for c, m in chunks if "Region | Q1 | Q2" in c]
+    assert len(table_chunks) == 1
+    assert "South | 8 | 9" in table_chunks[0]
+    # headings open their sections
+    heads = [m["heading"] for _c, m in chunks]
+    assert "Revenue" in heads and "Outlook" in heads
+    # bbox metadata present and sane
+    for _c, m in chunks:
+        x0, y0, x1, y1 = m["bbox"]
+        assert x0 <= x1 and y0 <= y1
+
+
+def test_openparse_udf_end_to_end():
+    from pathway_tpu.xpacks.llm.parsers import OpenParse
+
+    parser = OpenParse(max_chars=200)
+    chunks = parser.__wrapped__(_layout_pdf())
+    assert any("Region | Q1 | Q2" in text for text, _m in chunks)
+    assert all("page" in m and "bbox" in m for _t, m in chunks)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="llm"):
+        OpenParse(table_args={"parsing_algorithm": "llm"})
+    with _pytest.raises(ValueError, match="algorithm"):
+        OpenParse(table_args={"parsing_algorithm": "bogus"})
+
+
+def test_document_store_ingests_layout_pdf():
+    """DocumentStore end-to-end over a multi-column PDF with a table:
+    table cells stay intact inside retrieved chunks (round-4 verdict
+    item 8's done criterion)."""
+    import pathway_tpu as pw
+    from pathway_tpu.xpacks.llm.parsers import OpenParse
+
+    pw.G.clear()
+    rows = [(_layout_pdf(), "report.pdf")]
+    docs = pw.debug.table_from_rows(
+        pw.schema_from_types(data=bytes, path=str), rows
+    )
+    parser = OpenParse(max_chars=400)
+    parsed = docs.select(
+        chunks=pw.apply(lambda b: [c for c, _m in parser.__wrapped__(b)], docs.data),
+        path=docs.path,
+    )
+    flat = parsed.flatten(parsed.chunks)
+    out = []
+    pw.io.subscribe(flat, on_change=lambda k, row, t, add: out.append(row["chunks"]))
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    table_chunk = next(c for c in out if "Region | Q1 | Q2" in c)
+    assert "North | 10 | 14" in table_chunk and "South | 8 | 9" in table_chunk
+
+
+def test_openparse_llm_table_pass_preserves_prose():
+    from pathway_tpu.xpacks.llm.parsers import OpenParse
+
+    class FakeLLM:
+        calls: list = []
+
+        def __wrapped__(self, messages):
+            self.calls.append(messages[0]["content"])
+            return "| MD TABLE |"
+
+    llm = FakeLLM()
+    parser = OpenParse(
+        max_chars=400, table_args={"parsing_algorithm": "llm"}, llm=llm
+    )
+    chunks = parser.__wrapped__(_layout_pdf())
+    joined = "\n".join(t for t, _m in chunks)
+    # prose untouched, table replaced
+    assert "led by the north" in joined
+    assert "| MD TABLE |" in joined
+    assert "Region | Q1 | Q2" not in joined
+    # the llm saw ONLY the table rows, not the prose
+    assert len(llm.calls) == 1
+    assert "Region | Q1 | Q2" in llm.calls[0]
+    assert "led by the north" not in llm.calls[0]
+
+
+def test_layout_quote_operators_move_then_show():
+    """' and \" move to the next line BEFORE showing (ISO 32000-1
+    §9.4.3): three '-shown strings land on three distinct baselines."""
+    from pathway_tpu.xpacks.llm._layout import extract_pdf_spans
+
+    content = (
+        b"BT /F1 10 Tf 12 TL 1 0 0 1 72 700 Tm (first) Tj "
+        b"(second) ' (third) ' ET"
+    )
+    spans = extract_pdf_spans(_minimal_pdf(content, compress=False))[0]
+    ys = {s.text: s.y for s in spans}
+    assert ys["first"] == 700.0
+    assert ys["second"] == 688.0
+    assert ys["third"] == 676.0
