@@ -86,9 +86,26 @@ def load_yaml(stream: str | bytes | IO) -> Any:
     if not isinstance(raw, dict):
         return _instantiate(raw, {})
     # top-level keys are $variables for each other, regardless of document
-    # order: resolve iteratively, deferring keys whose $refs aren't ready yet
+    # order: resolve iteratively, deferring keys whose $refs aren't ready
+    # yet.  A leading $ on a KEY marks a private variable (reference app
+    # templates: "$llm:", "$sources:", ... referenced as $llm) — the $ is
+    # not part of the variable name, and $-keys are dropped from the
+    # returned config.
     variables: dict[str, Any] = {}
-    todo = dict(raw)
+    todo: dict[Any, Any] = {}
+    private: set = set()
+    for k, v in raw.items():
+        if isinstance(k, str) and k.startswith("$"):
+            name = k[1:]  # a single $: "$$x" is the literal key "$x"
+            private.add(name)
+        else:
+            name = k
+        if name in todo:
+            raise KeyError(
+                f"yaml config defines both {k!r} and a key that resolves "
+                f"to the same variable name {name!r}"
+            )
+        todo[name] = v
     while todo:
         progressed = False
         deferred: dict[str, Any] = {}
@@ -105,4 +122,4 @@ def load_yaml(stream: str | bytes | IO) -> Any:
                 f"unresolvable yaml variable reference(s): {last_error}"
             )
         todo = deferred
-    return variables
+    return {k: v for k, v in variables.items() if k not in private}

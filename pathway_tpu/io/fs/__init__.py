@@ -291,6 +291,87 @@ class _FilesSource(RowSource):
             _time.sleep(self.poll_interval)
 
 
+class _WholeFileSource(RowSource):
+    """One row PER FILE (``format="binary"`` / ``"plaintext_by_file"``,
+    reference binary object pattern): streaming mode polls the directory
+    and upserts changed files (keyed by path) and retracts deleted ones —
+    the dir-watch contract DocumentStore ingestion relies on."""
+
+    def __init__(
+        self,
+        path: str,
+        schema: sch.SchemaMetaclass,
+        *,
+        binary: bool,
+        mode: str,
+        poll_interval: float = 0.2,
+        with_metadata: bool = False,
+    ):
+        self.path = path
+        self.schema = schema
+        self.binary = binary
+        self.mode = mode
+        self.poll_interval = poll_interval
+        self.with_metadata = with_metadata
+
+    def _row(self, fp: str, payload: Any, mtime: float = 0.0) -> dict:
+        values: dict[str, Any] = {"data": payload}
+        if self.with_metadata:
+            values["_metadata"] = {
+                "path": fp,
+                "modified_at": int(mtime),
+            }
+        return values
+
+    def run(self, events: Any) -> None:
+        seen: dict[str, tuple[float, int]] = {}  # path -> (mtime, size)
+        while True:
+            changed = False
+            current = set()
+            for fp in _list_files(self.path):
+                current.add(fp)
+                try:
+                    st = os.stat(fp)
+                    sig = (st.st_mtime, st.st_size)
+                    if seen.get(fp) == sig:
+                        continue
+                    with open(fp, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    continue  # raced with deletion: next poll retracts
+                payload: Any = (
+                    data if self.binary else data.decode("utf-8", "replace")
+                )
+                events.add(
+                    ref_scalar("__fsbin__", fp),
+                    coerce_row(
+                        self._row(fp, payload, st.st_mtime), self.schema
+                    ),
+                )
+                seen[fp] = sig
+                changed = True
+            for fp in list(seen):
+                if fp not in current:
+                    del seen[fp]
+                    events.remove(
+                        ref_scalar("__fsbin__", fp),
+                        coerce_row(
+                            self._row(fp, b"" if self.binary else ""),
+                            self.schema,
+                        ),
+                    )
+                    changed = True
+            if changed:
+                events.commit()
+            if self.mode == "static":
+                return
+            deadline = _time.monotonic() + self.poll_interval
+            while _time.monotonic() < deadline:
+                if events.stopped:
+                    return
+                _time.sleep(min(0.05, self.poll_interval))
+
+
 def read(
     path: str | os.PathLike,
     *,
@@ -304,7 +385,25 @@ def read(
     persistent_id: str | None = None,
     **kwargs: Any,
 ) -> Table:
-    if format in ("plaintext", "plaintext_by_file", "binary"):
+    if format in ("binary", "plaintext_by_file"):
+        # whole-file rows (reference binary/plaintext_by_file object
+        # pattern): the natural source for DocumentStore pipelines
+        binary = format == "binary"
+        if schema is None:
+            cols: dict[str, Any] = {"data": bytes if binary else str}
+            if with_metadata:
+                cols["_metadata"] = dict
+            schema = sch.schema_from_types(**cols)
+        wsrc = _WholeFileSource(
+            str(path), schema, binary=binary, mode=mode,
+            with_metadata=with_metadata,
+            poll_interval=kwargs.get("poll_interval", 0.2),
+        )
+        return input_table(
+            wsrc, schema, name=name, persistent_id=persistent_id,
+            upsert=True,
+        )
+    if format == "plaintext":
         if schema is None:
             schema = sch.schema_from_types(data=str)
 

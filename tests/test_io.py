@@ -372,3 +372,49 @@ def test_s3_modified_object_retracts_old_version():
     assert len(removes) == 2  # rows 2 and 3's keys deleted
     add_keys = {k for k, _ in adds}
     assert all(k in add_keys for k, _ in removes)
+
+
+def test_fs_binary_whole_file_streaming(tmp_path):
+    """format='binary' reads one row per FILE and watches the dir:
+    adds upsert, content changes overwrite, deletions retract."""
+    (tmp_path / "a.txt").write_bytes(b"alpha")
+
+    t = pw.io.fs.read(
+        str(tmp_path), format="binary", mode="streaming",
+        with_metadata=True, poll_interval=0.05,
+    )
+    events = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda k, row, tm, add: events.append(
+            (add, row["_metadata"]["path"].rsplit("/", 1)[-1], row["data"])
+        ),
+    )
+    from pathway_tpu.engine.scheduler import Scheduler
+    from pathway_tpu.internals.parse_graph import G
+
+    sched = Scheduler(G.engine_graph, autocommit_ms=20)
+    run_t = threading.Thread(target=sched.run, daemon=True)
+    run_t.start()
+
+    def wait_for(pred, timeout=8.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return False
+
+    assert wait_for(lambda: (True, "a.txt", b"alpha") in events)
+    (tmp_path / "b.txt").write_bytes(b"beta")
+    assert wait_for(lambda: (True, "b.txt", b"beta") in events)
+    # rewrite: upsert retracts the old payload and adds the new
+    time.sleep(0.05)  # distinct mtime
+    (tmp_path / "a.txt").write_bytes(b"alpha-v2")
+    assert wait_for(lambda: (True, "a.txt", b"alpha-v2") in events)
+    assert wait_for(lambda: (False, "a.txt", b"alpha") in events)
+    # deletion retracts
+    (tmp_path / "b.txt").unlink()
+    assert wait_for(lambda: any(not a and n == "b.txt" for a, n, _d in events))
+    sched.stop()
+    run_t.join(timeout=3)
