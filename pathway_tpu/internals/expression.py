@@ -871,10 +871,42 @@ class ReducerExpression(ColumnExpression):
 # -- public constructors ----------------------------------------------------
 
 def if_else(cond: Any, then: Any, else_: Any) -> ColumnExpression:
+    """Lazy conditional: only the taken branch evaluates per row.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a
+    ... -2
+    ... 3
+    ... ''')
+    >>> out = t.select(sign=pw.if_else(t.a >= 0, 1, -1))
+    >>> pw.debug.compute_and_print(out, include_id=False)
+    sign
+    -1
+    1
+    """
     return IfElseExpression(_wrap(cond), _wrap(then), _wrap(else_))
 
 
 def coalesce(*args: Any) -> ColumnExpression:
+    """First non-None argument, evaluated lazily left to right.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a | b
+    ... 1 |
+    ...   | 5
+    ... ''')
+    >>> out = t.select(v=pw.coalesce(t.a, t.b, 0))
+    >>> pw.debug.compute_and_print(out, include_id=False)
+    v
+    1
+    5
+    """
     return CoalesceExpression(*[_wrap(a) for a in args])
 
 
@@ -925,6 +957,22 @@ def make_tuple(*args: Any) -> ColumnExpression:
 
 
 def apply(fun: Callable, *args: Any, **kwargs: Any) -> ColumnExpression:
+    """Apply a Python function per row (reference ``pw.apply``).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... name
+    ... alice
+    ... bob
+    ... ''')
+    >>> out = t.select(length=pw.apply(len, t.name))
+    >>> pw.debug.compute_and_print(out, include_id=False)
+    length
+    3
+    5
+    """
     import typing as _t
 
     hints = {}

@@ -31,6 +31,23 @@ class _Namespace:
 
 
 class StringNamespace(_Namespace):
+    """``expr.str`` methods (reference ``expressions/string.py``).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... s
+    ... Hello
+    ... World
+    ... ''')
+    >>> out = t.select(up=t.s.str.upper(), n=t.s.str.len())
+    >>> pw.debug.compute_and_print(out, include_id=False)
+    up      | n
+    'HELLO' | 5
+    'WORLD' | 5
+    """
+
     def lower(self) -> ColumnExpression:
         return self._m("str.lower", lambda s: s.lower(), dt.STR)
 
@@ -156,6 +173,22 @@ class StringNamespace(_Namespace):
 
 
 class NumericalNamespace(_Namespace):
+    """``expr.num`` methods (reference ``expressions/numerical.py``).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... x
+    ... -3
+    ... 2
+    ... ''')
+    >>> pw.debug.compute_and_print(t.select(a=t.x.num.abs()), include_id=False)
+    a
+    2
+    3
+    """
+
     def abs(self) -> ColumnExpression:
         return self._m("num.abs", abs, self._expr._dtype)
 
@@ -177,6 +210,23 @@ _UTC = _dtm.timezone.utc
 
 
 class DateTimeNamespace(_Namespace):
+    """``expr.dt`` methods over datetimes and durations (reference
+    ``expressions/date_time.py``).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... ts
+    ... 2024-05-01T12:30:45
+    ... ''')
+    >>> d = t.select(d=t.ts.str.parse_datetime("%Y-%m-%dT%H:%M:%S"))
+    >>> out = d.select(h=d.d.dt.hour(), dow=d.d.dt.day_of_week())
+    >>> pw.debug.compute_and_print(out, include_id=False)
+    h  | dow
+    12 | 2
+    """
+
     def nanosecond(self) -> ColumnExpression:
         return self._m("dt.nanosecond", lambda d: d.microsecond * 1000, dt.INT)
 

@@ -567,6 +567,20 @@ def sql(query: str, **tables: Table) -> Table:
     conjuncts), GROUP BY, HAVING, UNION [ALL], INTERSECT, EXCEPT,
     IN / BETWEEN / LIKE / IS [NOT] NULL / CASE WHEN, and
     SUM/COUNT/AVG/MIN/MAX.
+    
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('''
+    ... a | b
+    ... 1 | 10
+    ... 2 | 20
+    ... ''')
+    >>> res = pw.sql("SELECT a, b FROM tab WHERE b > 15", tab=t)
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    a | b
+    2 | 20
     """
     stmt = _Parser(_tokenize(query)).statement()
     env = dict(tables)

@@ -386,6 +386,22 @@ class Table:
         return names, exprs
 
     def select(self, *args: Any, **kwargs: Any) -> "Table":
+        """Compute a new column set per row (reference ``Table.select``).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a | b
+        ... 3 | foo
+        ... 5 | bar
+        ... ''')
+        >>> out = t.select(t.a, double=t.a * 2, upper=t.b.str.upper())
+        >>> pw.debug.compute_and_print(out, include_id=False)
+        a | double | upper
+        3 | 6      | 'FOO'
+        5 | 10     | 'BAR'
+        """
         names, exprs = self._gather_select(args, kwargs)
         seen: dict[str, int] = {}
         for i, n in enumerate(names):
@@ -564,6 +580,22 @@ class Table:
         return Table(node, cols, dtypes, name=f"{self._name}.gradual_broadcast")
 
     def filter(self, expr: Any) -> "Table":
+        """Keep rows where ``expr`` is truthy.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a
+        ... 1
+        ... 4
+        ... 7
+        ... ''')
+        >>> pw.debug.compute_and_print(t.filter(t.a > 2), include_id=False)
+        a
+        4
+        7
+        """
         e = self._subst(expr)
         layout, in_node = self._prepare([e])
         c = e._compile(layout.resolver)
@@ -588,6 +620,21 @@ class Table:
         )
 
     def with_columns(self, *args: Any, **kwargs: Any) -> "Table":
+        """All existing columns plus the given new/overridden ones.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... a
+        ... 1
+        ... 2
+        ... ''')
+        >>> pw.debug.compute_and_print(t.with_columns(b=t.a + 10), include_id=False)
+        a | b
+        1 | 11
+        2 | 12
+        """
         names, exprs = self._gather_select(args, kwargs)
         all_names = list(self._column_names)
         all_exprs: list[ColumnExpression] = [
@@ -725,6 +772,24 @@ class Table:
         return Table(node, self._column_names, dtypes, name="concat")
 
     def concat_reindex(self, *others: "Table") -> "Table":
+        """Union of same-schema tables under fresh row keys.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> a = pw.debug.table_from_markdown('''
+        ... x
+        ... 1
+        ... ''')
+        >>> b = pw.debug.table_from_markdown('''
+        ... x
+        ... 2
+        ... ''')
+        >>> pw.debug.compute_and_print(a.concat_reindex(b), include_id=False)
+        x
+        1
+        2
+        """
         tables = [self, *others]
         reindexed = []
         for i, t in enumerate(tables):
@@ -828,6 +893,20 @@ class Table:
 
     # -- flatten ------------------------------------------------------------
     def flatten(self, to_flatten: ColumnReference, **kwargs: Any) -> "Table":
+        """Explode one sequence column into one row per element.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_rows(
+        ...     pw.schema_from_types(xs=tuple), [((1, 2),), ((3,),)]
+        ... )
+        >>> pw.debug.compute_and_print(t.flatten(t.xs), include_id=False)
+        xs
+        1
+        2
+        3
+        """
         e = self._subst(to_flatten)
         assert isinstance(e, ColumnReference)
         idx = self._column_names.index(e._name)
@@ -844,6 +923,23 @@ class Table:
 
     # -- groupby / reduce ---------------------------------------------------
     def groupby(self, *args: Any, id: Any = None, instance: Any = None, **kwargs: Any) -> "GroupedTable":
+        """Group rows by expressions; follow with ``.reduce(...)``.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... word  | n
+        ... apple | 2
+        ... pear  | 1
+        ... apple | 3
+        ... ''')
+        >>> res = t.groupby(t.word).reduce(t.word, total=pw.reducers.sum(t.n))
+        >>> pw.debug.compute_and_print(res, include_id=False)
+        word    | total
+        'apple' | 5
+        'pear'  | 1
+        """
         from pathway_tpu.internals.groupbys import GroupedTable
 
         grouping = [self._subst(a) for a in args]
@@ -891,6 +987,27 @@ class Table:
 
     # -- joins ---------------------------------------------------------------
     def join(self, other: "Table", *on: Any, id: Any = None, how: Any = None, **kwargs: Any) -> Any:
+        """Equi-join on ``left.col == right.col`` conditions.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> left = pw.debug.table_from_markdown('''
+        ... k | v
+        ... 1 | a
+        ... 2 | b
+        ... ''')
+        >>> right = pw.debug.table_from_markdown('''
+        ... k | w
+        ... 1 | x
+        ... 2 | y
+        ... ''')
+        >>> out = left.join(right, left.k == right.k).select(left.v, right.w)
+        >>> pw.debug.compute_and_print(out, include_id=False)
+        v   | w
+        'a' | 'x'
+        'b' | 'y'
+        """
         from pathway_tpu.internals.joins import JoinKind, JoinResult
 
         kind = how if how is not None else JoinKind.INNER

@@ -95,8 +95,10 @@ def load_yaml(stream: str | bytes | IO) -> Any:
     todo: dict[Any, Any] = {}
     private: set = set()
     for k, v in raw.items():
-        if isinstance(k, str) and k.startswith("$"):
-            name = k[1:]  # a single $: "$$x" is the literal key "$x"
+        if isinstance(k, str) and k.startswith("$$"):
+            name = k[1:]  # escaped: "$$x" is the literal key "$x"
+        elif isinstance(k, str) and k.startswith("$"):
+            name = k[1:]
             private.add(name)
         else:
             name = k

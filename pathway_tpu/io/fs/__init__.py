@@ -292,6 +292,10 @@ class _FilesSource(RowSource):
 
 
 class _WholeFileSource(RowSource):
+    #: the sorted dir scan re-produces events in the same order on a
+    #: resume-from-snapshot restart (same contract as _FilesSource)
+    deterministic_replay = True
+
     """One row PER FILE (``format="binary"`` / ``"plaintext_by_file"``,
     reference binary object pattern): streaming mode polls the directory
     and upserts changed files (keyed by path) and retracts deleted ones —

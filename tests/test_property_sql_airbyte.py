@@ -1,0 +1,245 @@
+"""Property/differential tests for SQL set operations and the airbyte
+state machinery (round-4 verdict item 3: these areas rested on a
+handful of example-based tests each).
+
+- SQL: randomized table pairs; INTERSECT / EXCEPT / UNION [ALL] /
+  ``[NOT] IN (SELECT ...)`` are checked against independently computed
+  Python set/bag semantics, including NULL probes and duplicates
+  (reference semantics: SQL set ops deduplicate, set membership with
+  NULL is three-valued).
+- Airbyte: the StateTracker's fold is checked for the protocol
+  invariants (last-writer-wins per stream, LEGACY superseded by
+  stream/global states, envelope round-trip idempotence) over random
+  message sequences — mirroring the reference's state folding
+  (airbyte-serverless logic.py:68-131 role).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.io.airbyte import AirbyteStateTracker as StateTracker
+from tests.utils import run_to_rows
+
+
+def _rand_rows(rng: random.Random, n: int, vals: int) -> list[tuple]:
+    return [
+        (rng.randrange(vals), rng.choice(["p", "q", "r"]))
+        for _ in range(n)
+    ]
+
+
+def _table(rows: list[tuple]) -> pw.Table:
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(x=int, y=str), rows
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_sql_set_ops_match_set_semantics(seed):
+    rng = random.Random(seed)
+    rows_a = _rand_rows(rng, rng.randrange(0, 14), 5)
+    rows_b = _rand_rows(rng, rng.randrange(0, 14), 5)
+    pw.G.clear()
+    a, b = _table(rows_a), _table(rows_b)
+    sa, sb = set(rows_a), set(rows_b)
+
+    inter = pw.sql("SELECT x, y FROM a INTERSECT SELECT x, y FROM b", a=a, b=b)
+    assert sorted(run_to_rows(inter)) == sorted(sa & sb), (rows_a, rows_b)
+
+    exc = pw.sql("SELECT x, y FROM a EXCEPT SELECT x, y FROM b", a=a, b=b)
+    assert sorted(run_to_rows(exc)) == sorted(sa - sb), (rows_a, rows_b)
+
+    uni = pw.sql("SELECT x, y FROM a UNION SELECT x, y FROM b", a=a, b=b)
+    assert sorted(run_to_rows(uni)) == sorted(sa | sb), (rows_a, rows_b)
+
+    # UNION ALL keeps duplicates (bag semantics)
+    uall = pw.sql(
+        "SELECT x, y FROM a UNION ALL SELECT x, y FROM b", a=a, b=b
+    )
+    assert sorted(run_to_rows(uall)) == sorted(rows_a + rows_b)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_sql_in_subquery_matches_membership(seed):
+    rng = random.Random(100 + seed)
+    rows_a = _rand_rows(rng, rng.randrange(1, 14), 6)
+    rows_b = _rand_rows(rng, rng.randrange(0, 10), 6)
+    pw.G.clear()
+    a, b = _table(rows_a), _table(rows_b)
+    members = {x for x, _y in rows_b}
+
+    got = pw.sql(
+        "SELECT x, y FROM a WHERE x IN (SELECT x FROM b)", a=a, b=b
+    )
+    # semi-join: each qualifying A row appears exactly once per occurrence
+    assert sorted(run_to_rows(got)) == sorted(
+        r for r in rows_a if r[0] in members
+    ), (rows_a, rows_b)
+
+    got = pw.sql(
+        "SELECT x, y FROM a WHERE x NOT IN (SELECT x FROM b)", a=a, b=b
+    )
+    assert sorted(run_to_rows(got)) == sorted(
+        r for r in rows_a if r[0] not in members
+    )
+
+
+def test_sql_in_subquery_null_handling_matches_documented_contract():
+    """NULL handling follows the engine's documented contract
+    (internals/sql.py _apply_in_subquery): a NULL PROBE never matches —
+    IN and NOT IN both drop it (three-valued logic) — while a NULL
+    *inside* the subquery is a non-matching value (a deliberate,
+    documented deviation from the standard's everything-is-UNKNOWN
+    behavior, which is almost never what a query means)."""
+    pw.G.clear()
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int, y=str),
+        [(1, "p"), (2, "q"), (None, "n")],
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(1,), (None,)]
+    )
+    # NULL probe (None, 'n') drops from BOTH results
+    got = pw.sql("SELECT x, y FROM a WHERE x IN (SELECT x FROM b)", a=a, b=b)
+    assert sorted(run_to_rows(got)) == [(1, "p")]
+    got = pw.sql("SELECT x, y FROM a WHERE x NOT IN (SELECT x FROM b)", a=a, b=b)
+    assert sorted(run_to_rows(got)) == [(2, "q")]
+
+
+def test_sql_set_ops_precedence_and_chaining():
+    """A UNION B EXCEPT C parses left-to-right (standard precedence:
+    INTERSECT binds tighter than UNION/EXCEPT)."""
+    pw.G.clear()
+    a = _table([(1, "p"), (2, "p")])
+    b = _table([(2, "p"), (3, "p")])
+    c = _table([(3, "p")])
+    got = pw.sql(
+        "SELECT x, y FROM a UNION SELECT x, y FROM b "
+        "EXCEPT SELECT x, y FROM c",
+        a=a, b=b, c=c,
+    )
+    assert sorted(run_to_rows(got)) == [(1, "p"), (2, "p")]
+    # INTERSECT binds tighter: A UNION (B INTERSECT C)
+    got = pw.sql(
+        "SELECT x, y FROM a UNION SELECT x, y FROM b "
+        "INTERSECT SELECT x, y FROM c",
+        a=a, b=b, c=c,
+    )
+    assert sorted(run_to_rows(got)) == [(1, "p"), (2, "p"), (3, "p")]
+
+
+# ---------------------------------------------------------------------------
+# airbyte state folding
+
+
+def _rand_state_msg(rng: random.Random) -> dict:
+    kind = rng.choice(["LEGACY", "STREAM", "GLOBAL"])
+    if kind == "LEGACY":
+        return {"type": "LEGACY", "data": {"cursor": rng.randrange(100)}}
+    if kind == "STREAM":
+        return {
+            "type": "STREAM",
+            "stream": {
+                "stream_descriptor": {"name": rng.choice("abc")},
+                "stream_state": {"cursor": rng.randrange(100)},
+            },
+        }
+    return {
+        "type": "GLOBAL",
+        "global": {
+            "stream_states": [
+                {
+                    "stream_descriptor": {"name": rng.choice("abc")},
+                    "stream_state": {"cursor": rng.randrange(100)},
+                }
+                for _ in range(rng.randrange(0, 3))
+            ],
+            "shared_state": (
+                {"epoch": rng.randrange(10)} if rng.random() < 0.5 else None
+            ),
+        },
+    }
+
+
+def _model_fold(msgs: list[dict]) -> dict:
+    """Independent model of the protocol: per-stream last-writer-wins,
+    shared state from the last GLOBAL, legacy from the last LEGACY."""
+    streams: dict = {}
+    shared = None
+    legacy = None
+    for m in msgs:
+        if m["type"] == "LEGACY":
+            legacy = m["data"]
+        elif m["type"] == "STREAM":
+            s = m["stream"]
+            streams[s["stream_descriptor"]["name"]] = s["stream_state"]
+        else:
+            for s in m["global"]["stream_states"]:
+                streams[s["stream_descriptor"]["name"]] = s["stream_state"]
+            shared = m["global"]["shared_state"]
+    return {"streams": streams, "shared": shared, "legacy": legacy}
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_airbyte_state_folding_matches_model(seed):
+    rng = random.Random(seed)
+    msgs = [_rand_state_msg(rng) for _ in range(rng.randrange(1, 20))]
+    tracker = StateTracker()
+    for m in msgs:
+        tracker.observe(m)
+    model = _model_fold(msgs)
+    env = tracker.envelope()
+    if model["streams"] or model["shared"] is not None:
+        assert env is not None and env["type"] == "GLOBAL"
+        got_streams = {
+            s["stream_descriptor"]["name"]: s["stream_state"]
+            for s in env["global"]["stream_states"]
+        }
+        assert got_streams == model["streams"], msgs
+        assert env["global"].get("shared_state") == (
+            model["shared"] if model["shared"] is not None else None
+        )
+    elif model["legacy"] is not None:
+        assert env == {"type": "LEGACY", "data": model["legacy"]}
+    else:
+        assert env is None
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_airbyte_envelope_round_trip_idempotent(seed):
+    """load(envelope()) then envelope() again is a fixed point — the
+    resume contract: feeding the rendered state back reproduces it."""
+    rng = random.Random(50 + seed)
+    tracker = StateTracker()
+    for _ in range(rng.randrange(1, 15)):
+        tracker.observe(_rand_state_msg(rng))
+    env1 = tracker.envelope()
+    fresh = StateTracker()
+    fresh.load(env1)
+    assert fresh.envelope() == env1
+
+
+def test_airbyte_malformed_states_ignored():
+    tracker = StateTracker()
+    tracker.observe({"type": "LEGACY"})  # no data
+    tracker.observe({"type": "STREAM"})  # no stream
+    tracker.observe({"type": "STREAM", "stream": {"stream_state": {}}})  # no name
+    tracker.observe({"type": "GLOBAL"})  # no global
+    tracker.observe({"type": "WHATEVER"})
+    assert tracker.envelope() is None
+    # valid state still folds after garbage
+    tracker.observe(
+        {
+            "type": "STREAM",
+            "stream": {
+                "stream_descriptor": {"name": "s"},
+                "stream_state": {"cursor": 7},
+            },
+        }
+    )
+    env = tracker.envelope()
+    assert env["global"]["stream_states"][0]["stream_state"] == {"cursor": 7}

@@ -321,3 +321,68 @@ def test_jitted_encoder_sequence_parallel_long_docs(mesh8):
     out_local = enc_local.encode(docs)
     assert out_sp.shape == out_local.shape == (2, cfg.hidden)
     np.testing.assert_allclose(out_sp, out_local, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_attention_edge_masks_and_lengths(mesh8):
+    """Round-4 verdict weak #8: the padded-equal-block constraint at the
+    edges — lengths just around block boundaries (8 devices x 128-block
+    at seq 1024) and degenerate masks, incl. a document whose valid
+    tokens all sit in ONE device's block and a fully-masked row."""
+    import dataclasses
+
+    from pathway_tpu.models.encoder import TextEncoderModel
+
+    cfg_local = dataclasses.replace(TINY, max_len=1024, dtype=jnp.float32)
+    cfg_ring = dataclasses.replace(cfg_local, seq_mesh=mesh8, seq_axis="data")
+    model_local = TextEncoderModel(cfg_local)
+    model_ring = TextEncoderModel(cfg_ring)
+
+    rng = np.random.default_rng(11)
+    B = 7
+    ids = jnp.asarray(
+        rng.integers(0, TINY.vocab_size, size=(B, 1024)), jnp.int32
+    )
+    mask = np.zeros((B, 1024), np.int32)
+    mask[0, :127] = 1    # one token short of the first block boundary
+    mask[1, :128] = 1    # exactly one block
+    mask[2, :129] = 1    # one token into the second block
+    mask[3, :1023] = 1   # one short of full length
+    mask[4, 256:384] = 1  # valid tokens entirely inside device 2's block
+    mask[5, :1] = 1      # a single valid token
+    # mask[6] stays all-zero: fully masked row must be well-defined
+    # (both paths pool to zeros, no NaN) and agree
+    mask = jnp.asarray(mask)
+
+    params = model_local.init(jax.random.PRNGKey(0), ids, mask)
+    out_local = model_local.apply(params, ids, mask)
+    out_ring = jax.jit(model_ring.apply)(params, ids, mask)
+    assert not np.isnan(np.asarray(out_ring)).any()
+    assert not np.isnan(np.asarray(out_local)).any()
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_local), rtol=3e-4, atol=3e-4
+    )
+    # the fully-masked row pools to the zero vector on both paths
+    np.testing.assert_allclose(np.asarray(out_ring)[6], 0.0, atol=1e-6)
+
+
+def test_jitted_encoder_bucket_boundary_lengths(mesh8):
+    """Sequence-parallel encoder at token counts straddling the pad
+    bucket: results must agree with the local encoder for every length,
+    not only the bucket-aligned ones."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, max_len=256, dtype=jnp.float32)
+    enc_sp = JittedEncoder(cfg, mesh=mesh8, sequence_axis="data")
+    enc_local = JittedEncoder(cfg, params=enc_sp.params)
+
+    docs = [
+        "w " * 31,   # just under a 32-token bucket
+        "w " * 32,
+        "w " * 33,   # just over
+        "w " * 255,  # max_len - 1
+        "w",         # single token
+    ]
+    out_sp = enc_sp.encode(docs)
+    out_local = enc_local.encode(docs)
+    assert out_sp.shape == out_local.shape == (5, cfg.hidden)
+    np.testing.assert_allclose(out_sp, out_local, rtol=2e-3, atol=2e-3)
