@@ -40,9 +40,10 @@ def _list_files(path: str) -> list[str]:
 
 
 class _FilesSource(RowSource):
-    deterministic_replay = True
     """Reads lines of files under a path; in streaming mode polls for new
     files and appended lines (reference filesystem scanner + dir watching)."""
+
+    deterministic_replay = True
 
     def __init__(
         self,
@@ -292,14 +293,14 @@ class _FilesSource(RowSource):
 
 
 class _WholeFileSource(RowSource):
-    #: the sorted dir scan re-produces events in the same order on a
-    #: resume-from-snapshot restart (same contract as _FilesSource)
-    deterministic_replay = True
-
     """One row PER FILE (``format="binary"`` / ``"plaintext_by_file"``,
     reference binary object pattern): streaming mode polls the directory
     and upserts changed files (keyed by path) and retracts deleted ones —
     the dir-watch contract DocumentStore ingestion relies on."""
+
+    #: the sorted dir scan re-produces events in the same order on a
+    #: resume-from-snapshot restart (same contract as _FilesSource)
+    deterministic_replay = True
 
     def __init__(
         self,

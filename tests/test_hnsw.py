@@ -249,11 +249,17 @@ def test_hnsw_concurrent_add_search_remove():
 def test_hnsw_recall_at_100k_docs():
     """Recall at 100k docs (round-3 done criterion said 1M; round-4
     verdict weak #7 flagged that assertions only ran at 8k — this is the
-    committed >=100k-scale check; 1M remains a bench-only scale).  Also
-    asserts sub-linear query cost: the visited-node counter must stay
-    far below a brute-force scan."""
+    committed >=100k-scale check; 1M remains a bench-only scale).  The
+    native graph index must actually be active: without it HnswIndex
+    silently falls back to exact brute force and recall 1.0 would prove
+    nothing."""
+    from pathway_tpu.internals import native as _native
+
+    if _native.load() is None:
+        pytest.skip("native module unavailable: HNSW falls back to exact")
     x = _corpus(n=100_000, d=32, seed=3)
     idx = HnswIndex(x.shape[1], metric="cos")
+    assert idx._native is not None, "graph index inactive (exact fallback)"
     CHUNK = 10_000
     for lo in range(0, len(x), CHUNK):
         idx.add(list(enumerate(x[lo : lo + CHUNK], start=lo)))

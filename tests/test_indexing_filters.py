@@ -1,0 +1,167 @@
+"""Metadata filter language + index adapter edge cases (reference
+JMESPath-subset filters, ``src/external_integration/mod.rs:92-181``, and
+the BM25/hybrid/usearch adapter family).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pathway_tpu.stdlib.indexing.adapters import (
+    BM25Adapter,
+    HybridAdapter,
+    KnnAdapter,
+)
+from pathway_tpu.stdlib.indexing.filters import compile_filter
+
+
+# ---------------------------------------------------------------------------
+# filter language
+
+
+M = {
+    "path": "/docs/report-2024.pdf",
+    "owner": {"name": "ada", "age": 37},
+    "tags": "alpha beta",
+    "modified_at": 1700000000,
+    "score": 2.5,
+}
+
+
+@pytest.mark.parametrize(
+    "expr,expected",
+    [
+        ("modified_at == `1700000000`", True),
+        ("modified_at != `1700000000`", False),
+        ("modified_at > `1699999999`", True),
+        ("modified_at >= `1700000000`", True),
+        ("modified_at < `1700000000`", False),
+        ("score == `2.5`", True),
+        ("owner.name == 'ada'", True),
+        ("owner.name == 'bob'", False),
+        ("owner.age <= `37`", True),
+        ("contains(tags, 'beta')", True),
+        ("contains(tags, 'gamma')", False),
+        ("globmatch('*.pdf', path)", True),
+        ("globmatch('*.docx', path)", False),
+        ("globmatch('/docs/*', path)", True),
+        ("owner.name == 'ada' && score > `2`", True),
+        ("owner.name == 'ada' && score > `3`", False),
+        ("owner.name == 'bob' || contains(tags, 'alpha')", True),
+        ("!(owner.name == 'bob')", True),
+        ("!(owner.name == 'ada') || modified_at > `0`", True),
+        ("(score > `2` || score < `1`) && owner.age == `37`", True),
+    ],
+)
+def test_filter_expressions(expr, expected):
+    assert compile_filter(expr)(M) is expected, expr
+
+
+def test_filter_missing_fields_and_garbage_are_false():
+    f = compile_filter("nosuch.field == 'x'")
+    assert f(M) is False
+    assert f({}) is False
+    assert f(None) is False
+    # comparing incompatible types fails closed, not loudly
+    assert compile_filter("owner > `3`")(M) is False
+
+
+def test_filter_memoization_returns_same_callable():
+    a = compile_filter("score > `1`")
+    b = compile_filter("score > `1`")
+    assert a is b
+
+
+def test_filter_quoting_variants():
+    assert compile_filter('owner.name == "ada"')(M) is True
+    assert compile_filter("path == '/docs/report-2024.pdf'")(M) is True
+
+
+# ---------------------------------------------------------------------------
+# adapters (batch API: add([(key, payload)]), search(payloads, ks, filters))
+
+
+def test_bm25_rare_terms_outrank_common():
+    docs = {
+        1: "the quick brown fox",
+        2: "the the the lazy dog",
+        3: "quantum chromodynamics lattice",
+    }
+    idx = BM25Adapter()
+    idx.add(list(docs.items()))
+    res = idx.search(["quantum lattice"], [3], [None])[0]
+    assert res[0][0] == 3
+    res = idx.search(["the"], [3], [None])[0]
+    assert {key for key, _score in res} <= {1, 2}
+
+
+def test_bm25_removal_and_requery():
+    idx = BM25Adapter()
+    idx.add([(1, "alpha beta"), (2, "alpha gamma")])
+    assert idx.search(["gamma"], [2], [None])[0][0][0] == 2
+    idx.remove([2])
+    res = idx.search(["gamma"], [2], [None])[0]
+    assert all(key != 2 for key, _ in res)
+    # re-add under the same key with new text
+    idx.add([(2, "delta epsilon")])
+    assert idx.search(["epsilon"], [1], [None])[0][0][0] == 2
+
+
+def test_bm25_metadata_filter_applies():
+    idx = BM25Adapter()
+    idx.add(
+        [
+            (1, ("alpha report", {"path": "/a.pdf"})),
+            (2, ("alpha summary", {"path": "/b.txt"})),
+        ]
+    )
+    f = compile_filter("globmatch('*.pdf', path)")
+    res = idx.search(["alpha"], [5], [f])[0]
+    assert [key for key, _ in res] == [1]
+    # same query unfiltered sees both
+    res = idx.search(["alpha"], [5], [None])[0]
+    assert {key for key, _ in res} == {1, 2}
+
+
+def test_hybrid_rrf_fuses_lexical_and_vector():
+    """A doc strong in BOTH modalities must outrank one that is strong
+    in a single modality only (reciprocal rank fusion)."""
+    vecs = {
+        1: np.array([1.0, 0.0, 0.0], np.float32),
+        2: np.array([0.9, 0.1, 0.0], np.float32),
+        3: np.array([0.0, 1.0, 0.0], np.float32),
+    }
+    texts = {1: "apple pie recipe", 2: "apple tart", 3: "rocket engine"}
+    knn = KnnAdapter(3, metric="cos")
+    bm = BM25Adapter()
+    hybrid = HybridAdapter([knn, bm])
+    # hybrid add fans the same payload out to the children; feed the
+    # children directly so each modality gets its own payload shape
+    knn.add(list(vecs.items()))
+    bm.add(list(texts.items()))
+    # hybrid payloads are tuples with one element per child
+    res = hybrid.search(
+        [(np.array([1.0, 0.0, 0.0], np.float32), "apple")],
+        [3],
+        [None],
+    )[0]
+    assert res[0][0] in (1, 2)  # strong in both modalities
+    assert res[-1][0] == 3
+
+
+def test_knn_adapter_filter_and_churn():
+    knn = KnnAdapter(4, metric="cos")
+    rng = np.random.default_rng(0)
+    rows = [
+        (i, (rng.normal(size=4).astype(np.float32), {"grp": i % 2}))
+        for i in range(20)
+    ]
+    knn.add(rows)
+    q = rows[3][1][0]
+    f = compile_filter("grp == `1`")
+    res = knn.search([q], [5], [f])[0]
+    assert res and all(key % 2 == 1 for key, _ in res)
+    knn.remove([k for k, _p in rows if k % 2 == 1])
+    res = knn.search([q], [5], [f])[0]
+    assert res == []

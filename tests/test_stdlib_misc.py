@@ -749,3 +749,26 @@ def test_sql_in_subquery():
         pw.left.cust, pw.left.amount
     )
     assert sorted(run_to_rows(semi)) == sorted(run_to_rows(gt))
+
+
+def test_load_yaml_private_keys_and_escape():
+    """Reference app-template key conventions: a leading $ marks a
+    private variable (referenced as $name, dropped from the result);
+    $$name escapes to the literal key $name, which a $$name value
+    reference resolves to."""
+    cfg = pw.load_yaml(
+        """
+$hidden: 41
+visible: $hidden
+$$literal: 7
+also: $$literal
+"""
+    )
+    assert cfg == {"visible": 41, "$literal": 7, "also": 7}
+    # private/public collision raises instead of silently shadowing
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError, match="same variable name"):
+        pw.load_yaml("$x: 1\nx: 2")
+    # non-string keys pass through untouched
+    assert pw.load_yaml("1: a\nb: 2") == {1: "a", "b": 2}

@@ -1,0 +1,303 @@
+"""Streaming/batch consistency properties: the engine's core guarantee.
+
+For random update streams (interleaved adds, overwrites and deletions
+across many commit epochs) pushed through representative pipelines, the
+FINAL streaming state must equal recomputing the same pipeline over the
+final batch input — differential dataflow's defining invariant (the
+reference inherits it from differential arrangements; our epoch engine
+must reproduce it through its retraction machinery).
+
+Each pipeline runs twice per seed: once over the update stream (python
+connector emitting per-epoch adds/removes), once over a static table of
+the surviving rows; results are compared as sorted value tuples.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import run_to_rows
+
+
+class _StreamSource(pw.io.python.ConnectorSubject):
+    """Replays scripted epochs of ('add'|'remove', key, row) events."""
+
+    def __init__(self, epochs: list[list[tuple]], schema):
+        super().__init__()
+        self._epochs = epochs
+        self._sch = schema
+
+    def run(self) -> None:
+        from pathway_tpu.internals import keys as K
+        from pathway_tpu.io._connector import coerce_row
+
+        for epoch in self._epochs:
+            for kind, key, row in epoch:
+                k = K.ref_scalar("strm", key)
+                if kind == "add":
+                    self._events.add(k, coerce_row(row, self._sch))
+                else:
+                    self._events.remove(k, coerce_row(row, self._sch))
+            self.commit()
+
+
+def _random_history(rng: random.Random, n_keys: int, n_epochs: int):
+    """Scripted epochs + the surviving final rows. Keys are overwritten
+    via remove+add (upsert) and sometimes deleted outright."""
+    alive: dict[int, dict] = {}
+    epochs: list[list[tuple]] = []
+    for _ in range(n_epochs):
+        epoch: list[tuple] = []
+        for _ in range(rng.randrange(1, 6)):
+            key = rng.randrange(n_keys)
+            action = rng.random()
+            if key in alive and action < 0.25:
+                epoch.append(("remove", key, alive.pop(key)))
+            elif key in alive and action < 0.55:
+                new = {"k": key, "g": rng.choice("xyz"), "v": rng.randrange(50)}
+                epoch.append(("remove", key, alive[key]))
+                epoch.append(("add", key, new))
+                alive[key] = new
+            elif key not in alive:
+                row = {"k": key, "g": rng.choice("xyz"), "v": rng.randrange(50)}
+                epoch.append(("add", key, row))
+                alive[key] = row
+        if epoch:
+            epochs.append(epoch)
+    return epochs, list(alive.values())
+
+
+def _schema():
+    return pw.schema_from_types(k=int, g=str, v=int)
+
+
+def _stream_table(epochs):
+    src = _StreamSource(epochs, _schema())
+    return pw.io.python.read(src, schema=_schema())
+
+
+def _batch_table(rows):
+    return pw.debug.table_from_rows(
+        _schema(), [(r["k"], r["g"], r["v"]) for r in rows]
+    )
+
+
+def _run_both(build, epochs, final_rows):
+    pw.G.clear()
+    streamed = sorted(run_to_rows(build(_stream_table(epochs))))
+    pw.G.clear()
+    batch = sorted(run_to_rows(build(_batch_table(final_rows))))
+    return streamed, batch
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_select_filter_consistency(seed):
+    rng = random.Random(seed)
+    epochs, final = _random_history(rng, n_keys=8, n_epochs=10)
+
+    def build(t):
+        out = t.select(t.k, t.g, doubled=t.v * 2, flag=t.v % 3 == 0)
+        return out.filter(out.doubled > 10)
+
+    streamed, batch = _run_both(build, epochs, final)
+    assert streamed == batch, (epochs, final)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_groupby_aggregates_consistency(seed):
+    rng = random.Random(20 + seed)
+    epochs, final = _random_history(rng, n_keys=10, n_epochs=12)
+
+    def build(t):
+        return t.groupby(t.g).reduce(
+            t.g,
+            n=pw.reducers.count(),
+            s=pw.reducers.sum(t.v),
+            mx=pw.reducers.max(t.v),
+            mn=pw.reducers.min(t.v),
+        )
+
+    streamed, batch = _run_both(build, epochs, final)
+    assert streamed == batch, (epochs, final)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_join_consistency(seed):
+    """Self-join via two independent streams sharing the key space."""
+    rng = random.Random(40 + seed)
+    epochs_a, final_a = _random_history(rng, n_keys=6, n_epochs=8)
+    epochs_b, final_b = _random_history(rng, n_keys=6, n_epochs=8)
+
+    def build_pair(a, b):
+        j = a.join(b, a.k == b.k)
+        return j.select(a.k, va=a.v, vb=b.v)
+
+    pw.G.clear()
+    streamed = sorted(
+        run_to_rows(
+            build_pair(_stream_table(epochs_a), _stream_table(epochs_b))
+        )
+    )
+    pw.G.clear()
+    batch = sorted(
+        run_to_rows(build_pair(_batch_table(final_a), _batch_table(final_b)))
+    )
+    assert streamed == batch
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_groupby_then_join_consistency(seed):
+    """Two-stage pipeline: aggregates joined back against the rows."""
+    rng = random.Random(60 + seed)
+    epochs, final = _random_history(rng, n_keys=8, n_epochs=10)
+
+    def build(t):
+        g = t.groupby(t.g).reduce(t.g, total=pw.reducers.sum(t.v))
+        j = t.join(g, t.g == g.g)
+        return j.select(t.k, t.v, pw.right.total)
+
+    streamed, batch = _run_both(build, epochs, final)
+    assert streamed == batch, (epochs, final)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_distinct_count_consistency(seed):
+    rng = random.Random(80 + seed)
+    epochs, final = _random_history(rng, n_keys=12, n_epochs=10)
+
+    def build(t):
+        per_g = t.groupby(t.g, t.v).reduce(t.g, t.v)
+        return per_g.groupby(per_g.g).reduce(
+            per_g.g, distinct_vals=pw.reducers.count()
+        )
+
+    streamed, batch = _run_both(build, epochs, final)
+    assert streamed == batch, (epochs, final)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_argmax_and_tuple_reducers_consistency(seed):
+    rng = random.Random(120 + seed)
+    epochs, final = _random_history(rng, n_keys=9, n_epochs=9)
+    if not final:
+        pytest.skip("empty final state for this seed")
+
+    def build(t):
+        return t.groupby(t.g).reduce(
+            t.g,
+            best_k=pw.reducers.argmax(t.v, t.k),
+            vals=pw.reducers.sorted_tuple(t.v),
+        )
+
+    streamed, batch = _run_both(build, epochs, final)
+    assert streamed == batch, (epochs, final)
+
+
+def test_full_retraction_leaves_empty_state():
+    """Every added row eventually retracted: all downstream state must
+    drain to empty, including aggregates."""
+    rows = [{"k": i, "g": "x", "v": i} for i in range(5)]
+    epochs = [[("add", i, rows[i]) for i in range(5)]]
+    epochs.append([("remove", i, rows[i]) for i in range(5)])
+
+    def build(t):
+        return t.groupby(t.g).reduce(t.g, n=pw.reducers.count())
+
+    streamed, batch = _run_both(build, epochs, [])
+    assert streamed == [] and batch == []
+
+
+# ---------------------------------------------------------------------------
+# universe operations under streaming updates
+
+
+def _pair_histories(seed: int):
+    rng = random.Random(seed)
+    ea, fa = _random_history(rng, n_keys=6, n_epochs=8)
+    eb, fb = _random_history(rng, n_keys=6, n_epochs=8)
+    return ea, fa, eb, fb
+
+
+def _keyed_batch_table(rows):
+    """The final rows in ONE epoch through the SAME keyed source — the
+    universe ops key on row identity, so the batch side must share the
+    stream's key function (ref_scalar('strm', k))."""
+    epoch = [("add", r["k"], r) for r in rows]
+    return _stream_table([epoch] if epoch else [])
+
+
+def _run_both_pair(build_pair, ea, fa, eb, fb):
+    pw.G.clear()
+    streamed = sorted(
+        run_to_rows(build_pair(_stream_table(ea), _stream_table(eb)))
+    )
+    pw.G.clear()
+    batch = sorted(
+        run_to_rows(
+            build_pair(_keyed_batch_table(fa), _keyed_batch_table(fb))
+        )
+    )
+    return streamed, batch
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_update_rows_consistency(seed):
+    """update_rows: B's rows overwrite A's at equal keys; both tables
+    stream independently."""
+    ea, fa, eb, fb = _pair_histories(200 + seed)
+
+    def build_pair(a, b):
+        return a.update_rows(b).select(pw.this.k, pw.this.g, pw.this.v)
+
+    streamed, batch = _run_both_pair(build_pair, ea, fa, eb, fb)
+    assert streamed == batch, (ea, eb)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_intersect_difference_restrict_consistency(seed):
+    """Universe ops track membership changes on both sides. The two
+    sources share the key space (ref_scalar('strm', key)), so equal keys
+    collide across tables — exactly what these ops key on."""
+    ea, fa, eb, fb = _pair_histories(300 + seed)
+
+    def build_inter(a, b):
+        return a.intersect(b).select(pw.this.k, pw.this.v)
+
+    def build_diff(a, b):
+        return a.difference(b).select(pw.this.k, pw.this.v)
+
+    streamed, batch = _run_both_pair(build_inter, ea, fa, eb, fb)
+    assert streamed == batch, "intersect diverged"
+    streamed, batch = _run_both_pair(build_diff, ea, fa, eb, fb)
+    assert streamed == batch, "difference diverged"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_ix_and_having_consistency(seed):
+    """Pointer indirection (ix / having) under churn: looked-up rows
+    follow the target table's updates."""
+    ea, fa, eb, fb = _pair_histories(400 + seed)
+
+    def build_having(a, b):
+        from pathway_tpu.internals import keys as K
+
+        ptrs = a.select(p=pw.apply(lambda k: K.ref_scalar("strm", k), a.k))
+        return b.having(ptrs.p).select(pw.this.k, pw.this.v)
+
+    streamed, batch = _run_both_pair(build_having, ea, fa, eb, fb)
+    assert streamed == batch, (ea, eb)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_concat_reindex_consistency(seed):
+    ea, fa, eb, fb = _pair_histories(500 + seed)
+
+    def build_pair(a, b):
+        u = a.concat_reindex(b)
+        return u.groupby(u.g).reduce(u.g, n=pw.reducers.count(), s=pw.reducers.sum(u.v))
+
+    streamed, batch = _run_both_pair(build_pair, ea, fa, eb, fb)
+    assert streamed == batch

@@ -1,0 +1,226 @@
+"""UDF system: retry strategies, caches, executors, capacity/timeout
+combinators — the reference's udfs package behaviors
+(``python/pathway/internals/udfs/``: retries.py, caches.py,
+executors.py), previously covered only incidentally through pipelines.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import udfs
+from tests.utils import run_to_rows
+
+
+# ---------------------------------------------------------------------------
+# retry strategies
+
+
+def test_fixed_delay_retry_retries_then_succeeds():
+    calls = []
+
+    async def flaky(x):
+        calls.append(x)
+        if len(calls) < 3:
+            raise ValueError("transient")
+        return x * 10
+
+    strat = udfs.FixedDelayRetryStrategy(max_retries=5, delay_ms=1)
+    out = asyncio.run(strat.invoke(flaky, 4))
+    assert out == 40
+    assert len(calls) == 3
+
+
+def test_fixed_delay_retry_exhausts_and_raises():
+    async def always_fails():
+        raise RuntimeError("permanent")
+
+    strat = udfs.FixedDelayRetryStrategy(max_retries=2, delay_ms=1)
+    with pytest.raises(RuntimeError, match="permanent"):
+        asyncio.run(strat.invoke(always_fails))
+
+
+def test_exponential_backoff_delay_growth():
+    strat = udfs.ExponentialBackoffRetryStrategy(
+        max_retries=4, initial_delay=100, backoff_factor=2, jitter_ms=0
+    )
+    delays = [strat._next_delay(a) for a in range(4)]
+    # jitter off: exact doubling from the initial delay
+    assert delays == [0.1, 0.2, 0.4, 0.8], delays
+
+
+def test_no_retry_strategy_single_attempt():
+    calls = []
+
+    async def fails():
+        calls.append(1)
+        raise ValueError("once")
+
+    with pytest.raises(ValueError):
+        asyncio.run(udfs.NoRetryStrategy().invoke(fails))
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# caches
+
+
+def test_in_memory_cache_memoizes_by_args():
+    calls = []
+
+    async def f(x, y=1):
+        calls.append((x, y))
+        return x + y
+
+    wrapped = udfs.InMemoryCache().make_wrapper(f)
+    assert asyncio.run(wrapped(1, y=2)) == 3
+    assert asyncio.run(wrapped(1, y=2)) == 3
+    assert asyncio.run(wrapped(2, y=2)) == 4
+    assert calls == [(1, 2), (2, 2)]
+
+
+def test_disk_cache_persists_across_instances(tmp_path):
+    calls = []
+
+    async def f(x):
+        calls.append(x)
+        return x * 2
+
+    c1 = udfs.DiskCache(directory=str(tmp_path))
+    assert asyncio.run(c1.make_wrapper(f)(21)) == 42
+    # a FRESH cache over the same dir serves from disk
+    c2 = udfs.DiskCache(directory=str(tmp_path))
+    assert asyncio.run(c2.make_wrapper(f)(21)) == 42
+    assert calls == [21]
+
+
+def test_default_cache_exists():
+    """pw.udfs.DefaultCache is the YAML-template alias the reference apps
+    use (app.yaml: cache_strategy: !pw.udfs.DefaultCache)."""
+    assert hasattr(udfs, "DefaultCache")
+    c = udfs.DefaultCache()
+    assert isinstance(c, udfs.CacheStrategy)
+
+
+# ---------------------------------------------------------------------------
+# combinators
+
+
+def test_with_capacity_bounds_concurrency():
+    peak = {"now": 0, "max": 0}
+
+    async def slow(x):
+        peak["now"] += 1
+        peak["max"] = max(peak["max"], peak["now"])
+        await asyncio.sleep(0.02)
+        peak["now"] -= 1
+        return x
+
+    bounded = udfs.with_capacity(slow, 3)
+
+    async def fan_out():
+        return await asyncio.gather(*[bounded(i) for i in range(10)])
+
+    out = asyncio.run(fan_out())
+    assert out == list(range(10))
+    assert peak["max"] <= 3, peak
+
+
+def test_with_timeout_raises_on_slow_call():
+    async def slow():
+        await asyncio.sleep(1.0)
+
+    fast = udfs.with_timeout(slow, 0.05)
+    with pytest.raises(Exception):
+        asyncio.run(fast())
+
+
+def test_coerce_async_wraps_sync_function():
+    out = asyncio.run(udfs.coerce_async(lambda x: x + 1)(4))
+    assert out == 5
+
+
+# ---------------------------------------------------------------------------
+# UDF decorator through pipelines
+
+
+def _t():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(1,), (2,), (3,)]
+    )
+
+
+def test_udf_decorator_sync_pipeline():
+    @pw.udf
+    def double(x: int) -> int:
+        return x * 2
+
+    pw.G.clear()
+    out = _t().select(y=double(pw.this.x))
+    assert sorted(run_to_rows(out)) == [(2,), (4,), (6,)]
+
+
+def test_udf_async_executor_with_retries_in_pipeline():
+    attempts: dict[int, int] = {}
+
+    @pw.udf(
+        executor=udfs.async_executor(
+            capacity=2,
+            retry_strategy=udfs.FixedDelayRetryStrategy(
+                max_retries=3, delay_ms=1
+            ),
+        )
+    )
+    async def flaky_double(x: int) -> int:
+        attempts[x] = attempts.get(x, 0) + 1
+        if attempts[x] == 1:
+            raise ValueError("first attempt always fails")
+        return x * 2
+
+    pw.G.clear()
+    out = _t().select(y=flaky_double(pw.this.x))
+    assert sorted(run_to_rows(out)) == [(2,), (4,), (6,)]
+    assert all(n >= 2 for n in attempts.values())
+
+
+def test_udf_cache_strategy_in_pipeline():
+    calls = []
+
+    @pw.udf(cache_strategy=udfs.InMemoryCache())
+    def tracked(x: int) -> int:
+        calls.append(x)
+        return x + 100
+
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(x=int), [(7,), (7,), (8,)]
+    )
+    out = t.select(y=tracked(t.x))
+    assert sorted(run_to_rows(out)) == [(107,), (107,), (108,)]
+    assert sorted(calls) == [7, 8]  # duplicate argument served from cache
+
+
+def test_udf_batched_via_batch_hook():
+    """UDFs defining __batch__ evaluate whole epochs in one call."""
+
+    class BatchSquare(udfs.UDF):
+        def __init__(self):
+            super().__init__()
+            self.batches = []
+
+        def __wrapped__(self, x):
+            raise AssertionError("per-row path must not run")
+
+        def __batch__(self, xs):
+            self.batches.append(len(xs))
+            return [x * x for x in xs]
+
+    u = BatchSquare()
+    pw.G.clear()
+    out = _t().select(y=u(pw.this.x))
+    assert sorted(run_to_rows(out)) == [(1,), (4,), (9,)]
+    assert u.batches == [3]
