@@ -689,6 +689,10 @@ class GroupByNode(Node):
         batch = inbatches[0]
         if not isinstance(batch, list):
             batch = list(batch)  # Unsupported fallback must re-iterate
+        # ERROR poisoning (reference reduce.rs: any Error input makes the
+        # group's aggregate Value::Error until it is retracted).  Error
+        # presence is tracked per (group, reducer) in g["errs"], balanced
+        # by diffs; extract() is bypassed while the count is nonzero.
         dirty: dict[Any, Any] | None = None
         if self.fast_spec is not None:
             dirty = self._accumulate_native(st, batch)
@@ -700,8 +704,49 @@ class GroupByNode(Node):
                 gvals = group_fn(u.key, u.values)
                 gh, g = self._group(st, gvals)
                 g["count"] += u.diff
-                for (reducer, arg_fn), acc in zip(reducer_args, g["accs"]):
-                    reducer.update(acc, arg_fn(u.key, u.values), u.diff)
+                for ri, ((reducer, arg_fn), acc) in enumerate(
+                    zip(reducer_args, g["accs"])
+                ):
+                    # args computed ONCE; an ERROR arg (raw cell or a
+                    # computed expression that errored) or a raising
+                    # arg expression poisons instead of reaching
+                    # update() — multiset reducers would otherwise store
+                    # the sentinel and crash at extract
+                    try:
+                        rargs = arg_fn(u.key, u.values)
+                        poisoned = bool(reducer.n_args) and any(
+                            a is api.ERROR for a in rargs
+                        )
+                    except Exception:
+                        rargs, poisoned = None, True
+                    if poisoned:
+                        errs = g.setdefault("errs", {})
+                        errs[ri] = errs.get(ri, 0) + u.diff
+                        continue
+                    reducer.update(acc, rargs, u.diff)
+                dirty[gh] = g
+        else:
+            # native fast path: reducer args are plain column positions
+            # (fast_spec), so scanning the raw cells is exact; the C
+            # partials skip sum-like error args and the multiset stores
+            # them symmetrically — extract is masked while poisoned
+            for u in batch:
+                if not any(v is api.ERROR for v in u.values):
+                    continue
+                gvals = self.group_fn(u.key, u.values)
+                gh, g = self._group(st, gvals)
+                for ri, (reducer, arg_fn) in enumerate(self.reducer_args):
+                    if not reducer.n_args:
+                        continue  # count() never looks at values
+                    try:
+                        poisoned = any(
+                            a is api.ERROR for a in arg_fn(u.key, u.values)
+                        )
+                    except Exception:
+                        poisoned = True
+                    if poisoned:
+                        errs = g.setdefault("errs", {})
+                        errs[ri] = errs.get(ri, 0) + u.diff
                 dirty[gh] = g
         out = []
         for gh, g in dirty.items():
@@ -714,8 +759,12 @@ class GroupByNode(Node):
                 out.append(Update(okey, g["last_out"], -1))
                 g["last_out"] = None
             if g["count"] > 0:
+                errs = g.get("errs") or {}
                 reduced = tuple(
-                    r.extract(acc) for (r, _), acc in zip(self.reducer_args, g["accs"])
+                    api.ERROR if errs.get(ri, 0) != 0 else r.extract(acc)
+                    for ri, ((r, _), acc) in enumerate(
+                        zip(self.reducer_args, g["accs"])
+                    )
                 )
                 row = (tuple(g["gvals"]) + reduced) if self.include_group_values else reduced
                 out.append(Update(okey, row, 1))

@@ -358,7 +358,9 @@ class NpSumReducer(ReducerImpl):
 
     def update(self, acc, args, diff):
         v = args[0]
-        if v is None:
+        if v is None or v is api.ERROR:
+            # defense in depth: GroupByNode poisons error args before
+            # update(), but a direct caller must not crash on the sentinel
             return
         v = np.asarray(v)
         acc[0] = v * diff if acc[0] is None else acc[0] + v * diff

@@ -1,0 +1,197 @@
+"""Error-value propagation semantics across the operator set: ERROR is a
+first-class value (reference ``Value::Error``, ``src/engine/error.rs``)
+— it flows through selects, drops from filters, is absorbed by joins and
+groupbys per the reference's rules, and never aborts the run.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import api
+from tests.utils import T, run_to_rows
+
+
+def _with_error():
+    """A table whose middle row computes an ERROR in column e."""
+    t = T(
+        """
+        k | d
+        1 | 1
+        2 | 0
+        3 | 3
+        """
+    )
+    return t.select(t.k, e=pw.fill_error(t.k // t.d, -99) if False else t.k // t.d)
+
+
+def test_error_value_flows_through_select_chain():
+    t = _with_error()
+    # further arithmetic on an ERROR stays ERROR, other rows unaffected
+    out = t.select(t.k, y=t.e * 10 + 1)
+    rows = dict(run_to_rows(out))
+    assert rows[1] == 11 and rows[3] == 11 or True  # values checked below
+    vals = sorted(run_to_rows(out), key=lambda r: r[0])
+    assert vals[0] == (1, 11)
+    assert vals[1][0] == 2 and vals[1][1] is api.ERROR
+    assert vals[2] == (3, 11)
+
+
+def test_fill_error_replaces_and_stops_propagation():
+    t = _with_error()
+    out = t.select(t.k, y=pw.fill_error(t.e, -1) * 2)
+    assert sorted(run_to_rows(out)) == [(1, 2), (2, -2), (3, 2)]
+
+
+def test_filter_drops_error_predicates():
+    t = _with_error()
+    # predicate on the ERROR row evaluates to ERROR -> row drops, run continues
+    out = t.filter(t.e > 0).select(t.k)
+    assert sorted(run_to_rows(out)) == [(1,), (3,)]
+
+
+def test_groupby_absorbs_error_keys_and_values():
+    """Rows whose GROUP KEY is ERROR group under the error key; aggregate
+    VALUES that are ERROR poison their group's aggregate, not the run."""
+    t = _with_error()
+    out = t.groupby(t.e).reduce(n=pw.reducers.count())
+    counts = sorted(v[0] for v in run_to_rows(out))
+    # groups: e=1 (k=1 and k=3 both 1//1? no: k//d = 1,ERROR,1) -> {1: 2, ERROR: 1}
+    assert counts == [1, 2]
+    keyed = t.select(t.e, parity=t.k % 2)
+    s = keyed.groupby(keyed.parity).reduce(total=pw.reducers.sum(keyed.e))
+    vals = [v[0] for v in run_to_rows(s)]
+    # the odd group sums cleanly; the even group's sum is poisoned
+    assert sorted(str(v) for v in vals) == sorted(["2", str(api.ERROR)])
+
+
+def test_join_on_error_key_produces_no_match():
+    t = _with_error()
+    other = T(
+        """
+        j | w
+        1 | x
+        3 | y
+        """
+    )
+    jn = t.join(other, t.e == other.j).select(t.k, other.w)
+    assert sorted(run_to_rows(jn)) == [(1, "x"), (3, "x")]
+
+
+def test_unwrap_turns_none_into_error_and_requires():
+    t = T(
+        """
+        a | b
+        1 | 5
+        2 |
+        """
+    )
+    out = t.select(t.a, u=pw.unwrap(t.b) + 1)
+    vals = dict(run_to_rows(out))
+    assert vals[1] == 6
+    assert vals[2] is api.ERROR
+
+
+def test_error_log_collects_multiple_operator_failures():
+    t = T(
+        """
+        a
+        0
+        1
+        """
+    )
+    err = pw.global_error_log()
+    t.select(x=pw.apply(lambda a: 1 // a, t.a))
+    t.select(y=pw.apply(lambda a: [1, 2][a + 5], t.a))
+    cap = err._capture_node()
+    ctx = pw.run()
+    messages = [v[0] for v in ctx.state(cap)["rows"].values()]
+    assert any("ZeroDivisionError" in m for m in messages)
+    assert any("IndexError" in m for m in messages)
+
+
+def test_error_rows_do_not_reach_outputs_via_subscribe_filtering():
+    """A pipeline can quarantine ERROR rows explicitly with fill_error +
+    a sentinel filter — the recommended output hygiene pattern."""
+    t = _with_error()
+    clean = t.select(t.k, v=pw.fill_error(t.e, None)).filter(
+        ~pw.this.v.is_none()
+    )
+    assert sorted(run_to_rows(clean)) == [(1, 1), (3, 1)]
+
+
+def test_runtime_typecheck_violation_is_fatal(monkeypatch):
+    """Declared-type violations under PATHWAY_RUNTIME_TYPECHECKING are a
+    FATAL engine error (reference fail-whole-run), unlike value errors."""
+    t = T(
+        """
+        a
+        1
+        """
+    )
+    bad = t.select(x=pw.declare_type(str, pw.apply(lambda a: a + 1, t.a)))
+    bad._capture_node()
+    with pytest.raises(Exception):
+        pw.run(runtime_typechecking=True)
+
+
+def test_groupby_error_poison_heals_on_retraction():
+    """Retracting the ERROR-bearing row un-poisons its group's aggregate
+    (reference reduce.rs keeps an error COUNT, not a sticky flag)."""
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    g | a | d | __time__ | __diff__
+    x | 4 | 2 | 2        | 1
+    x | 6 | 0 | 2        | 1
+    x | 6 | 0 | 4        | -1
+    """
+    )
+    w = t.select(t.g, v=t.a // t.d)
+    out = w.groupby(w.g).reduce(w.g, s=pw.reducers.sum(w.v))
+    history = []
+    pw.io.subscribe(
+        out, on_change=lambda k, row, tm, add: history.append((tm, add, row["s"]))
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    # epoch 2: the aggregate is poisoned; epoch 4: clean sum again
+    final_adds = [v for tm, add, v in history if add]
+    assert str(final_adds[0]) == str(api.ERROR)
+    assert final_adds[-1] == 2
+
+
+def test_computed_reducer_arg_error_poisons_multiset_reducers():
+    """The reducer ARGUMENT expression itself errors (no raw cell is
+    ERROR): min/max/sorted_tuple must poison, not crash at extract, and
+    sum must poison rather than silently skipping (review finding)."""
+    pw.G.clear()
+    t = T(
+        """
+        g | a | b
+        x | 4 | 2
+        x | 6 | 0
+        y | 9 | 3
+        """
+    )
+    out = t.groupby(t.g).reduce(
+        t.g,
+        m=pw.reducers.min(t.a // t.b),
+        s=pw.reducers.sum(t.a // t.b),
+        st_=pw.reducers.sorted_tuple(t.a // t.b),
+    )
+    rows = {r[0]: r[1:] for r in run_to_rows(out)}
+    assert all(v is api.ERROR for v in rows["x"])
+    assert rows["y"] == (3, 3, (3,))
+
+
+def test_npsum_direct_error_arg_does_not_crash():
+    from pathway_tpu.engine.reducers import NpSumReducer
+
+    r = NpSumReducer()
+    acc = r.make_acc()
+    r.update(acc, (api.ERROR,), 1)  # must be a no-op, not a TypeError
+    r.update(acc, ([1.0, 2.0],), 1)
+    import numpy as np
+
+    np.testing.assert_allclose(r.extract(acc), [1.0, 2.0])
