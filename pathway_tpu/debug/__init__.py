@@ -55,10 +55,24 @@ def table_from_markdown(
     an update stream (reference ``debug/__init__.py:312-481``)."""
     lines = [l for l in txt.strip().splitlines() if l.strip() and not set(l.strip()) <= {"-", "|", "+", " "}]
 
+    # outer-pipe style ("| a | b |") is decided by the HEADER: in the
+    # bare style ("a | b") a row's leading pipe marks an EMPTY FIRST
+    # CELL ("  | n1" is [None, "n1"]), which a blanket strip("|") used
+    # to swallow
+    outer_pipes = lines[0].strip().startswith("|") if lines else False
+
     def split_line(line: str) -> list[str]:
-        line = line.strip()
-        if "|" in line:
-            return [c.strip() for c in line.strip("|").split("|")]
+        stripped = line.strip()
+        if "|" in stripped:
+            parts = stripped.split("|")
+            if outer_pipes:
+                if stripped.startswith("|"):
+                    parts = parts[1:]
+                if stripped.endswith("|"):
+                    parts = parts[:-1]
+            elif stripped.endswith("|"):
+                parts = parts[:-1]
+            return [c.strip() for c in parts]
         # whitespace-separated; quoted strings stay whole
         return re.findall(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"|\S+", line)
 

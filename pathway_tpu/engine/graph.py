@@ -922,8 +922,55 @@ class JoinNode(Node):
 
     _KIND_CODES = {"inner": 0, "left": 1, "right": 2, "outer": 3}
 
+    def _split_null_keys(self, batch, jk_fn, side: str, null_out: list):
+        """Partition null-jk rows off a batch, appending their
+        passthrough updates (built by :meth:`_block`, the single owner of
+        the output row shape) to ``null_out``.  Returns (kept_rows,
+        kept_jks)."""
+        batch = list(batch)
+        jks = self._side_jks(batch, jk_fn)
+        if all(jk is not None for jk in jks):
+            return batch, jks
+        kept, kept_jks = [], []
+        for u, jk in zip(batch, jks):
+            if jk is not None:
+                kept.append(u)
+                kept_jks.append(jk)
+                continue
+            single = {u.key: u.values}
+            block = (
+                self._block(single, {})
+                if side == "left"
+                else self._block({}, single)
+            )
+            null_out.extend(
+                Update(okey, vals, u.diff) for okey, vals in block.items()
+            )
+        return kept, kept_jks
+
     def process(self, ctx, time, inbatches):
         st = ctx.state(self)
+        # SQL outer semantics: a null join key never MATCHES, but the row
+        # is RETAINED unmatched on its preserved side (LEFT/RIGHT/FULL
+        # OUTER keep null-key rows; only INNER drops them).  Null-key
+        # rows are stateless passthroughs — they can never gain a match —
+        # so they are split off here and emitted directly, leaving the
+        # arrangements (native and Python alike) null-free.  The computed
+        # jks are handed to the Python fallback so it never recomputes
+        # them; the cost of this Python pass only hits the outer family,
+        # never inner joins.
+        null_out: list[Update] = []
+        ljks = rjks = None
+        if self.kind in ("left", "outer"):
+            left_b, ljks = self._split_null_keys(
+                inbatches[0], self.left_jk_fn, "left", null_out
+            )
+            inbatches = [left_b, inbatches[1]]
+        if self.kind in ("right", "outer"):
+            right_b, rjks = self._split_null_keys(
+                inbatches[1], self.right_jk_fn, "right", null_out
+            )
+            inbatches = [inbatches[0], right_b]
         native = _native.load()
         if native is not None and self.jk_programs is not None:
             # whole-epoch native pass (build/probe/diff in C, mirroring
@@ -948,9 +995,11 @@ class JoinNode(Node):
             except native.Unsupported:
                 pass
             else:
-                return consolidate(out)
-        ljks = self._side_jks(inbatches[0], self.left_jk_fn)
-        rjks = self._side_jks(inbatches[1], self.right_jk_fn)
+                return consolidate(out + null_out)
+        if ljks is None:
+            ljks = self._side_jks(inbatches[0], self.left_jk_fn)
+        if rjks is None:
+            rjks = self._side_jks(inbatches[1], self.right_jk_fn)
         dirty_keys: set = set()
         dirty_keys.update(jk for jk in ljks if jk is not None)
         dirty_keys.update(jk for jk in rjks if jk is not None)
@@ -973,7 +1022,7 @@ class JoinNode(Node):
             if not st["left"].get(jk) and not st["right"].get(jk):
                 st["left"].pop(jk, None)
                 st["right"].pop(jk, None)
-        return consolidate(out)
+        return consolidate(out + null_out)
 
 
 class IxNode(Node):

@@ -196,9 +196,12 @@ def wrap(input_type: _Any) -> DType:
         return _FROM_PY[input_type]
     origin = typing.get_origin(input_type)
     args = typing.get_args(input_type)
-    if origin is typing.Union or origin is getattr(typing, "UnionType", None) or str(
-        origin
-    ) in ("types.UnionType",):
+    import types as _types
+
+    if origin is typing.Union or origin is _types.UnionType:
+        # typing.Optional[X] AND PEP-604 `X | None` literals (their
+        # origin is types.UnionType, which the old string compare against
+        # "types.UnionType" never matched — repr is "<class ...>")
         non_none = [a for a in args if a is not type(None)]
         has_none = len(non_none) != len(args)
         if len(non_none) == 1:

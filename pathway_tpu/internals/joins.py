@@ -45,9 +45,16 @@ def _side_of(expr: ColumnExpression, left: Table, right: Table) -> str:
             sides.add("left")
         elif t is RIGHT:
             sides.add("right")
-        elif t is left or getattr(t, "_layout_token", object()) is left._layout_token:
+        # table IDENTITY decides before layout tokens: a self-join via
+        # t.copy() shares t's layout token on both sides, and the token
+        # fallback alone would call both references "left"
+        elif t is left:
             sides.add("left")
-        elif t is right or getattr(t, "_layout_token", object()) is right._layout_token:
+        elif t is right:
+            sides.add("right")
+        elif getattr(t, "_layout_token", object()) is left._layout_token:
+            sides.add("left")
+        elif getattr(t, "_layout_token", object()) is right._layout_token:
             sides.add("right")
         else:
             raise ValueError(f"join condition references unknown table: {r!r}")

@@ -59,6 +59,17 @@ class SchemaMetaclass(type):
     __columns__: dict[str, ColumnDefinition]
     __properties__: SchemaProperties
 
+    def __new__(
+        mcls,
+        name: str,
+        bases: tuple,
+        namespace: dict,
+        append_only: bool | None = None,
+    ):
+        # class-level kwargs (``class S(pw.Schema, append_only=True)``)
+        # must not reach object.__init_subclass__, which rejects them
+        return super().__new__(mcls, name, bases, namespace)
+
     def __init__(cls, name: str, bases: tuple, namespace: dict, append_only: bool | None = None) -> None:
         super().__init__(name, bases, namespace)
         columns: dict[str, ColumnDefinition] = {}

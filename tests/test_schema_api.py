@@ -1,0 +1,242 @@
+"""Schema API surface: declarations, defaults, primary keys, dtype
+introspection, composition and derivation (reference
+``internals/schema.py`` + ``python/pathway/tests/test_schema.py`` role).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as sch
+from tests.utils import run_to_rows
+
+
+def test_class_declaration_and_introspection():
+    class S(pw.Schema):
+        a: int
+        b: str
+        c: float | None
+
+    assert S.column_names() == ["a", "b", "c"]
+    assert S.dtypes()["a"] == dt.INT and S.dtypes()["b"] == dt.STR
+    assert S.dtypes()["c"] == dt.Optional(dt.FLOAT)
+
+
+def test_primary_key_and_defaults():
+    class S(pw.Schema):
+        key: int = pw.column_definition(primary_key=True)
+        name: str = pw.column_definition(default_value="anon")
+        score: float
+
+    assert S.primary_key_columns() == ["key"]
+    assert S["name"].has_default
+    assert not S["score"].has_default
+    # defaults apply through connector coercion
+    from pathway_tpu.io._connector import coerce_row
+
+    row = coerce_row({"key": 1, "score": 2.0}, S)
+    assert row == (1, "anon", 2.0)
+
+
+def test_schema_or_composition_and_without():
+    class A(pw.Schema):
+        x: int
+
+    class B(pw.Schema):
+        y: str
+
+    AB = A | B
+    assert AB.column_names() == ["x", "y"]
+    assert AB.without("x").column_names() == ["y"]
+
+
+def test_with_types_overrides():
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    S2 = S.with_types(a=float)
+    assert S2.dtypes()["a"] == dt.FLOAT
+    assert S2.dtypes()["b"] == dt.STR
+    # the original is untouched
+    assert S.dtypes()["a"] == dt.INT
+
+
+def test_schema_from_types_and_dict():
+    S = pw.schema_from_types(a=int, b=str)
+    assert S.column_names() == ["a", "b"]
+    D = sch.schema_from_dict({"x": int, "y": float | None})
+    assert D.dtypes()["y"] == dt.Optional(dt.FLOAT)
+
+
+def test_table_schema_property_round_trip():
+    t = pw.debug.table_from_rows(pw.schema_from_types(a=int, b=str), [(1, "x")])
+    S = t.schema
+    assert S.column_names() == ["a", "b"]
+    assert t.typehints()["a"] == dt.INT
+
+
+def test_primary_key_rows_keyed_by_value():
+    """Two tables with the same pk values share row keys — the join-free
+    mechanism connectors use for upserts."""
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    pw.G.clear()
+    a = pw.debug.table_from_rows(S, [(1, "x"), (2, "y")])
+    b = pw.debug.table_from_rows(S, [(1, "z")])
+    # update_rows matches on row key = hash of pk
+    out = a.update_rows(b)
+    assert sorted(run_to_rows(out.select(out.k, out.v))) == [(1, "z"), (2, "y")]
+
+
+def test_append_only_property_propagates():
+    class S(pw.Schema, append_only=True):
+        a: int
+
+    assert S.append_only
+
+
+# ---------------------------------------------------------------------------
+# join matrix (left/right/outer against nulls and duplicates)
+
+
+def _tables():
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, va=str),
+        [(1, "a1"), (2, "a2"), (2, "a2x"), (3, "a3")],
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, vb=str),
+        [(2, "b2"), (3, "b3"), (3, "b3x"), (4, "b4")],
+    )
+    return a, b
+
+
+def test_inner_join_duplicates_multiply():
+    pw.G.clear()
+    a, b = _tables()
+    j = a.join(b, a.k == b.k).select(a.k, a.va, b.vb)
+    got = sorted(run_to_rows(j))
+    assert got == [
+        (2, "a2", "b2"),
+        (2, "a2x", "b2"),
+        (3, "a3", "b3"),
+        (3, "a3", "b3x"),
+    ]
+
+
+def test_left_join_unmatched_nulls():
+    pw.G.clear()
+    a, b = _tables()
+    j = a.join_left(b, a.k == b.k).select(a.k, a.va, b.vb)
+    got = sorted(run_to_rows(j), key=repr)
+    assert (1, "a1", None) in got
+    assert len(got) == 5  # 4 inner matches + 1 unmatched left
+
+
+def test_right_join_unmatched_nulls():
+    pw.G.clear()
+    a, b = _tables()
+    j = a.join_right(b, a.k == b.k).select(b.k, a.va, b.vb)
+    got = sorted(run_to_rows(j), key=repr)
+    assert (4, None, "b4") in got
+    assert len(got) == 5
+
+
+def test_outer_join_both_sides():
+    pw.G.clear()
+    a, b = _tables()
+    j = a.join_outer(b, a.k == b.k).select(va=a.va, vb=b.vb)
+    got = sorted(run_to_rows(j), key=repr)
+    assert (None, "b4") in got
+    assert ("a1", None) in got
+    assert len(got) == 6
+
+
+def test_join_how_kwarg_matches_methods():
+    from pathway_tpu.internals.joins import JoinKind
+
+    pw.G.clear()
+    a, b = _tables()
+    via_kw = sorted(
+        run_to_rows(
+            a.join(b, a.k == b.k, how=JoinKind.LEFT).select(a.k, b.vb)
+        ),
+        key=repr,
+    )
+    pw.G.clear()
+    a, b = _tables()
+    via_method = sorted(
+        run_to_rows(a.join_left(b, a.k == b.k).select(a.k, b.vb)), key=repr
+    )
+    assert via_kw == via_method
+
+
+def test_join_null_keys_never_match():
+    pw.G.clear()
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, v=str), [(1, "x"), (None, "n1")]
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, w=str), [(1, "y"), (None, "n2")]
+    )
+    j = a.join(b, a.k == b.k).select(a.v, b.w)
+    assert sorted(run_to_rows(j)) == [("x", "y")]  # SQL semantics: no NULL match
+    # outer keeps the null rows unmatched on their own sides
+    jo = a.join_outer(b, a.k == b.k).select(a.v, b.w)
+    got = sorted(run_to_rows(jo), key=repr)
+    assert ("n1", None) in got and (None, "n2") in got
+
+
+def test_multi_condition_join():
+    pw.G.clear()
+    a = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, g=str, v=int), [(1, "x", 10), (1, "y", 20)]
+    )
+    b = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, g=str, w=int), [(1, "x", 100), (1, "z", 200)]
+    )
+    j = a.join(b, a.k == b.k, a.g == b.g).select(a.g, a.v, b.w)
+    assert run_to_rows(j) == [("x", 10, 100)]
+
+
+def test_self_join_with_copy():
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(k=int, v=int), [(1, 10), (2, 20)]
+    )
+    u = t.copy()
+    j = t.join(u, t.k == u.k).select(t.k, left_v=t.v, right_v=u.v)
+    assert sorted(run_to_rows(j)) == [(1, 10, 10), (2, 20, 20)]
+
+
+def test_markdown_leading_empty_cell_parses_as_null():
+    """'  | n1' in bare style means an empty first cell, not a shifted
+    row (the old strip('|') swallowed the leading empty field)."""
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    k | v
+    1 | x
+      | n1
+    """
+    )
+    assert sorted(run_to_rows(t.select(t.k, t.v)), key=repr) == sorted(
+        [(1, "x"), (None, "n1")], key=repr
+    )
+    # outer-pipe style rows behave identically
+    u = pw.debug.table_from_markdown(
+        """
+    | k | v  |
+    | 1 | x  |
+    |   | n1 |
+    """
+    )
+    assert sorted(run_to_rows(u.select(u.k, u.v)), key=repr) == sorted(
+        [(1, "x"), (None, "n1")], key=repr
+    )
