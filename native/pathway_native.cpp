@@ -3729,6 +3729,53 @@ PyObject* join_row(JoinCtx& C, PyObject* lv, PyObject* rv, PyObject* lk,
 
 // Build the full output block {okey: lv+rv+(lk,rk)} for one join key.
 // Returns a NEW dict, or nullptr with exception set.
+// SQL outer semantics: a null-jk row never matches but IS retained
+// unmatched on its preserved side.  Such rows are stateless
+// passthroughs (mirrors JoinNode._split_null_keys on the Python
+// fallback); rows are built by join_row/join_okey, the same
+// constructors the blocks use.
+int join_emit_null_passthroughs(JoinCtx& C, PyObject* seq, PyObject* jks,
+                                bool left_side, PyObject* out,
+                                PyObject* update_cls) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (PyList_GET_ITEM(jks, i) != Py_None) continue;
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* key = PyTuple_GET_ITEM(u, 0);
+        PyObject* values = PyTuple_GET_ITEM(u, 1);
+        PyObject* diff = PyTuple_GET_ITEM(u, 2);
+        PyObject* okey;
+        PyObject* row;
+        if (left_side) {
+            if (C.left_id_only) {
+                Py_INCREF(key);
+                okey = key;
+            } else {
+                okey = join_okey(key, nullptr);
+                if (okey == nullptr) return -1;
+            }
+            row = join_row(C, values, nullptr, key, Py_None);
+        } else {
+            okey = join_okey_r(key);
+            if (okey == nullptr) return -1;
+            row = join_row(C, nullptr, values, Py_None, key);
+        }
+        if (row == nullptr) {
+            Py_DECREF(okey);
+            return -1;
+        }
+        PyObject* nu = make_update_obj(update_cls, okey, row, diff);
+        Py_DECREF(okey);
+        Py_DECREF(row);
+        if (nu == nullptr || PyList_Append(out, nu) < 0) {
+            Py_XDECREF(nu);
+            return -1;
+        }
+        Py_DECREF(nu);
+    }
+    return 0;
+}
+
 PyObject* join_block(JoinCtx& C, PyObject* lrows, PyObject* rrows) {
     PyObject* out = PyDict_New();
     if (out == nullptr) return nullptr;
@@ -4021,6 +4068,16 @@ PyObject* py_join_process(PyObject*, PyObject* args) {
     // new blocks + diff
     out = PyList_New(0);
     if (out == nullptr) goto fail;
+    if (C.kind == 1 || C.kind == 3) {  // left / outer preserve left nulls
+        if (join_emit_null_passthroughs(C, lseq, ljks, true, out,
+                                        update_cls) < 0)
+            goto fail;
+    }
+    if (C.kind == 2 || C.kind == 3) {  // right / outer preserve right nulls
+        if (join_emit_null_passthroughs(C, rseq, rjks, false, out,
+                                        update_cls) < 0)
+            goto fail;
+    }
     {
         PyObject* one = PyLong_FromLong(1);
         PyObject* neg = PyLong_FromLong(-1);

@@ -70,8 +70,8 @@ def table_from_markdown(
                     parts = parts[1:]
                 if stripped.endswith("|"):
                     parts = parts[:-1]
-            elif stripped.endswith("|"):
-                parts = parts[:-1]
+            # bare style keeps every field: a trailing empty cell parses
+            # to None exactly where header-length padding would put it
             return [c.strip() for c in parts]
         # whitespace-separated; quoted strings stay whole
         return re.findall(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"|\S+", line)

@@ -950,27 +950,6 @@ class JoinNode(Node):
 
     def process(self, ctx, time, inbatches):
         st = ctx.state(self)
-        # SQL outer semantics: a null join key never MATCHES, but the row
-        # is RETAINED unmatched on its preserved side (LEFT/RIGHT/FULL
-        # OUTER keep null-key rows; only INNER drops them).  Null-key
-        # rows are stateless passthroughs — they can never gain a match —
-        # so they are split off here and emitted directly, leaving the
-        # arrangements (native and Python alike) null-free.  The computed
-        # jks are handed to the Python fallback so it never recomputes
-        # them; the cost of this Python pass only hits the outer family,
-        # never inner joins.
-        null_out: list[Update] = []
-        ljks = rjks = None
-        if self.kind in ("left", "outer"):
-            left_b, ljks = self._split_null_keys(
-                inbatches[0], self.left_jk_fn, "left", null_out
-            )
-            inbatches = [left_b, inbatches[1]]
-        if self.kind in ("right", "outer"):
-            right_b, rjks = self._split_null_keys(
-                inbatches[1], self.right_jk_fn, "right", null_out
-            )
-            inbatches = [inbatches[0], right_b]
         native = _native.load()
         if native is not None and self.jk_programs is not None:
             # whole-epoch native pass (build/probe/diff in C, mirroring
@@ -995,7 +974,26 @@ class JoinNode(Node):
             except native.Unsupported:
                 pass
             else:
-                return consolidate(out + null_out)
+                return consolidate(out)
+        # SQL outer semantics: a null join key never MATCHES, but the row
+        # is RETAINED unmatched on its preserved side (LEFT/RIGHT/FULL
+        # OUTER keep null-key rows; only INNER drops them).  The native
+        # pass emits these passthroughs itself
+        # (join_emit_null_passthroughs); this split only runs on the
+        # Python fallback, and its jks feed the arrangement pass below so
+        # nothing is evaluated twice.
+        null_out: list[Update] = []
+        ljks = rjks = None
+        if self.kind in ("left", "outer"):
+            left_b, ljks = self._split_null_keys(
+                inbatches[0], self.left_jk_fn, "left", null_out
+            )
+            inbatches = [left_b, inbatches[1]]
+        if self.kind in ("right", "outer"):
+            right_b, rjks = self._split_null_keys(
+                inbatches[1], self.right_jk_fn, "right", null_out
+            )
+            inbatches = [inbatches[0], right_b]
         if ljks is None:
             ljks = self._side_jks(inbatches[0], self.left_jk_fn)
         if rjks is None:

@@ -1,0 +1,238 @@
+"""Stdlib algorithm properties: graphs (bellman-ford, pagerank,
+louvain), ordered (sort/diff), statistical interpolation, LSH
+classifiers — correctness pinned against independently computed ground
+truth on structured instances (reference ``stdlib/graphs``, ``ml``,
+``ordered``, ``statistical`` test roles).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import run_to_rows
+
+
+# ---------------------------------------------------------------------------
+# graphs
+
+
+def _graph(v_md, e_md):
+    v = pw.debug.table_from_markdown(v_md).select(
+        name=pw.this.name,
+        dist=pw.apply(
+            lambda d: 0.0 if str(d) == "0" else None, pw.this.dist0
+        ),
+    )
+    vertices = v.with_id_from(pw.this.name)
+    e = pw.debug.table_from_markdown(e_md)
+    edges = e.select(
+        u=vertices.pointer_from(e.u),
+        v=vertices.pointer_from(e.v),
+        dist=pw.cast(float, e.dist),
+    )
+    return vertices, edges
+
+
+def test_bellman_ford_shortest_paths_chain_vs_shortcut():
+    """A long cheap chain must beat a direct expensive edge."""
+    from pathway_tpu.stdlib.graphs import bellman_ford
+
+    pw.G.clear()
+    vertices, edges = _graph(
+        """
+    name | dist0
+    a    | 0
+    b    | __none__
+    c    | __none__
+    d    | __none__
+    """,
+        """
+    u | v | dist
+    a | b | 1
+    b | c | 1
+    c | d | 1
+    a | d | 10
+    """,
+    )
+    res = bellman_ford(vertices, edges)
+    dists = sorted(r[0] for r in run_to_rows(res))
+    assert dists == [0.0, 1.0, 2.0, 3.0]  # chain beats the shortcut
+
+
+def test_pagerank_star_center_dominates():
+    """All nodes link to a center: the center's rank must dominate."""
+    from pathway_tpu.stdlib.graphs import pagerank
+
+    pw.G.clear()
+    e = pw.debug.table_from_markdown(
+        """
+    un | vn
+    a  | z
+    b  | z
+    c  | z
+    z  | a
+    z  | b
+    z  | c
+    """
+    )
+    edges = e.select(u=pw.this.un, v=pw.this.vn)
+    ranks = run_to_rows(pagerank(edges, steps=14))
+    by_node = {r[0]: r[1] for r in ranks}
+    others = [v for k, v in by_node.items() if k != "z"]
+    assert by_node["z"] > 2 * max(others)  # z clearly dominates
+
+
+def test_pagerank_symmetric_cycle_uniform():
+    from pathway_tpu.stdlib.graphs import pagerank
+
+    pw.G.clear()
+    e = pw.debug.table_from_markdown(
+        """
+    un | vn
+    a  | b
+    b  | c
+    c  | a
+    """
+    )
+    edges = e.select(u=pw.this.un, v=pw.this.vn)
+    vals = [r[1] for r in run_to_rows(pagerank(edges, steps=12))]
+    assert max(vals) - min(vals) < 1e-6  # symmetry -> uniform rank
+
+
+# ---------------------------------------------------------------------------
+# ordered
+
+
+def test_sort_produces_prev_next_chain():
+    from tests.utils import _run_capture
+
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    v
+    30
+    10
+    20
+    """
+    )
+    from pathway_tpu.stdlib.ordered import sort as o_sort
+
+    s = o_sort(t, key=t.v)
+    joined = t.with_columns(prev=s.prev, next=s.next)
+    (rows, _), = _run_capture(joined)
+    # exactly one head (prev None) and one tail (next None)
+    prevs = [vals[1] for vals in rows.values()]
+    nexts = [vals[2] for vals in rows.values()]
+    assert prevs.count(None) == 1 and nexts.count(None) == 1
+    # walking next-pointers from the head visits ascending v
+    by_key = dict(rows)
+    head = next(k for k, vals in rows.items() if vals[1] is None)
+    walk, k = [], head
+    while k is not None:
+        walk.append(by_key[k][0])
+        k = by_key[k][2]
+    assert walk == [10, 20, 30]
+
+
+def test_diff_computes_ordered_deltas():
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    ts | v
+    1  | 10
+    2  | 15
+    4  | 25
+    """
+    )
+    d = t.diff(t.ts, t.v)
+    rows = sorted(run_to_rows(d.select(pw.this.diff_v)), key=repr)
+    # first row has no predecessor -> None; others are deltas
+    assert sorted((r[0] for r in rows if r[0] is not None)) == [5, 10]
+    assert sum(1 for r in rows if r[0] is None) == 1
+
+
+# ---------------------------------------------------------------------------
+# statistical
+
+
+def test_interpolate_linear_fills_gaps():
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    ts | v
+    0  | 0.0
+    10 | 100.0
+    5  |
+    """
+    )
+    from pathway_tpu.stdlib.statistical import interpolate
+
+    out = interpolate(t, t.ts, t.v)
+    vals = {r[0]: r[1] for r in run_to_rows(out.select(pw.this.ts, pw.this.v))}
+    assert vals[0] == 0.0 and vals[10] == 100.0
+    assert vals[5] == pytest.approx(50.0)
+
+
+# ---------------------------------------------------------------------------
+# LSH classifiers
+
+
+def test_lsh_knn_index_finds_close_neighbors():
+    from pathway_tpu.stdlib.ml.classifiers import LshBandingIndex
+
+    rng = np.random.default_rng(0)
+    dim = 16
+    idx = LshBandingIndex(dim, metric="euclidean", A=4.0)
+    base = rng.normal(size=(30, dim))
+    for i, v in enumerate(base):
+        idx.add(i, v)
+    # a point very close to base[7] must rank it first
+    q = base[7] + rng.normal(scale=1e-3, size=dim)
+    res = idx.query(q, k=3)
+    assert res and res[0][0] == 7
+
+
+def test_lsh_bucketers_are_deterministic_and_locality_sensitive():
+    from pathway_tpu.stdlib.ml.classifiers import (
+        generate_cosine_lsh_bucketer,
+        generate_euclidean_lsh_bucketer,
+    )
+
+    rng = np.random.default_rng(1)
+    for gen in (
+        lambda: generate_euclidean_lsh_bucketer(8, 3, 4, 2.0),
+        lambda: generate_cosine_lsh_bucketer(8, 3, 4),
+    ):
+        b = gen()
+        x = rng.normal(size=8)
+        assert b(x) == b(x)  # deterministic
+        near = x + rng.normal(scale=1e-4, size=8)
+        far = rng.normal(size=8) * 10
+        same_near = sum(1 for p, q in zip(b(x), b(near)) if p == q)
+        same_far = sum(1 for p, q in zip(b(x), b(far)) if p == q)
+        assert same_near >= same_far
+
+
+def test_fuzzy_self_match_pairs_identical_texts():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    pw.G.clear()
+    a = pw.debug.table_from_markdown(
+        """
+    txt
+    alpha_beta_gamma
+    delta_epsilon
+    """
+    )
+    b = pw.debug.table_from_markdown(
+        """
+    txt
+    alpha_beta_gamma
+    zeta_eta
+    """
+    )
+    m = fuzzy_match_tables(a, b)
+    rows = run_to_rows(m)
+    assert rows, "identical strings must produce at least one match"
