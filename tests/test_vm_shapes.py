@@ -1,0 +1,226 @@
+"""Expression-VM program shapes: deep and adversarial compositions of
+the lazy constructs (if_else/coalesce/fill_error/require), tuple/get
+chains, pointer expressions and namespace methods — each compared
+against the pure-Python closure over the same rows (the op-level
+differential matrix lives in test_expr_vm.py; this file covers the
+COMPOSITIONS the lowering's jump patching must get right).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.stream import Update
+from pathway_tpu.internals import api
+from pathway_tpu.internals import expr_vm
+from pathway_tpu.internals import expression as ex
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import native as _native
+
+
+@pytest.fixture(scope="module")
+def native():
+    mod = _native.load()
+    if mod is None or not hasattr(mod, "vm_compile"):
+        pytest.skip("native VM unavailable")
+    return mod
+
+
+class _T:
+    pass
+
+
+class _Layout:
+    _POS = {"x": 0, "y": 1, "z": 2}
+
+    def resolver(self, ref):
+        if ref._name == "id":
+            return lambda kv: kv[0]
+        pos = self._POS[ref._name]
+        return lambda kv, pos=pos: kv[1][pos]
+
+    def resolve_pos(self, ref):
+        if ref._name == "id":
+            return -1
+        return self._POS[ref._name]
+
+
+_TBL = _T()
+X = ex.ColumnReference(_TBL, "x")
+Y = ex.ColumnReference(_TBL, "y")
+Z = ex.ColumnReference(_TBL, "z")
+L = _Layout()
+E = api.ERROR
+
+ROWS = [
+    (1, 2, 3),
+    (0, 0, 0),
+    (-5, 7, 2),
+    (None, 4, 1),
+    (E, 4, 1),
+    (10, None, None),
+    ("s", 1, 2),
+]
+
+
+def _assert_parity(native, exprs, rows=ROWS):
+    batch = [Update(K.Pointer(i + 1), r, 1) for i, r in enumerate(rows)]
+    progs = expr_vm.lower_programs(list(exprs), L)
+    assert progs is not None
+    out = native.vm_eval_batch(batch, progs, Update, api.ERROR, lambda e: None)
+    closures = [e._compile(L.resolver) for e in exprs]
+    for u_in, u_out in zip(batch, out):
+        expected = []
+        any_raised = False
+        for c in closures:
+            try:
+                expected.append(c((u_in.key, u_in.values)))
+            except Exception:
+                expected.append(api.ERROR)
+                any_raised = True
+        got = [repr(g) for g in u_out.values]
+        if got == [repr(v) for v in expected]:
+            continue
+        # a ROW-level VM failure collapses the whole row to (ERROR,)
+        # (rowwise_map contract) — accept it iff a closure raised too
+        assert any_raised and got == [repr(api.ERROR)], (
+            u_in.values,
+            got,
+            expected,
+        )
+
+
+def test_nested_if_else_pyramid(native):
+    e = pw.if_else(
+        X > 0,
+        pw.if_else(Y > 0, X + Y, pw.if_else(Z > 0, X + Z, X)),
+        pw.if_else(Y > 0, Y - X, 0),
+    )
+    _assert_parity(native, [e])
+
+
+def test_if_else_branches_are_lazy(native):
+    """The untaken branch must not evaluate: the false arm divides by
+    zero, which would poison rows where the condition is true."""
+    e = pw.if_else(Z != 0, X // pw.if_else(Z != 0, Z, 1), X // Z)
+    _assert_parity(native, [e])
+
+
+def test_deep_coalesce_chain(native):
+    e = pw.coalesce(
+        pw.coalesce(X, Y),
+        pw.coalesce(Y, Z),
+        pw.if_else(Z.is_none(), 0, Z),
+        -1,
+    )
+    _assert_parity(native, [e])
+
+
+def test_fill_error_over_nested_failure(native):
+    e = pw.fill_error(X // Z + pw.fill_error(Y // Z, 100), -7)
+    _assert_parity(native, [e])
+
+
+def test_require_guards_composition(native):
+    # require embedded in arithmetic: the None short-circuit's jump must
+    # land so the addition still sees one value on the stack
+    e = pw.require(X * 10, X, Y) + pw.coalesce(Y, 0)
+    _assert_parity(native, [e])
+
+
+def test_make_tuple_get_roundtrip(native):
+    t = pw.make_tuple(X, Y, Z)
+    _assert_parity(native, [t.get(0, default=-1), t.get(7, default=-1)])
+
+
+def test_mixed_methods_and_lazy_ops(native):
+    rows = [
+        ("  Alpha  ", "x", 1),
+        ("", "y", 2),
+        (None, "z", 3),
+        (E, "w", 4),
+    ]
+    e = pw.if_else(
+        X.is_none(),
+        "missing",
+        pw.coalesce(X, "").str.strip().str.lower(),
+    )
+    _assert_parity(native, [e], rows)
+
+
+def test_pointer_expression_inside_branches(native):
+    e = pw.if_else(Y > 2, _TBL_pointer(X, Y), _TBL_pointer(Y))
+    _assert_parity(native, [e])
+
+
+def _TBL_pointer(*args):
+    return ex.PointerExpression(_TBL, *[ex._wrap(a) for a in args])
+
+
+def test_many_columns_one_program_each(native):
+    exprs = [
+        X + Y,
+        pw.if_else(X > Y, X, Y),
+        pw.coalesce(X, Y, Z, 0),
+        pw.fill_error(X * Y, -1),
+        pw.make_tuple(X, pw.if_else(Y.is_none(), 0, Y)),
+    ]
+    _assert_parity(native, exprs)
+
+
+def test_stack_depth_stress(native):
+    """A deeply right-nested arithmetic chain exercises the stack-depth
+    validator (every intermediate stays live)."""
+    e = X
+    for i in range(30):
+        e = e + pw.if_else(Y > i, 1, 0)
+    _assert_parity(native, [e])
+
+
+def test_end_to_end_matches_python_disable(native, tmp_path):
+    """Whole pipeline through pw.run twice: native VM on vs off."""
+    import json
+    import subprocess
+    import sys
+    import textwrap
+
+    prog = tmp_path / "p.py"
+    prog.write_text(
+        textwrap.dedent(
+            """
+            import json, os, sys
+            sys.path.insert(0, %r)
+            import pathway_tpu as pw
+            from tests.utils import run_to_rows
+
+            t = pw.debug.table_from_rows(
+                pw.schema_from_types(a=int, b=int),
+                [(i, (i * 7) %% 13) for i in range(500)],
+            )
+            out = t.select(
+                q=pw.if_else(t.b != 0, t.a // t.b, -1),
+                r=pw.coalesce(t.a, 0) * 2,
+                s=pw.fill_error(t.a // (t.b - 6), 999),
+            )
+            print(json.dumps(sorted(run_to_rows(out))))
+            """
+        )
+        % "/root/repo"
+    )
+    import os
+
+    env_on = dict(os.environ, JAX_PLATFORMS="cpu")
+    env_off = dict(env_on, PATHWAY_DISABLE_NATIVE="1")
+    a = subprocess.run(
+        [sys.executable, str(prog)], env=env_on, capture_output=True, text=True,
+        cwd="/root/repo",
+    )
+    b = subprocess.run(
+        [sys.executable, str(prog)], env=env_off, capture_output=True, text=True,
+        cwd="/root/repo",
+    )
+    assert a.returncode == 0 and b.returncode == 0, (a.stderr, b.stderr)
+    assert json.loads(a.stdout.splitlines()[-1]) == json.loads(
+        b.stdout.splitlines()[-1]
+    )
