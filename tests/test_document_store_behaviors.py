@@ -1,0 +1,166 @@
+"""DocumentStore pipeline behaviors: splitters, post-processors,
+metadata merge, retrieval filters, statistics/inputs endpoints, and
+live updates through the index (reference ``document_store.py`` +
+``tests/unit/test_document_store.py`` roles).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.models import MINILM_L6
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.embedders import TPUEncoderEmbedder
+from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter, null_splitter
+from tests.utils import run_to_rows
+
+import jax.numpy as jnp
+
+TINY = dataclasses.replace(
+    MINILM_L6, layers=2, hidden=64, heads=4, mlp_dim=128, dtype=jnp.float32
+)
+
+
+@pytest.fixture(scope="module")
+def embedder():
+    return TPUEncoderEmbedder(config=TINY)
+
+
+def _docs(rows):
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(data=bytes, _metadata=dict),
+        rows,
+    )
+
+
+def _store(docs, embedder, **kwargs):
+    return DocumentStore(
+        docs,
+        retriever_factory=BruteForceKnnFactory(
+            embedder=embedder, reserved_space=64
+        ),
+        **kwargs,
+    )
+
+
+def _retrieve(store, query, k=2, metadata_filter=None, glob=None):
+    queries = pw.debug.table_from_rows(
+        pw.schema_from_types(
+            query=str, k=int, metadata_filter=str, filepath_globpattern=str
+        ),
+        [(query, k, metadata_filter, glob)],
+    )
+    out = store.retrieve_query(queries)
+    rows = run_to_rows(out.select(out.result))
+    return rows[0][0] if rows else []
+
+
+def test_token_count_splitter_respects_bounds():
+    sp = TokenCountSplitter(min_tokens=5, max_tokens=10)
+    text = "word " * 60
+    chunks = sp.__wrapped__(text)
+    assert len(chunks) >= 5
+    for chunk_text, _meta in chunks:
+        n = len(chunk_text.split())
+        assert 1 <= n <= 10
+
+
+def test_null_splitter_passthrough():
+    out = null_splitter("whole doc stays intact")
+    assert out == [("whole doc stays intact", {})]
+
+
+def test_store_statistics_and_inputs(embedder):
+    pw.G.clear()
+    docs = _docs(
+        [
+            (b"apples grow on trees", {"path": "/a/fruit.txt", "modified_at": 5}),
+            (b"the tpu multiplies matrices", {"path": "/b/tpu.txt", "modified_at": 9}),
+        ]
+    )
+    store = _store(docs, embedder)
+    stats_q = pw.debug.table_from_rows(pw.schema_from_types(q=int), [(0,)])
+    stats = run_to_rows(store.statistics_query(stats_q.select()))
+    assert stats and stats[0][0]["file_count"] == 2
+    inputs_q = pw.debug.table_from_rows(
+        pw.schema_from_types(metadata_filter=str, filepath_globpattern=str),
+        [(None, "*.txt")],
+    )
+    inputs = run_to_rows(store.inputs_query(inputs_q))
+    paths = {d["path"] for d in inputs[0][0]}
+    assert paths == {"/a/fruit.txt", "/b/tpu.txt"}
+
+
+def test_retrieval_glob_and_metadata_filters(embedder):
+    pw.G.clear()
+    docs = _docs(
+        [
+            (b"apples and oranges in the orchard", {"path": "/a/fruit.txt", "modified_at": 5}),
+            (b"apples compile matrix kernels", {"path": "/b/tpu.md", "modified_at": 9}),
+        ]
+    )
+    store = _store(docs, embedder)
+    all_hits = _retrieve(store, "apples", k=5)
+    assert len(all_hits) == 2
+    txt_only = _retrieve(store, "apples", k=5, glob="*.txt")
+    assert [d["metadata"]["path"] for d in txt_only] == ["/a/fruit.txt"]
+    newer = _retrieve(
+        store, "apples", k=5, metadata_filter="modified_at > `7`"
+    )
+    assert [d["metadata"]["path"] for d in newer] == ["/b/tpu.md"]
+
+
+def test_doc_post_processors_rewrite_text(embedder):
+    pw.G.clear()
+    docs = _docs([(b"MIXED case Document", {"path": "/x.txt"})])
+
+    def lower_all(text: str, metadata: dict):
+        return text.lower(), {**metadata, "post": True}
+
+    store = _store(docs, embedder, doc_post_processors=[lower_all])
+    hits = _retrieve(store, "mixed case document", k=1)
+    assert hits and hits[0]["text"] == "mixed case document"
+    assert hits[0]["metadata"]["post"] is True
+
+
+def test_splitter_chunks_searchable_individually(embedder):
+    """A long doc split into chunks: retrieval returns the RELEVANT
+    chunk, with the source path in every chunk's metadata."""
+    pw.G.clear()
+    part_a = "quantum chromodynamics lattice simulation " * 3
+    part_b = "sourdough bread fermentation starter " * 3
+    docs = _docs([((part_a + part_b).encode(), {"path": "/long.txt"})])
+    store = _store(
+        docs,
+        embedder,
+        splitter=TokenCountSplitter(min_tokens=3, max_tokens=12),
+    )
+    hits = _retrieve(store, "sourdough fermentation", k=1)
+    assert hits and "sourdough" in hits[0]["text"]
+    assert hits[0]["metadata"]["path"] == "/long.txt"
+
+
+def test_parser_errors_do_not_abort_store(embedder):
+    """A document whose parser raises lands in the error flow; the other
+    documents still index (per-row containment)."""
+    pw.G.clear()
+    docs = _docs(
+        [
+            (b"good document about apples", {"path": "/good.txt"}),
+            (b"\x00\x01broken", {"path": "/bad.bin"}),
+        ]
+    )
+
+    class PickyParser(pw.udfs.UDF):
+        def __wrapped__(self, data, **kw):
+            if b"\x00" in data:
+                raise ValueError("unparseable")
+            return [(data.decode(), {})]
+
+    store = _store(docs, embedder, parser=PickyParser())
+    hits = _retrieve(store, "apples", k=5)
+    assert [d["metadata"]["path"] for d in hits] == ["/good.txt"]
