@@ -1,0 +1,211 @@
+"""CLI spawn env contract, demo stream generators, and the temporal
+behavior matrix (delay/cutoff/keep_results combinations) — reference
+``cli.py`` spawn, ``demo/__init__.py`` generators, and
+``stdlib/temporal/temporal_behavior.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.temporal import (
+    common_behavior,
+    exactly_once_behavior,
+    tumbling,
+)
+from tests.utils import run_to_rows
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_spawn_sets_env_contract(tmp_path, capfd):
+    """``pathway spawn --processes N --threads M`` launches N copies with
+    the PATHWAY_* env contract (reference spawn/spawn-from-env)."""
+    prog = tmp_path / "p.py"
+    prog.write_text(
+        textwrap.dedent(
+            """
+            import json, os
+            print(json.dumps({
+                "pid": os.environ.get("PATHWAY_PROCESS_ID"),
+                "procs": os.environ.get("PATHWAY_PROCESSES"),
+                "threads": os.environ.get("PATHWAY_THREADS"),
+                "port": os.environ.get("PATHWAY_FIRST_PORT"),
+            }))
+            """
+        )
+    )
+    from pathway_tpu.cli import main
+
+    rc = main(
+        [
+            "spawn",
+            "--processes",
+            "2",
+            "--threads",
+            "3",
+            sys.executable,
+            str(prog),
+        ]
+    )
+    assert rc == 0
+    import json
+
+    captured = capfd.readouterr().out  # child stdout arrives at fd level
+    lines = [
+        json.loads(line)
+        for line in captured.splitlines()
+        if line.strip().startswith("{")
+    ]
+    assert len(lines) == 2
+    assert {rec["pid"] for rec in lines} == {"0", "1"}
+    assert all(rec["procs"] == "2" and rec["threads"] == "3" for rec in lines)
+    assert len({rec["port"] for rec in lines}) == 1  # shared first port
+
+
+def test_cli_rejects_unknown_command():
+    from pathway_tpu.cli import main
+
+    with pytest.raises(BaseException):  # argparse: SystemExit/ArgumentError
+        main(["no-such-command"])
+
+
+# ---------------------------------------------------------------------------
+# demo generators
+
+
+def test_demo_range_stream_values():
+    pw.G.clear()
+    t = pw.demo.range_stream(nb_rows=5, input_rate=1000)
+    vals = sorted(r[0] for r in run_to_rows(t))
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_demo_noisy_linear_stream_shape():
+    pw.G.clear()
+    t = pw.demo.noisy_linear_stream(nb_rows=6, input_rate=1000)
+    rows = run_to_rows(t)
+    assert len(rows) == 6
+    xs = sorted(r[0] for r in rows)
+    assert xs == [0, 1, 2, 3, 4, 5]
+
+
+def test_demo_replay_csv(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("a,b\n1,x\n2,y\n")
+
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    pw.G.clear()
+    t = pw.demo.replay_csv(str(p), schema=S, input_rate=1000)
+    assert sorted(run_to_rows(t)) == [(1, "x"), (2, "y")]
+
+
+def test_demo_generate_custom_stream():
+    pw.G.clear()
+    t = pw.demo.generate_custom_stream(
+        value_generators={"n": lambda i: i * 10},
+        schema=pw.schema_from_types(n=int),
+        nb_rows=4,
+        input_rate=1000,
+    )
+    assert sorted(run_to_rows(t)) == [(0,), (10,), (20,), (30,)]
+
+
+# ---------------------------------------------------------------------------
+# temporal behaviors
+
+
+def _timed(rows_md: str):
+    return pw.debug.table_from_markdown(rows_md)
+
+
+def _window_with_behavior(behavior):
+    t = _timed(
+        """
+    t  | v | __time__ | __diff__
+    1  | 1 | 2        | 1
+    3  | 2 | 2        | 1
+    11 | 4 | 4        | 1
+    2  | 8 | 6        | 1
+    """
+    )
+    w = t.windowby(
+        t.t, window=tumbling(duration=10), behavior=behavior
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    return w.select(w.start, w.s)
+
+
+def test_behavior_none_keeps_late_updates():
+    pw.G.clear()
+    out = _window_with_behavior(None)
+    rows = dict(run_to_rows(out))
+    # the late t=2 row (arriving after t=11 advanced time) still lands
+    assert rows[0] == 11 and rows[10] == 4
+
+
+def test_behavior_cutoff_drops_late_rows():
+    """common_behavior(cutoff=...): a window whose close time has passed
+    the event-time watermark by cutoff ignores further updates."""
+    pw.G.clear()
+    out = _window_with_behavior(common_behavior(cutoff=0))
+    rows = dict(run_to_rows(out))
+    # the late t=2 arrival (watermark already at 11 > window end 10)
+    # is dropped: the first window keeps only its on-time rows
+    assert rows[0] == 3 and rows[10] == 4
+
+
+def test_behavior_keep_results_false_forgets_closed_windows():
+    pw.G.clear()
+    out = _window_with_behavior(
+        common_behavior(cutoff=0, keep_results=False)
+    )
+    rows = dict(run_to_rows(out))
+    # closed windows vanish from the output; only the live window stays
+    assert 0 not in rows and rows[10] == 4
+
+
+def test_exactly_once_behavior_emits_single_version():
+    """exactly_once: each window flushes once at close — no incremental
+    revisions reach the output stream."""
+    pw.G.clear()
+    t = _timed(
+        """
+    t  | v | __time__ | __diff__
+    1  | 1 | 2        | 1
+    2  | 2 | 4        | 1
+    11 | 4 | 6        | 1
+    21 | 8 | 8        | 1
+    """
+    )
+    out = t.windowby(
+        t.t, window=tumbling(duration=10), behavior=exactly_once_behavior()
+    ).reduce(
+        start=pw.this._pw_window_start,
+        s=pw.reducers.sum(pw.this.v),
+    )
+    history: list = []
+    pw.io.subscribe(
+        out,
+        on_change=lambda k, row, tm, add: history.append(
+            (row["start"], add, row["s"])
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    # window [0,10) emitted exactly once, with the final sum, no retraction
+    w0 = [h for h in history if h[0] == 0]
+    assert w0 == [(0, True, 3)]
