@@ -1,0 +1,189 @@
+"""AsyncTransformer option matrix (retries, caching, failure routing)
+and pw.iterate fixed points with limits/universe changes (reference
+``stdlib/utils/async_transformer.py`` ``:282+`` and ``pw.iterate``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import udfs
+from tests.utils import T, run_to_rows
+
+
+class _Doubler(pw.AsyncTransformer):
+    output_schema = pw.schema_from_types(doubled=int)
+
+    async def invoke(self, a: int) -> dict:
+        await asyncio.sleep(0)
+        return {"doubled": a * 2}
+
+
+def test_async_transformer_successful_results():
+    pw.G.clear()
+    t = T(
+        """
+        a
+        1
+        2
+        3
+        """
+    )
+    out = _Doubler(t).successful
+    assert sorted(run_to_rows(out)) == [(2,), (4,), (6,)]
+
+
+def test_async_transformer_failures_route_to_failed_table():
+    pw.G.clear()
+    t = T(
+        """
+        a
+        1
+        0
+        3
+        """
+    )
+
+    class Picky(pw.AsyncTransformer):
+        output_schema = pw.schema_from_types(inv=float)
+
+        async def invoke(self, a: int) -> dict:
+            return {"inv": 1.0 / a}  # a=0 raises
+
+    tr = Picky(t)
+    ok = sorted(run_to_rows(tr.successful))
+    assert ok == [(1.0 / 3,), (1.0,)]
+    pw.G.clear()
+    t = T(
+        """
+        a
+        1
+        0
+        """
+    )
+    tr = Picky(t)
+    # one run, both outputs captured (the transformer's host-side queue
+    # drains once per run; a second pw.run would see no input)
+    cap_failed = tr.failed._capture_node()
+    cap_ok = tr.successful._capture_node()
+    ctx = pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert len(ctx.state(cap_failed)["rows"]) == 1  # the a=0 row
+    ok_vals = [v[0] for v in ctx.state(cap_ok)["rows"].values()]
+    assert ok_vals == [1.0]
+
+
+def test_async_transformer_with_retries_recovers():
+    pw.G.clear()
+    attempts: dict[int, int] = {}
+    t = T(
+        """
+        a
+        5
+        6
+        """
+    )
+
+    class Flaky(pw.AsyncTransformer):
+        output_schema = pw.schema_from_types(v=int)
+
+        async def invoke(self, a: int) -> dict:
+            attempts[a] = attempts.get(a, 0) + 1
+            if attempts[a] == 1:
+                raise ValueError("first try fails")
+            return {"v": a * 10}
+
+    tr = Flaky(t).with_options(
+        retry_strategy=udfs.FixedDelayRetryStrategy(max_retries=3, delay_ms=1)
+    )
+    assert sorted(run_to_rows(tr.successful)) == [(50,), (60,)]
+    assert all(n >= 2 for n in attempts.values())
+
+
+def test_async_transformer_cache_dedupes_equal_rows():
+    pw.G.clear()
+    calls: list[int] = []
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(a=int), [(7,), (7,), (8,)]
+    )
+
+    class Tracked(pw.AsyncTransformer):
+        output_schema = pw.schema_from_types(v=int)
+
+        async def invoke(self, a: int) -> dict:
+            calls.append(a)
+            return {"v": a + 1}
+
+    tr = Tracked(t).with_options(cache_strategy=udfs.InMemoryCache())
+    assert sorted(run_to_rows(tr.successful)) == [(8,), (8,), (9,)]
+    assert sorted(calls) == [7, 8]
+
+
+# ---------------------------------------------------------------------------
+# iterate
+
+
+def test_iterate_collatz_reaches_one():
+    """Classic fixed point: every row iterates its Collatz sequence to 1."""
+    pw.G.clear()
+    t = T(
+        """
+        n
+        6
+        7
+        27
+        """
+    )
+
+    def step(state: pw.Table) -> pw.Table:
+        return state.select(
+            n=pw.if_else(
+                state.n == 1,
+                1,
+                pw.if_else(
+                    state.n % 2 == 0, state.n // 2, 3 * state.n + 1
+                ),
+            )
+        )
+
+    out = pw.iterate(step, state=t.select(n=t.n))
+    assert sorted(run_to_rows(out)) == [(1,), (1,), (1,)]
+
+
+def test_iterate_limit_stops_early():
+    pw.G.clear()
+    t = T(
+        """
+        n
+        0
+        """
+    )
+
+    def inc(state: pw.Table) -> pw.Table:
+        return state.select(n=state.n + 1)
+
+    out = pw.iterate(inc, iteration_limit=5, state=t.select(n=t.n))
+    assert run_to_rows(out) == [(5,)]
+
+
+def test_iterate_multi_table_fixed_point():
+    """Two coupled tables: propagate the max value to every row."""
+    pw.G.clear()
+    t = T(
+        """
+        g | v
+        x | 1
+        x | 9
+        x | 4
+        """
+    )
+
+    def spread(state: pw.Table) -> pw.Table:
+        m = state.groupby(state.g).reduce(state.g, mx=pw.reducers.max(state.v))
+        j = state.join(m, state.g == m.g)
+        return j.select(state.g, v=pw.right.mx)
+
+    out = pw.iterate(spread, state=t.select(t.g, t.v))
+    assert sorted(run_to_rows(out)) == [("x", 9), ("x", 9), ("x", 9)]
