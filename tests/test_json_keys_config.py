@@ -1,0 +1,142 @@
+"""Json value semantics, 128-bit key/pointer API, and env config
+refresh — reference ``internals/json.py``, ``src/engine/value.rs`` Key,
+and the PATHWAY_* env contract in ``internals/config.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals.json import Json
+from tests.utils import T, run_to_rows
+
+
+# ---------------------------------------------------------------------------
+# Json
+
+
+def test_json_wrapping_and_access():
+    j = Json({"a": {"b": [1, 2, 3]}, "s": "x", "f": 2.5, "t": True})
+    assert j["a"]["b"][1].as_int() == 2
+    assert j["s"].as_str() == "x"
+    assert j["f"].as_float() == 2.5
+    assert j["t"].as_bool() is True
+    assert j.get("missing", default="d") == "d"
+
+
+def test_json_equality_and_hash():
+    a = Json({"x": [1, {"y": 2}]})
+    b = Json({"x": [1, {"y": 2}]})
+    c = Json({"x": [1, {"y": 3}]})
+    assert a == b and hash(a) == hash(b)
+    assert a != c
+
+
+def test_json_through_pipeline_and_vm():
+    """Json cells flow through select/get; the VM's OP_GET handles them
+    natively (internals/expr_vm.py)."""
+    pw.G.clear()
+    rows = [(Json({"user": {"name": "ada", "score": 7}}),)]
+    t = pw.debug.table_from_rows(pw.schema_from_types(j=object), rows)
+    out = t.select(
+        name=t.j.get("user").get("name"),
+        score=t.j.get("user").get("score"),
+        missing=t.j.get("nope", default="fallback"),
+    )
+    (r,) = run_to_rows(out)
+    name, score, missing = r
+    assert str(name).strip('"') == "ada" or name == "ada"
+    assert (score.as_int() if isinstance(score, Json) else score) == 7
+    assert (
+        missing == "fallback"
+        or (isinstance(missing, Json) and missing.value == "fallback")
+    )
+
+
+def test_json_falsiness():
+    assert not Json(None) and not Json({}) and not Json([]) and not Json(0)
+    assert Json({"a": 1}) and Json([0]) and Json("x")
+
+
+# ---------------------------------------------------------------------------
+# keys / pointers
+
+
+def test_ref_scalar_stable_and_type_tagged():
+    assert K.ref_scalar(1, "a") == K.ref_scalar(1, "a")
+    # type tagging: the INT 1 and the STRING "1" hash differently
+    assert K.ref_scalar(1) != K.ref_scalar("1")
+    assert K.ref_scalar(True) != K.ref_scalar(1)
+    # 128-bit range
+    assert 0 <= int(K.ref_scalar("x")) < 2**128
+
+
+def test_pointer_repr_and_value():
+    p = K.ref_scalar("row")
+    assert isinstance(p, K.Pointer)
+    assert p.value == int(p)
+    assert str(p).startswith("^")
+
+
+def test_keys_for_values_batch_matches_scalar():
+    args = [(1, "a"), (2, "b"), (3, "c")]
+    batch = K.keys_for_values(args)
+    assert list(batch) == [K.ref_scalar(*a) for a in args]
+
+
+def test_pointer_from_in_pipeline_matches_row_ids():
+    pw.G.clear()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    t = pw.debug.table_from_rows(S, [(1, "x"), (2, "y")])
+    withptr = t.select(t.v, p=t.pointer_from(t.k))
+    from tests.utils import _run_capture
+
+    ((rows, _),) = _run_capture(withptr)
+    for key, (v, p) in rows.items():
+        assert key == p  # pointer_from(pk) reproduces the row id
+
+
+def test_sequential_keys_distinct():
+    ks = {K.sequential_key(i) for i in range(100)}
+    assert len(ks) == 100
+
+
+# ---------------------------------------------------------------------------
+# config
+
+
+def test_config_env_refresh(monkeypatch):
+    from pathway_tpu.internals.config import pathway_config
+
+    monkeypatch.setenv("PATHWAY_THREADS", "3")
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "1")
+    monkeypatch.setenv("PATHWAY_FIRST_PORT", "12345")
+    pathway_config.refresh()
+    try:
+        assert pathway_config.threads == 3
+        assert pathway_config.processes == 2
+        assert pathway_config.process_id == 1
+        assert pathway_config.first_port == 12345
+        assert pathway_config.total_workers == 6
+    finally:
+        monkeypatch.undo()
+        pathway_config.refresh()
+
+
+def test_config_bad_env_values_fall_back(monkeypatch):
+    from pathway_tpu.internals.config import pathway_config
+
+    monkeypatch.setenv("PATHWAY_THREADS", "not-a-number")
+    pathway_config.refresh()
+    try:
+        assert pathway_config.threads >= 1  # default, not a crash
+    finally:
+        monkeypatch.undo()
+        pathway_config.refresh()
