@@ -1,0 +1,182 @@
+"""Table API semantics: this/left/right resolution, column renaming and
+slices, with_id_from reindexing, with_universe_of, cast_to_types, ix
+contexts, and TableSlice operations — reference ``Table`` surface
+(``python/pathway/internals/table.py`` role).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+from tests.utils import T, run_to_rows
+
+
+def _t():
+    return pw.debug.table_from_rows(
+        pw.schema_from_types(a=int, b=str, c=float),
+        [(1, "x", 0.5), (2, "y", 1.5)],
+    )
+
+
+def test_pw_this_resolves_to_context_table():
+    pw.G.clear()
+    t = _t()
+    out = t.select(doubled=pw.this.a * 2, label=pw.this.b)
+    assert sorted(run_to_rows(out)) == [(2, "x"), (4, "y")]
+
+
+def test_rename_kwargs_and_dict():
+    pw.G.clear()
+    t = _t()
+    r1 = t.rename(alpha="a")
+    assert "alpha" in r1.column_names() and "a" not in r1.column_names()
+    assert sorted(run_to_rows(r1.select(r1.alpha))) == [(1,), (2,)]
+    pw.G.clear()
+    t = _t()
+    r2 = t.rename_by_dict({"a": "first", "b": "second"})
+    assert r2.column_names()[:2] == ["first", "second"]
+
+
+def test_without_drops_columns():
+    pw.G.clear()
+    t = _t()
+    w = t.without("b", "c")
+    assert w.column_names() == ["a"]
+    assert sorted(run_to_rows(w)) == [(1,), (2,)]
+
+
+def test_slice_without_rename_compose():
+    pw.G.clear()
+    t = _t()
+    sl = t.slice.without("c").rename({"a": "k"})
+    # passing the SLICE ITSELF keeps its renames (splatting loses them:
+    # bare refs only carry their original name)
+    out = t.select(sl)
+    assert out.column_names() == ["k", "b"]
+    assert sorted(run_to_rows(out)) == [(1, "x"), (2, "y")]
+
+
+def test_cast_to_types_changes_dtype_and_value():
+    pw.G.clear()
+    t = _t()
+    c = t.cast_to_types(a=float)
+    assert c._dtypes["a"] == dt.FLOAT
+    rows = sorted(run_to_rows(c.select(c.a)))
+    assert rows == [(1.0,), (2.0,)]
+    assert all(isinstance(r[0], float) for r in rows)
+
+
+def test_with_id_from_reindexes_deterministically():
+    pw.G.clear()
+    t = _t()
+    keyed = t.with_id_from(t.b)
+    from tests.utils import _run_capture
+
+    ((rows, _),) = _run_capture(keyed)
+    from pathway_tpu.internals import keys as K
+
+    assert set(rows) == {K.ref_scalar("x"), K.ref_scalar("y")}
+
+
+def test_with_universe_of_aligns_keys():
+    pw.G.clear()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    a = pw.debug.table_from_rows(S, [(1, "x"), (2, "y")])
+
+    class S2(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        w: int
+
+    b = pw.debug.table_from_rows(S2, [(1, 10), (2, 20)])
+    joined_cols = a.with_universe_of(b)
+    # same universe: columns combine positionally by key
+    both = joined_cols.select(joined_cols.v, w=b.w)
+    assert sorted(run_to_rows(both)) == [("x", 10), ("y", 20)]
+
+
+def test_ix_looks_up_rows_by_pointer():
+    pw.G.clear()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    target = pw.debug.table_from_rows(S, [(1, "one"), (2, "two")])
+    reqs = pw.debug.table_from_rows(
+        pw.schema_from_types(want=int), [(2,), (1,)]
+    )
+    ptrs = reqs.select(p=target.pointer_from(reqs.want))
+    looked = target.ix(ptrs.p, context=ptrs)
+    out = ptrs.select(v=looked.v)
+    assert sorted(run_to_rows(out)) == [("one",), ("two",)]
+
+
+def test_ix_null_pointer_and_dangling_pointer():
+    from pathway_tpu.internals import api
+
+    pw.G.clear()
+
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    target = pw.debug.table_from_rows(S, [(1, "one")])
+    # a NULL pointer with optional=True resolves to None values; a
+    # DANGLING pointer (valid hash, no such row) is an ERROR in strict
+    # mode — the lookup contract
+    reqs = pw.debug.table_from_rows(pw.schema_from_types(want=int), [(1,), (None,)])
+    ptrs = reqs.select(
+        p=target.pointer_from(reqs.want, optional=True)
+    )
+    looked = target.ix(ptrs.p, optional=True, context=ptrs)
+    out = ptrs.select(v=looked.v)
+    assert sorted(run_to_rows(out), key=repr) == sorted(
+        [("one",), (None,)], key=repr
+    )
+    pw.G.clear()
+    target = pw.debug.table_from_rows(S, [(1, "one")])
+    reqs = pw.debug.table_from_rows(pw.schema_from_types(want=int), [(99,)])
+    ptrs = reqs.select(p=target.pointer_from(reqs.want))
+    looked = target.ix(ptrs.p, context=ptrs)
+    ((dangling,),) = run_to_rows(ptrs.select(v=looked.v))
+    assert dangling is api.ERROR
+
+
+def test_concat_requires_same_columns():
+    pw.G.clear()
+    a = _t()
+    b = pw.debug.table_from_rows(pw.schema_from_types(z=int), [(1,)])
+    with pytest.raises(Exception):
+        a.concat_reindex(b)
+
+
+def test_select_star_and_override():
+    pw.G.clear()
+    t = _t()
+    out = t.select(*t, a=t.a * 100)  # star then override one column
+    # the override WINS and takes the later position (last-wins order)
+    assert out.column_names() == ["b", "c", "a"]
+    rows = sorted(run_to_rows(out))
+    assert rows == [("x", 0.5, 100), ("y", 1.5, 200)]
+
+
+def test_groupby_set_id_groups_under_group_key():
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(g=str, v=int), [("x", 1), ("x", 2), ("y", 5)]
+    )
+    # id=: the group value BECOMES the row key (set_id contract — the
+    # reference requires a pointer-typed value; this engine keys on the
+    # value directly)
+    red = t.groupby(t.g, id=t.g).reduce(t.g, s=pw.reducers.sum(t.v))
+    from tests.utils import _run_capture
+
+    ((rows, _),) = _run_capture(red)
+    assert set(rows) == {"x", "y"}
+    assert {v[1] for v in rows.values()} == {3, 5}
