@@ -1,0 +1,143 @@
+"""REST ingress details: concurrent requests, schema defaults through
+HTTP, OpenAPI docs endpoint, 404s, and serve_callable under concurrent
+load (reference ``io/http`` webserver + ``servers.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+import pathway_tpu as pw
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(url, payload, timeout=10.0):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _start_scheduler():
+    from pathway_tpu.engine.scheduler import Scheduler
+    from pathway_tpu.internals.parse_graph import G
+
+    sched = Scheduler(G.engine_graph, autocommit_ms=20)
+    run_t = threading.Thread(target=sched.run, daemon=True)
+    run_t.start()
+    return sched, run_t
+
+
+def _wait_server(base, route, payload, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return _post(base + route, payload, timeout=5.0)
+        except Exception:
+            time.sleep(0.2)
+    raise TimeoutError(f"server at {base}{route} did not come up")
+
+
+def test_rest_connector_concurrent_queries_and_docs():
+    from pathway_tpu.io.http import PathwayWebserver, rest_connector
+
+    pw.G.clear()
+    port = _free_port()
+    ws = PathwayWebserver(host="127.0.0.1", port=port)
+
+    class S(pw.Schema):
+        x: int
+        y: int = pw.column_definition(default_value=10)
+
+    queries, writer = rest_connector(webserver=ws, route="/add", schema=S)
+    writer(queries.select(result=queries.x + queries.y))
+    sched, run_t = _start_scheduler()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        first = _wait_server(base, "/add", {"x": 1})
+        # schema default applies when y is omitted; the response body IS
+        # the result column's value
+        assert first == 11
+        # concurrent posts all answer correctly
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            futs = [
+                pool.submit(_post, base + "/add", {"x": i, "y": i * 2})
+                for i in range(16)
+            ]
+            results = [f.result() for f in futs]
+        assert sorted(results) == sorted(3 * i for i in range(16))
+        # OpenAPI description served
+        docs = json.loads(
+            urllib.request.urlopen(f"{base}/_schema", timeout=5).read()
+        )
+        assert isinstance(docs, dict)
+        # unknown route -> 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base + "/no-such-route", {})
+        assert e.value.code == 404
+    finally:
+        sched.stop()
+        run_t.join(timeout=3)
+
+
+def test_serve_callable_concurrent_and_error_path():
+    from pathway_tpu.xpacks.llm.servers import BaseRestServer
+
+    pw.G.clear()
+    port = _free_port()
+    server = BaseRestServer(host="127.0.0.1", port=port)
+
+    class S(pw.Schema):
+        text: str
+
+    def transform(text: str) -> str:
+        if text == "boom":
+            raise ValueError("handler failure")
+        return text[::-1]
+
+    server.serve_callable("/v1/reverse", S, transform)
+    sched, run_t = _start_scheduler()
+    try:
+        base = f"http://127.0.0.1:{port}"
+        first = _wait_server(base, "/v1/reverse", {"text": "abc"})
+        assert first == "cba"
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [
+                pool.submit(
+                    _post, base + "/v1/reverse", {"text": f"word{i}"}
+                )
+                for i in range(8)
+            ]
+            out = [f.result() for f in futs]
+        assert sorted(out) == sorted(f"word{i}"[::-1] for i in range(8))
+        # a raising handler must not kill the server; subsequent
+        # requests still answer
+        try:
+            _post(base + "/v1/reverse", {"text": "boom"})
+        except urllib.error.HTTPError:
+            pass  # error response acceptable
+        except TimeoutError:
+            pass
+        again = _post(base + "/v1/reverse", {"text": "xyz"})
+        assert again == "zyx"
+    finally:
+        sched.stop()
+        run_t.join(timeout=3)
