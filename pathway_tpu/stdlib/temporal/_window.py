@@ -96,7 +96,7 @@ class IntervalsOverWindow(Window):
     at: Any  # ColumnReference with the probe time points
     lower_bound: Any
     upper_bound: Any
-    is_outer: bool = False
+    is_outer: bool = True  # match the intervals_over() factory default
 
 
 def tumbling(duration: Any, origin: Any = None, offset: Any = None) -> TumblingWindow:
@@ -300,11 +300,19 @@ def windowby(
     return WindowedTable(assigned, inst_e)
 
 
-def _apply_behavior(assigned: Table, behavior: Behavior, time_fn) -> Table:
+def _apply_behavior(
+    assigned: Table, behavior: Behavior, time_fn, window_end_offset: Any = 0
+) -> Table:
+    """``window_end_offset`` shifts where a window CLOSES relative to its
+    tuple's end field: intervals_over windows store the probe point p in
+    both slots while their data band extends to p + upper_bound — the
+    cutoff/shift must anchor at the band end, or in-band rows past the
+    probe freeze their own window (late-row loss)."""
     widx = assigned._column_names.index("_pw_window")
+    off = window_end_offset
 
     if isinstance(behavior, ExactlyOnceBehavior):
-        shift = behavior.shift or 0
+        shift = (behavior.shift or 0) + off
         # exactly-once: buffer the whole window, release at close + shift,
         # then freeze (late rows dropped); results kept
         thr_fn = lambda k, v, s=shift: v[widx][2] + s  # noqa: E731
@@ -328,7 +336,9 @@ def _apply_behavior(assigned: Table, behavior: Behavior, time_fn) -> Table:
         (lambda k, v, d=delay: v[widx][1] + d) if delay is not None else None
     )
     exp_fn = (
-        (lambda k, v, c=cutoff: v[widx][2] + c) if cutoff is not None else None
+        (lambda k, v, c=cutoff + off: v[widx][2] + c)
+        if cutoff is not None
+        else None
     )
     node = TemporalBehaviorNode(
         G.engine_graph,
@@ -374,11 +384,22 @@ def _intervals_over_windowby(table, tc, ic, window: IntervalsOverWindow, behavio
                     st["probes"].pop(u.key, None)
             # recompute full assignment (dirty-all; probe sets are small)
             new_out: dict = {}
+            matched: set = set()
             for dk, (values, t, inst) in st["data"].items():
                 for pk, (p, _) in st["probes"].items():
                     if p + window.lower_bound <= t <= p + window.upper_bound:
                         okey = K.derive(dk, "iv", int(pk))
                         new_out[okey] = values + ((inst, p, p),)
+                        matched.add(pk)
+            if window.is_outer:
+                # outer: a probe with no data in its band still yields a
+                # window — one placeholder row of Nones (reference
+                # intervals_over is_outer)
+                n_data_cols = len(table._column_names)
+                for pk, (p, _) in st["probes"].items():
+                    if pk not in matched:
+                        okey = K.derive(pk, "iv_outer")
+                        new_out[okey] = (None,) * n_data_cols + ((None, p, p),)
             out = []
             for okey, row in new_out.items():
                 if st["out"].get(okey) != row:
@@ -398,4 +419,16 @@ def _intervals_over_windowby(table, tc, ic, window: IntervalsOverWindow, behavio
         {**table._dtypes, "_pw_window": dt.ANY},
         name="intervals_over",
     )
+    if behavior is not None:
+        # behaviors act on the data rows' TRUE event time, like the
+        # fixed-window paths; the window tuple stores the probe point p
+        # in both slots, so closing anchors at the BAND end
+        # p + upper_bound via the offset (placeholder outer rows carry
+        # time None and pass through untouched by the watermark)
+        assigned = _apply_behavior(
+            assigned,
+            behavior,
+            lambda k, v: tc((k, v)),
+            window_end_offset=window.upper_bound,
+        )
     return WindowedTable(assigned, None)

@@ -132,10 +132,8 @@ def test_serve_callable_concurrent_and_error_path():
         # requests still answer
         try:
             _post(base + "/v1/reverse", {"text": "boom"})
-        except urllib.error.HTTPError:
-            pass  # error response acceptable
-        except TimeoutError:
-            pass
+        except (urllib.error.URLError, OSError):
+            pass  # error response or timeout both acceptable
         again = _post(base + "/v1/reverse", {"text": "xyz"})
         assert again == "zyx"
     finally:
