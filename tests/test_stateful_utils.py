@@ -1,0 +1,136 @@
+"""Stateful deduplicate acceptor semantics, per-instance isolation under
+streaming, and the stdlib col utilities (unpack_col, apply_all_rows) —
+reference ``stdlib/stateful/deduplicate.py`` + ``stdlib/utils/col.py``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, run_to_rows
+
+
+def test_deduplicate_acceptor_keeps_increasing_values():
+    """Classic monotone acceptor: only strictly greater values replace
+    the held row; everything else is suppressed."""
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    v  | __time__ | __diff__
+    3  | 2        | 1
+    1  | 4        | 1
+    7  | 6        | 1
+    5  | 8        | 1
+    """
+    )
+    d = t.deduplicate(
+        value=pw.this.v, acceptor=lambda new, old: old is None or new > old
+    )
+    history: list = []
+    pw.io.subscribe(
+        d, on_change=lambda k, row, tm, add: history.append((add, row["v"]))
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    # accepted sequence: 3 then 7 (1 and 5 rejected); retractions pair up
+    accepted = [v for add, v in history if add]
+    assert accepted == [3, 7]
+    final = [v for add, v in history if add][-1]
+    assert final == 7
+
+
+def test_deduplicate_per_instance_streams_independently():
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    g | v | __time__ | __diff__
+    a | 1 | 2        | 1
+    b | 9 | 2        | 1
+    a | 5 | 4        | 1
+    b | 2 | 4        | 1
+    """
+    )
+    d = t.deduplicate(
+        value=pw.this.v,
+        instance=pw.this.g,
+        acceptor=lambda new, old: old is None or new > old,
+    )
+    rows = sorted(run_to_rows(d.select(pw.this.g, pw.this.v)))
+    # instance a accepted 1 then 5; instance b accepted 9, rejected 2
+    assert rows == [("a", 5), ("b", 9)]
+
+
+def test_deduplicate_acceptor_exception_contained():
+    pw.G.clear()
+    t = T(
+        """
+    v
+    1
+    2
+    """
+    )
+
+    def explosive(new, old):
+        if new == 2:
+            raise RuntimeError("acceptor exploded")
+        return old is None
+
+    d = t.deduplicate(value=pw.this.v, acceptor=explosive)
+    err = pw.global_error_log()
+    cap_d = d._capture_node()
+    cap_e = err._capture_node()
+    ctx = pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    # the run survives; the error is logged; the held row remains
+    assert any("acceptor" in v[0] for v in ctx.state(cap_e)["rows"].values())
+    held = [v[0] for v in ctx.state(cap_d)["rows"].values()]
+    assert held == [1]
+
+
+def test_unpack_col_expands_tuples():
+    from pathway_tpu.stdlib.utils.col import unpack_col
+
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(packed=tuple),
+        [((1, "x"),), ((2, "y"),)],
+    )
+    out = unpack_col(t.packed, "num", "label")
+    assert out.column_names() == ["num", "label"]
+    assert sorted(run_to_rows(out)) == [(1, "x"), (2, "y")]
+
+
+def test_apply_all_rows_sees_whole_column():
+    from pathway_tpu.stdlib.utils.col import apply_all_rows
+
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(v=int), [(1,), (2,), (3,)]
+    )
+
+    def normalize(vs):
+        total = sum(vs)
+        return [v / total for v in vs]
+
+    out = apply_all_rows(t.v, fun=normalize, result_col_name="share")
+    rows = sorted(r[-1] for r in run_to_rows(out))
+    assert rows == pytest.approx([1 / 6, 2 / 6, 3 / 6])
+
+
+def test_deduplicate_is_append_only():
+    """Deduplicate consumes ADDITIONS only (append-only source contract,
+    like the reference's persisted deduplicate): retracting the held row
+    upstream does not reopen the slot."""
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+    v | __time__ | __diff__
+    5 | 2        | 1
+    5 | 4        | -1
+    1 | 6        | 1
+    """
+    )
+    d = t.deduplicate(
+        value=pw.this.v, acceptor=lambda new, old: old is None or new > old
+    )
+    rows = [v[0] for v in run_to_rows(d.select(pw.this.v))]
+    assert rows == [5]  # the retraction is ignored; 1 < 5 rejected
