@@ -301,3 +301,91 @@ def test_concat_reindex_consistency(seed):
 
     streamed, batch = _run_both_pair(build_pair, ea, fa, eb, fb)
     assert streamed == batch
+
+
+# ---------------------------------------------------------------------------
+# composite pipelines: multiple stateful stages chained
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_three_stage_pipeline_consistency(seed):
+    """filter -> groupby -> join -> groupby: a retraction entering stage
+    one must cascade correctly through three stateful stages."""
+    rng = random.Random(700 + seed)
+    epochs, final = _random_history(rng, n_keys=10, n_epochs=12)
+
+    def build(t):
+        flt = t.filter(t.v % 3 != 0)
+        per_g = flt.groupby(flt.g).reduce(
+            flt.g, n=pw.reducers.count(), s=pw.reducers.sum(flt.v)
+        )
+        j = flt.join(per_g, flt.g == per_g.g)
+        enriched = j.select(flt.k, flt.g, share=flt.v * 100 // pw.right.s)
+        return enriched.groupby(enriched.g).reduce(
+            enriched.g, total_share=pw.reducers.sum(enriched.share)
+        )
+
+    streamed, batch = _run_both(build, epochs, final)
+    assert streamed == batch, (epochs, final)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_flatten_then_aggregate_consistency(seed):
+    rng = random.Random(800 + seed)
+    epochs, final = _random_history(rng, n_keys=8, n_epochs=10)
+
+    def build(t):
+        tup = t.select(t.g, parts=pw.apply(lambda v: tuple(range(v % 4)), t.v))
+        flat = tup.flatten(tup.parts)
+        return flat.groupby(flat.g).reduce(
+            flat.g, n=pw.reducers.count(), s=pw.reducers.sum(flat.parts)
+        )
+
+    streamed, batch = _run_both(build, epochs, final)
+    assert streamed == batch, (epochs, final)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_double_groupby_rollup_consistency(seed):
+    """Two-level rollup (g,v)->(g)->global with avg in the middle."""
+    rng = random.Random(900 + seed)
+    epochs, final = _random_history(rng, n_keys=10, n_epochs=10)
+
+    def build(t):
+        lvl1 = t.groupby(t.g, t.v).reduce(t.g, t.v, n=pw.reducers.count())
+        lvl2 = lvl1.groupby(lvl1.g).reduce(
+            lvl1.g,
+            distinct=pw.reducers.count(),
+            biggest=pw.reducers.max(lvl1.v),
+        )
+        total = lvl2.groupby().reduce(
+            groups=pw.reducers.count(),
+            overall_max=pw.reducers.max(lvl2.biggest),
+        )
+        return total
+
+    streamed, batch = _run_both(build, epochs, final)
+    assert streamed == batch, (epochs, final)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_deduplicate_downstream_of_aggregates(seed):
+    """Append-only deduplicate fed by a changing aggregate: accepted
+    values form a monotone sequence regardless of churn order."""
+    rng = random.Random(1000 + seed)
+    epochs, _final = _random_history(rng, n_keys=6, n_epochs=10)
+
+    pw.G.clear()
+    t = _stream_table(epochs)
+    agg = t.groupby().reduce(total=pw.reducers.sum(pw.this.v))
+    best = agg.deduplicate(
+        value=pw.this.total,
+        acceptor=lambda new, old: old is None or new > old,
+    )
+    history: list = []
+    pw.io.subscribe(
+        best, on_change=lambda k, row, tm, add: history.append((add, row["total"]))
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    accepted = [v for add, v in history if add]
+    assert accepted == sorted(set(accepted))  # strictly increasing record
