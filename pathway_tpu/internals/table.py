@@ -805,6 +805,25 @@ class Table:
         return reindexed[0].concat(*reindexed[1:])
 
     def update_rows(self, other: "Table") -> "Table":
+        """Per key, rows of ``other`` override rows of ``self``.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> a = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | x
+        ... 2  | y
+        ... ''')
+        >>> b = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 2  | z
+        ... ''')
+        >>> pw.debug.compute_and_print(a.update_rows(b), include_id=False)
+        v
+        'x'
+        'z'
+        """
         if other._column_names != self._column_names:
             other = other.select(**{c: other[c] for c in self._column_names})
         node = eg.UpdateRowsNode(G.engine_graph, self._node, other._node)
@@ -833,6 +852,24 @@ class Table:
         return self.update_cells(other)
 
     def intersect(self, *others: "Table") -> "Table":
+        """Keep rows whose keys appear in every other table.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> a = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | x
+        ... 2  | y
+        ... ''')
+        >>> b = pw.debug.table_from_markdown('''
+        ... id | w
+        ... 2  | q
+        ... ''')
+        >>> pw.debug.compute_and_print(a.intersect(b), include_id=False)
+        v
+        'y'
+        """
         node = eg.IntersectNode(
             G.engine_graph, self._node, [t._node for t in others]
         )
@@ -846,6 +883,24 @@ class Table:
         )
 
     def difference(self, other: "Table") -> "Table":
+        """Keep rows whose keys do NOT appear in ``other``.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> a = pw.debug.table_from_markdown('''
+        ... id | v
+        ... 1  | x
+        ... 2  | y
+        ... ''')
+        >>> b = pw.debug.table_from_markdown('''
+        ... id | w
+        ... 2  | q
+        ... ''')
+        >>> pw.debug.compute_and_print(a.difference(b), include_id=False)
+        v
+        'x'
+        """
         node = eg.SubtractNode(G.engine_graph, self._node, other._node)
         return Table(
             node,
@@ -1117,6 +1172,25 @@ class Table:
 
     # -- temporal (reference exposes these as Table methods too) -------------
     def windowby(self, time_expr: Any, *, window: Any, behavior: Any = None, instance: Any = None, shard: Any = None) -> Any:
+        """Assign rows to temporal windows; follow with ``.reduce(...)``.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... t  | v
+        ... 1  | 10
+        ... 3  | 20
+        ... 12 | 30
+        ... ''')
+        >>> w = t.windowby(t.t, window=pw.temporal.tumbling(duration=10)).reduce(
+        ...     start=pw.this._pw_window_start, s=pw.reducers.sum(pw.this.v)
+        ... )
+        >>> pw.debug.compute_and_print(w.select(w.start, w.s), include_id=False)
+        start | s
+        0     | 30
+        10    | 30
+        """
         from pathway_tpu.stdlib.temporal import windowby as _windowby
 
         return _windowby(self, time_expr, window=window, behavior=behavior, instance=instance, shard=shard)
@@ -1147,6 +1221,29 @@ class Table:
         return _f(self, other, self_time, other_time, interval, *on, **kw)
 
     def asof_join(self, other, self_time, other_time, *on, **kw):
+        """For each left row, the closest right row at or before its time.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> trades = pw.debug.table_from_markdown('''
+        ... t | px
+        ... 3 | 100
+        ... 7 | 105
+        ... ''')
+        >>> quotes = pw.debug.table_from_markdown('''
+        ... t | bid
+        ... 1 | 99
+        ... 5 | 103
+        ... ''')
+        >>> j = trades.asof_join(quotes, trades.t, quotes.t)
+        >>> pw.debug.compute_and_print(
+        ...     j.select(trades.px, quotes.bid), include_id=False
+        ... )
+        px  | bid
+        100 | 99
+        105 | 103
+        """
         from pathway_tpu.stdlib.temporal import asof_join as _f
 
         return _f(self, other, self_time, other_time, *on, **kw)
