@@ -125,6 +125,14 @@ def __getattr__(name: str) -> Any:
         import pathway_tpu.persistence as persistence
 
         return persistence
+    if name == "testing":
+        import pathway_tpu.testing as testing
+
+        return testing
+    if name == "ConnectorRecoveryPolicy":
+        from pathway_tpu.internals.resilience import ConnectorRecoveryPolicy
+
+        return ConnectorRecoveryPolicy
     if name == "universes":
         import pathway_tpu.universes as universes
 

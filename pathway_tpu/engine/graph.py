@@ -94,6 +94,11 @@ class RunContext:
         #: long streaming run would leak unboundedly
         self.error_pending: list[ErrorEntry] = []
         self.error_sink_enabled: bool = False
+        #: input node ids whose connector gave up under on_failure=
+        #: "degrade": downstream tables reflect only the rows delivered
+        #: before the failure (stale).  Filled by the connector
+        #: supervisor; surfaced through the monitoring snapshot.
+        self.stale_sources: set[int] = set()
 
     def state(self, node: "Node") -> Any:
         if node.id not in self.states:

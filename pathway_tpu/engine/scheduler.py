@@ -542,22 +542,16 @@ class Scheduler:
         threads: list[threading.Thread] = []
         wrappers: dict[int, Any] = {}
         for node in live_inputs:
-            with self._prober_lock:
-                # counter-key setdefaults inside ConnectorEvents must also
-                # happen under the lock: a concurrent snapshot's dict(s)
-                # copy would otherwise hit a resizing dict
-                cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
-                events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
-            if self.persistence is not None:
-                events = self.persistence.wrap_events(
-                    node, events, replayed_counts.get(node.id, 0)
+            threads.append(
+                self._spawn_supervised(
+                    node,
+                    node.subject,
+                    q,
+                    wrappers,
+                    replayed_counts.get(node.id, 0),
+                    self.ctx,
                 )
-                wrappers[node.id] = events
-            t_ = threading.Thread(
-                target=self._run_subject, args=(node, events), daemon=True
             )
-            t_.start()
-            threads.append(t_)
 
         # auxiliary inputs (loopbacks) never keep the run alive by
         # themselves: the run ends when all primaries closed AND every
@@ -748,22 +742,15 @@ class Scheduler:
         q: "queue.Queue" = queue.Queue()
         wrappers: dict[int, Any] = {}
         for node, subject in my_inputs:
-            with self._prober_lock:
-                # counter-key setdefaults inside ConnectorEvents must also
-                # happen under the lock: a concurrent snapshot's dict(s)
-                # copy would otherwise hit a resizing dict
-                cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
-                events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
-            if self.persistence is not None:
-                events = self.persistence.wrap_events(
-                    node, events, replayed_counts.get(node.id, 0), worker=w
-                )
-                wrappers[node.id] = events
-            threading.Thread(
-                target=self._run_subject_obj,
-                args=(node, subject, events),
-                daemon=True,
-            ).start()
+            self._spawn_supervised(
+                node,
+                subject,
+                q,
+                wrappers,
+                replayed_counts.get(node.id, 0),
+                ctx,
+                worker=w,
+            )
 
         my_primaries = {
             n.id for n, _s in my_inputs if not getattr(n, "auxiliary", False)
@@ -1000,22 +987,62 @@ class Scheduler:
             t += TIME_STEP
         return t, replayed_counts
 
-    @staticmethod
-    def _run_subject_obj(node: InputNode, subject: Any, events: ConnectorEvents) -> None:
-        try:
-            subject.run(events)
-        except Exception as e:  # reader errors must not hang the run
-            import logging
+    def _spawn_supervised(
+        self,
+        node: InputNode,
+        subject: Any,
+        q: "queue.Queue",
+        wrappers: dict[int, Any],
+        replayed: int,
+        ctx: Any,
+        worker: int = 0,
+    ) -> threading.Thread:
+        """Start the connector supervisor for one live input.  The reader
+        no longer dies permanently on the first exception: the supervisor
+        restarts it per ``node.recovery_policy`` (default: the historical
+        one-failure-drops-the-source behaviour), building a fresh events
+        chain per attempt that resumes past the data events the engine
+        already consumed."""
+        from pathway_tpu.internals.resilience import ConnectorSupervisor
 
-            logging.getLogger("pathway_tpu").error(
-                "connector %s failed: %r", node.name, e
-            )
-        finally:
-            events.close()
+        with self._prober_lock:
+            # counter-key setdefaults inside ConnectorEvents must happen
+            # under the lock: a concurrent snapshot's dict(s) copy would
+            # otherwise hit a resizing dict
+            cstats = self.connector_stats.setdefault(f"{node.name}#{node.id}", {})
 
-    @staticmethod
-    def _run_subject(node: InputNode, events: ConnectorEvents) -> None:
-        Scheduler._run_subject_obj(node, node.subject, events)
+        def make_events(resume: int) -> Any:
+            with self._prober_lock:
+                events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
+            if self.persistence is not None:
+                events = self.persistence.wrap_events(
+                    node, events, resume, worker=worker
+                )
+                # rebind, so snapshot force-commits hit the LIVE attempt's
+                # recording wrapper (key reassignment, never a dict resize)
+                wrappers[node.id] = events
+            return events
+
+        sup = ConnectorSupervisor(
+            node,
+            subject,
+            make_events,
+            getattr(node, "recovery_policy", None),
+            ctx=ctx,
+            stats=cstats,
+            stop_event=self._stop,
+            initial_resume=replayed,
+            skip_handled_by_events=(
+                # the persistence recording wrapper skips the resume
+                # prefix itself — but only for nodes it actually wraps
+                self.persistence is not None
+                and not self.persistence.replay_only
+                and not getattr(node, "auxiliary", False)
+                and self.persistence.persisted(node)
+            ),
+            stop_runner=self.stop,
+        )
+        return sup.start()
 
     def stop(self) -> None:
         self._stop.set()

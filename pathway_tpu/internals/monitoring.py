@@ -32,13 +32,26 @@ class ProberStats:
     latency_ms: float | None = None
     connectors: dict[str, dict] = field(default_factory=dict)
     operator_probes: dict[int, dict] = field(default_factory=dict)
+    #: resilience counters (connector.restarts/failures/breaker_open/
+    #: dlq_events) from the telemetry layer
+    resilience: dict[str, int] = field(default_factory=dict)
+    #: connector names whose source gave up under on_failure="degrade" —
+    #: their downstream tables are stale, not complete
+    stale_connectors: list[str] = field(default_factory=list)
 
 
 def collect_stats(sched: Any) -> ProberStats:
+    from pathway_tpu.internals.telemetry import get_telemetry
+
     ctx = sched.ctx
     # race-free copy: worker threads register connectors concurrently
     connectors = sched.snapshot_connector_stats()
     probes = {k: dict(v) for k, v in ctx.stats.get("operators", {}).items()}
+    resilience = {
+        name: v
+        for name, v in get_telemetry().snapshot_counters().items()
+        if name.startswith("connector.")
+    }
     return ProberStats(
         epoch=ctx.time,
         operators=len(sched.graph.nodes),
@@ -54,6 +67,10 @@ def collect_stats(sched: Any) -> ProberStats:
         ),
         connectors=connectors,
         operator_probes=probes,
+        resilience=resilience,
+        stale_connectors=sorted(
+            name for name, c in connectors.items() if c.get("stale")
+        ),
     )
 
 
@@ -80,15 +97,24 @@ def start_dashboard(
 
         if stats.connectors:
             ct = RichTable(title="connectors")
-            for col in ("input", "rows", "retractions", "commits", "state"):
+            for col in ("input", "rows", "retractions", "commits", "restarts", "state"):
                 ct.add_column(col)
             for name, c in sorted(stats.connectors.items()):
+                if c.get("stale"):
+                    state = "degraded"
+                elif c.get("state") in ("failed", "drop"):
+                    state = "failed"
+                elif c.get("closed"):
+                    state = "closed"
+                else:
+                    state = "live"
                 ct.add_row(
                     name,
                     str(c.get("rows", 0)),
                     str(c.get("retractions", 0)),
                     str(c.get("commits", 0)),
-                    "closed" if c.get("closed") else "live",
+                    str(c.get("restarts", 0)),
+                    state,
                 )
             parts.append(ct)
 

@@ -30,6 +30,9 @@ def _metrics_text(sched: Any) -> str:
     if connector_stats:
         lines.append("# TYPE pathway_tpu_connector_rows_total counter")
         lines.append("# TYPE pathway_tpu_connector_commits_total counter")
+        lines.append("# TYPE pathway_tpu_connector_restarts_total counter")
+        lines.append("# TYPE pathway_tpu_connector_failures_total counter")
+        lines.append("# TYPE pathway_tpu_connector_stale gauge")
         for name, c in sorted(connector_stats.items()):
             label = name.replace('"', "'")
             lines.append(
@@ -40,6 +43,25 @@ def _metrics_text(sched: Any) -> str:
                 f'pathway_tpu_connector_commits_total{{input="{label}"}} '
                 f"{c.get('commits', 0)}"
             )
+            lines.append(
+                f'pathway_tpu_connector_restarts_total{{input="{label}"}} '
+                f"{c.get('restarts', 0)}"
+            )
+            lines.append(
+                f'pathway_tpu_connector_failures_total{{input="{label}"}} '
+                f"{c.get('failures', 0)}"
+            )
+            lines.append(
+                f'pathway_tpu_connector_stale{{input="{label}"}} '
+                f"{1 if c.get('stale') else 0}"
+            )
+    # resilience counters (supervisor restarts, breaker trips, DLQ)
+    from pathway_tpu.internals.telemetry import get_telemetry
+
+    for name, v in sorted(get_telemetry().snapshot_counters().items()):
+        metric = "pathway_tpu_" + name.replace(".", "_") + "_total"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {v}")
     # per-operator probes (reference attach_prober, graph.rs:988-995)
     probes = ctx.stats.get("operators", {})
     if probes:

@@ -1,0 +1,505 @@
+"""Connector resilience: supervised restart, backoff, circuit breaking.
+
+The reference engine recovers from reader failures via persisted snapshots
+(``src/connectors/mod.rs`` ``Connector::run`` + rewind): a connector that
+dies is restarted and resumes from the last committed frontier.  This
+module provides that layer for the epoch-synchronous engine:
+
+- :class:`ConnectorRecoveryPolicy` — restart budget, exponential backoff
+  (shared with the UDF retry layer: the delay schedule IS an
+  :class:`~pathway_tpu.internals.udfs.ExponentialBackoffRetryStrategy`),
+  circuit breaker, watchdog timeout and an ``on_failure`` mode.
+- :class:`CircuitBreaker` — closed / open / half-open, so a source that
+  fails in a tight loop stops consuming restart budget until a cool-down
+  elapses.
+- :class:`ConnectorSupervisor` — runs ``RowSource.run(events)`` on a
+  reader thread, restarting per policy and resuming from the persistence
+  snapshot offset (already-delivered rows are skipped, never re-emitted).
+
+The scheduler spawns one supervisor per live input; a node opts in by
+carrying a ``recovery_policy`` attribute (``input_table(...,
+recovery_policy=...)``).  Nodes without a policy keep the historical
+behaviour: one failure, logged, stream closed (``DEFAULT_POLICY``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from pathway_tpu.internals.udfs import ExponentialBackoffRetryStrategy
+
+__all__ = [
+    "BreakerState",
+    "CircuitBreaker",
+    "ConnectorRecoveryPolicy",
+    "ConnectorSupervisor",
+    "DEFAULT_POLICY",
+    "WatchdogTimeout",
+]
+
+_logger = logging.getLogger("pathway_tpu.resilience")
+
+_ON_FAILURE_MODES = ("stop", "drop", "degrade")
+
+
+class WatchdogTimeout(Exception):
+    """A source made no progress within ``watchdog_timeout_s``."""
+
+
+class BreakerState:
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker (closed → open → half-open).
+
+    ``failure_threshold`` consecutive failures open the circuit; while
+    open, :meth:`allow` refuses further attempts until ``reset_after_s``
+    has elapsed, then exactly one probe attempt is allowed (half-open).
+    A success closes the circuit; a failure re-opens it and restarts the
+    cool-down.  ``clock`` is injectable so tests need not sleep."""
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        reset_after_s: float = 30.0,
+        clock: Callable[[], float] = _time.monotonic,
+    ):
+        self.failure_threshold = max(1, failure_threshold)
+        self.reset_after_s = reset_after_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = BreakerState.CLOSED
+        self._opened_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            if (
+                self._state == BreakerState.OPEN
+                and self._clock() - self._opened_at >= self.reset_after_s
+            ):
+                return BreakerState.HALF_OPEN
+            return self._state
+
+    def allow(self) -> bool:
+        """Whether the next attempt may proceed.  In the half-open window
+        this consumes the single probe slot (the breaker re-arms as OPEN
+        with a fresh cool-down until the probe reports back)."""
+        with self._lock:
+            if self._state == BreakerState.CLOSED:
+                return True
+            if self._state == BreakerState.HALF_OPEN:
+                return False  # a probe is already in flight
+            if self._clock() - self._opened_at >= self.reset_after_s:
+                self._state = BreakerState.HALF_OPEN
+                self._opened_at = self._clock()  # fresh cool-down if it fails
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._state = BreakerState.CLOSED
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if (
+                self._state == BreakerState.HALF_OPEN
+                or self._failures >= self.failure_threshold
+            ):
+                self._state = BreakerState.OPEN
+                self._opened_at = self._clock()
+
+
+@dataclass
+class ConnectorRecoveryPolicy:
+    """Restart policy for one connector (reference connector supervision).
+
+    ``on_failure`` decides what happens once the restart budget is spent
+    or the circuit breaker refuses further attempts:
+
+    - ``"stop"``: the failure is recorded and the whole run is stopped.
+    - ``"drop"``: the source's stream is closed; the run continues on the
+      data delivered so far (the historical behaviour).
+    - ``"degrade"``: like ``drop``, but the failure is routed into the
+      global error-log table and the source's outputs are marked stale
+      (``ctx.stale_sources`` + the connector's monitoring entry), so the
+      run finishes and the degradation is observable instead of silent.
+    """
+
+    max_restarts: int = 3
+    initial_delay_ms: int = 50
+    backoff_factor: float = 2.0
+    max_delay_ms: int | None = 10_000
+    jitter_ms: int = 50
+    full_jitter: bool = False
+    seed: int | None = None
+    #: no event (row/commit/close) for this long counts as a failure;
+    #: the stalled attempt is fenced off and restarted.  None disables.
+    watchdog_timeout_s: float | None = None
+    on_failure: str = "stop"
+    #: consecutive failures before the breaker opens; None disables the
+    #: breaker (budget alone governs restarts)
+    breaker_failure_threshold: int | None = None
+    breaker_reset_after_s: float = 30.0
+
+    def __post_init__(self) -> None:
+        if self.on_failure not in _ON_FAILURE_MODES:
+            raise ValueError(
+                f"on_failure must be one of {_ON_FAILURE_MODES}, "
+                f"got {self.on_failure!r}"
+            )
+
+    def backoff_strategy(self) -> ExponentialBackoffRetryStrategy:
+        """The delay schedule, as the SAME policy object the UDF retry
+        layer uses — one backoff implementation across the system."""
+        return ExponentialBackoffRetryStrategy(
+            max_retries=self.max_restarts,
+            initial_delay=self.initial_delay_ms,
+            backoff_factor=self.backoff_factor,
+            jitter_ms=self.jitter_ms,
+            max_delay_ms=self.max_delay_ms,
+            full_jitter=self.full_jitter,
+            seed=self.seed,
+        )
+
+    def make_breaker(
+        self, clock: Callable[[], float] = _time.monotonic
+    ) -> CircuitBreaker | None:
+        if self.breaker_failure_threshold is None:
+            return None
+        return CircuitBreaker(
+            failure_threshold=self.breaker_failure_threshold,
+            reset_after_s=self.breaker_reset_after_s,
+            clock=clock,
+        )
+
+
+#: nodes without an explicit policy: one failure, logged, stream closed —
+#: exactly the pre-supervisor behaviour, so existing pipelines see no
+#: change until they opt in
+DEFAULT_POLICY = ConnectorRecoveryPolicy(max_restarts=0, on_failure="drop")
+
+
+class _AttemptEvents:
+    """Per-attempt shim around the live events chain.
+
+    Tracks last-activity time (watchdog) and can be *fenced*: a stalled
+    attempt's thread cannot be killed, so instead its event sink is cut —
+    after :meth:`fence` nothing it emits reaches the engine, and
+    cooperative readers observe ``stopped`` and exit.  ``close`` from the
+    subject is recorded but NOT forwarded: the supervisor owns the single
+    end-of-stream close."""
+
+    def __init__(self, inner: Any):
+        self._inner = inner
+        self._fenced = False
+        self.closed_by_subject = False
+        self.last_activity = _time.monotonic()
+
+    @property
+    def stopped(self) -> bool:
+        return self._fenced or self._inner.stopped
+
+    @property
+    def resume_offset(self) -> int:
+        return getattr(self._inner, "resume_offset", 0)
+
+    def fence(self) -> None:
+        self._fenced = True
+
+    def add(self, key: Any, values: tuple) -> None:
+        if not self._fenced:
+            self.last_activity = _time.monotonic()
+            self._inner.add(key, values)
+
+    def add_many(self, rows: list) -> None:
+        if not self._fenced:
+            self.last_activity = _time.monotonic()
+            self._inner.add_many(rows)
+
+    def remove(self, key: Any, values: tuple) -> None:
+        if not self._fenced:
+            self.last_activity = _time.monotonic()
+            self._inner.remove(key, values)
+
+    def commit(self) -> None:
+        if not self._fenced:
+            self.last_activity = _time.monotonic()
+            self._inner.commit()
+
+    def close(self) -> None:
+        if not self._fenced:
+            self.closed_by_subject = True
+
+
+class _SkipEvents:
+    """Drop the first ``skip`` data events (and any commits inside that
+    prefix) before forwarding — the non-persistence analogue of
+    ``_RecordingEvents.resume_offset``: a restarted deterministic reader
+    re-emits its history and the prefix the engine already consumed must
+    not be delivered twice."""
+
+    def __init__(self, inner: Any, skip: int):
+        self._inner = inner
+        self.resume_offset = skip
+
+    @property
+    def stopped(self) -> bool:
+        return self._inner.stopped
+
+    def add(self, key: Any, values: tuple) -> None:
+        if self.resume_offset > 0:
+            self.resume_offset -= 1
+            return
+        self._inner.add(key, values)
+
+    def add_many(self, rows: list) -> None:
+        skip = min(self.resume_offset, len(rows))
+        if skip:
+            self.resume_offset -= skip
+            rows = rows[skip:]
+        if rows:
+            self._inner.add_many(rows)
+
+    def remove(self, key: Any, values: tuple) -> None:
+        if self.resume_offset > 0:
+            self.resume_offset -= 1
+            return
+        self._inner.remove(key, values)
+
+    def commit(self) -> None:
+        if self.resume_offset > 0:
+            return
+        self._inner.commit()
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class ConnectorSupervisor:
+    """Supervises one connector's reader thread.
+
+    Each attempt runs ``subject.run`` on a fresh daemon thread against a
+    fresh events chain built by ``make_events(resume)``, where ``resume``
+    is the number of data events the engine has already consumed from
+    this source (persistence-replayed prefix + rows delivered by earlier
+    attempts).  With persistence attached, ``make_events`` wraps the sink
+    in the recording layer whose ``resume_offset`` skips that prefix
+    without re-recording it; without persistence the supervisor inserts
+    :class:`_SkipEvents` for deterministic readers (or calls the reader's
+    ``on_persistence_resume`` hook).
+    """
+
+    def __init__(
+        self,
+        node: Any,
+        subject: Any,
+        make_events: Callable[[int], Any],
+        policy: ConnectorRecoveryPolicy | None,
+        *,
+        ctx: Any = None,
+        stats: dict | None = None,
+        stop_event: threading.Event | None = None,
+        initial_resume: int = 0,
+        skip_handled_by_events: bool = False,
+        stop_runner: Callable[[], None] | None = None,
+    ):
+        self.node = node
+        self.subject = subject
+        self.make_events = make_events
+        self.policy = policy if policy is not None else DEFAULT_POLICY
+        self.ctx = ctx
+        self.stats = stats if stats is not None else {}
+        self._stop_event = stop_event or threading.Event()
+        self._initial_resume = initial_resume
+        #: True when make_events already returns a chain that skips the
+        #: resume prefix itself (the persistence recording wrapper)
+        self._skip_handled = skip_handled_by_events
+        self._stop_runner = stop_runner
+        self._backoff = self.policy.backoff_strategy()
+        self._breaker = self.policy.make_breaker()
+        self.restarts = 0
+        self.stats.setdefault("restarts", 0)
+        self.stats.setdefault("failures", 0)
+
+    # ------------------------------------------------------------------
+    def start(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._supervise,
+            daemon=True,
+            name=f"pw_supervisor_{self.node.name}#{self.node.id}",
+        )
+        t.start()
+        return t
+
+    # ------------------------------------------------------------------
+    def _delivered(self) -> int:
+        """Data events this run has consumed from this source: the
+        replayed prefix plus everything the base events sink counted
+        (the stats dict is shared across attempts)."""
+        return (
+            self._initial_resume
+            + self.stats.get("rows", 0)
+            + self.stats.get("retractions", 0)
+        )
+
+    def _build_attempt(self, resume: int) -> _AttemptEvents:
+        events = self.make_events(resume)
+        if resume > 0 and not self._skip_handled:
+            if getattr(self.subject, "deterministic_replay", False):
+                events = _SkipEvents(events, resume)
+            else:
+                hook = getattr(self.subject, "on_persistence_resume", None)
+                if hook is not None:
+                    hook(resume)
+                else:
+                    _logger.warning(
+                        "restarting input %r after %d delivered events but "
+                        "its reader is not deterministically replayable and "
+                        "defines no on_persistence_resume(n) hook; "
+                        "re-delivered rows will be double-counted",
+                        self.node.name,
+                        resume,
+                    )
+        return _AttemptEvents(events)
+
+    def _run_attempt(self, att: _AttemptEvents) -> BaseException | None:
+        """Run one attempt; returns the failure (exception or watchdog
+        verdict) or None on clean completion."""
+        box: dict[str, BaseException] = {}
+
+        def body() -> None:
+            try:
+                self.subject.run(att)
+            except BaseException as e:  # noqa: BLE001 — reported to policy
+                box["exc"] = e
+
+        t = threading.Thread(
+            target=body,
+            daemon=True,
+            name=f"pw_reader_{self.node.name}#{self.node.id}",
+        )
+        t.start()
+        timeout = self.policy.watchdog_timeout_s
+        tick = 0.05 if timeout is None else min(0.05, timeout / 4.0)
+        while t.is_alive():
+            t.join(tick)
+            if self._stop_event.is_set():
+                # shutdown: the reader sees stopped=True and exits; give
+                # it a moment, then abandon it (daemon)
+                t.join(0.5)
+                return None
+            if (
+                timeout is not None
+                and t.is_alive()
+                and _time.monotonic() - att.last_activity > timeout
+            ):
+                att.fence()  # the zombie may never die; cut its sink
+                return WatchdogTimeout(
+                    f"source {self.node.name!r} made no progress for "
+                    f"{timeout}s"
+                )
+        return box.get("exc")
+
+    def _supervise(self) -> None:
+        from pathway_tpu.internals.telemetry import get_telemetry
+
+        telemetry = get_telemetry()
+        att: _AttemptEvents | None = None
+        attempt = 0
+        while True:
+            att = self._build_attempt(
+                self._delivered() if attempt else self._initial_resume
+            )
+            self.stats["state"] = "live"
+            failure = self._run_attempt(att)
+            if failure is None:
+                if self._breaker is not None:
+                    self._breaker.record_success()
+                break
+            self.stats["failures"] += 1
+            self.stats["last_error"] = repr(failure)
+            telemetry.counter("connector.failures")
+            if self._breaker is not None:
+                self._breaker.record_failure()
+                if self._breaker.state == BreakerState.OPEN:
+                    telemetry.counter("connector.breaker_open")
+            _logger.error(
+                "connector %s failed (attempt %d): %r",
+                self.node.name,
+                attempt + 1,
+                failure,
+            )
+            if self._stop_event.is_set():
+                break
+            can_restart = self.restarts < self.policy.max_restarts and (
+                self._breaker is None or self._breaker.allow()
+            )
+            if not can_restart:
+                self._give_up(failure)
+                break
+            delay = self._backoff.next_delay(self.restarts)
+            self.restarts += 1
+            self.stats["restarts"] += 1
+            telemetry.counter("connector.restarts")
+            _logger.warning(
+                "restarting connector %s in %.3fs (restart %d/%d, resuming "
+                "past %d delivered events)",
+                self.node.name,
+                delay,
+                self.restarts,
+                self.policy.max_restarts,
+                self._delivered(),
+            )
+            if self._stop_event.wait(delay):
+                break
+            attempt += 1
+        # exactly one end-of-stream close, owned by the supervisor — the
+        # scheduler's run loop exits once every primary source closed
+        self.make_close(att)
+
+    def make_close(self, att: _AttemptEvents | None) -> None:
+        if att is not None and not att._fenced:
+            att._inner.close()
+        else:
+            # the live chain was fenced (watchdog): close via a fresh sink
+            self.make_events(self._delivered()).close()
+
+    def _give_up(self, failure: BaseException) -> None:
+        from pathway_tpu.internals.telemetry import get_telemetry
+
+        mode = self.policy.on_failure
+        msg = (
+            f"connector {self.node.name}#{self.node.id} gave up after "
+            f"{self.restarts} restart(s): {failure!r}"
+        )
+        self.stats["state"] = "failed" if mode == "stop" else mode
+        if mode == "degrade":
+            # keep the run alive; the failure lands in the global
+            # error-log table and the outputs are flagged stale
+            self.stats["stale"] = True
+            get_telemetry().counter("connector.dlq_events")
+            if self.ctx is not None:
+                self.ctx.log_error(self.node, msg)
+                self.ctx.stale_sources.add(self.node.id)
+            return
+        if mode == "stop":
+            if self.ctx is not None:
+                self.ctx.log_error(self.node, msg)
+            _logger.error("%s; stopping the run (on_failure='stop')", msg)
+            if self._stop_runner is not None:
+                self._stop_runner()
+            return
+        # "drop": historical behaviour — loud log, stream closes, the run
+        # continues on whatever was delivered
+        _logger.error("%s; dropping the source (on_failure='drop')", msg)

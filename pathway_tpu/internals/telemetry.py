@@ -35,6 +35,9 @@ class Telemetry:
         self.run_id = str(uuid.uuid4())
         self.spans: list[dict] = []
         self.gauges: dict[str, float] = {}
+        #: monotonic counters (connector restarts, breaker trips, DLQ
+        #: events — the resilience subsystem's telemetry surface)
+        self.counters: dict[str, int] = {}
         self._lock = threading.Lock()
 
     # -- spans ----------------------------------------------------------
@@ -61,6 +64,18 @@ class Telemetry:
     def gauge(self, name: str, value: float) -> None:
         with self._lock:
             self.gauges[name] = float(value)
+
+    def counter(self, name: str, inc: int = 1) -> int:
+        """Increment (and return) a monotonic counter — exported with the
+        gauges and surfaced in the monitoring snapshot."""
+        with self._lock:
+            v = self.counters.get(name, 0) + inc
+            self.counters[name] = v
+            return v
+
+    def snapshot_counters(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.counters)
 
     def record_process_metrics(self) -> None:
         """Process memory/CPU gauges (reference telemetry.rs:316-395)."""
@@ -116,11 +131,15 @@ class Telemetry:
         self._post("/v1/traces", payload)
 
     def export_metrics(self) -> None:
-        if not self.endpoint or not self.gauges:
+        if not self.endpoint or not (self.gauges or self.counters):
             return
         now_ns = str(int(time.time() * 1e9))
         with self._lock:
             gauges = dict(self.gauges)
+            # counters ride the same gauge export (cumulative values)
+            gauges.update(
+                {name: float(v) for name, v in self.counters.items()}
+            )
         payload = {
             "resourceMetrics": [
                 {

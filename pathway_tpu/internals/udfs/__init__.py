@@ -77,6 +77,12 @@ class FixedDelayRetryStrategy(AsyncRetryStrategy):
     def _next_delay(self, attempt: int) -> float:
         return self._delay
 
+    def next_delay(self, attempt: int) -> float:
+        """Delay in seconds before retry number ``attempt`` (0-based).
+        Public: the connector supervisor reuses the same policy objects
+        for its restart schedule."""
+        return self._next_delay(attempt)
+
     async def invoke(self, fun, *args, **kwargs):
         last: Exception | None = None
         for attempt in range(self._max_retries + 1):
@@ -91,19 +97,42 @@ class FixedDelayRetryStrategy(AsyncRetryStrategy):
 
 
 class ExponentialBackoffRetryStrategy(FixedDelayRetryStrategy):
+    """Exponential backoff with jitter.
+
+    ``max_delay_ms`` caps every delay (with it unset, a long retry chain
+    sleeps unboundedly: delay * factor**n).  ``full_jitter=True`` draws
+    uniformly from ``[0, capped_base]`` (AWS full-jitter — decorrelates
+    retry storms better than additive jitter); the default keeps the
+    additive ``base + U(0, jitter_ms)`` behaviour.  ``seed`` makes the
+    schedule deterministic (chaos tests, reproducible drills)."""
+
     def __init__(
         self,
         max_retries: int = 3,
         initial_delay: int = 1000,
         backoff_factor: float = 2.0,
         jitter_ms: int = 300,
+        max_delay_ms: int | None = None,
+        full_jitter: bool = False,
+        seed: int | None = None,
     ):
         super().__init__(max_retries, initial_delay)
         self._backoff = backoff_factor
         self._jitter = jitter_ms / 1000
+        self._max_delay = max_delay_ms / 1000 if max_delay_ms is not None else None
+        self._full_jitter = full_jitter
+        self._rng = random.Random(seed) if seed is not None else random
 
     def _next_delay(self, attempt: int) -> float:
-        return self._delay * (self._backoff**attempt) + random.random() * self._jitter
+        base = self._delay * (self._backoff**attempt)
+        if self._max_delay is not None:
+            base = min(base, self._max_delay)
+        if self._full_jitter:
+            return self._rng.uniform(0.0, base)
+        delay = base + self._rng.random() * self._jitter
+        if self._max_delay is not None:
+            delay = min(delay, self._max_delay)
+        return delay
 
 
 # ---------------------------------------------------------------------------
@@ -155,11 +184,32 @@ class DiskCache(CacheStrategy):
             key = _cache_key(fun, args, kwargs)
             path = self._path(key)
             if os.path.exists(path):
-                with open(path, "rb") as f:
-                    return pickle.load(f)
+                try:
+                    with open(path, "rb") as f:
+                        return pickle.load(f)
+                except Exception:
+                    # torn/corrupt entry (crash mid-write before this
+                    # cache used tmp+replace, disk corruption): a cache
+                    # miss, not a permanent failure — drop it and recompute
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
             result = await fun(*args, **kwargs)
-            with open(path, "wb") as f:
-                pickle.dump(result, f)
+            # tmp + atomic rename: a crash mid-write must never leave a
+            # half-written pickle under the final name (unique tmp per
+            # writer — concurrent epochs may compute the same key)
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            try:
+                with open(tmp, "wb") as f:
+                    pickle.dump(result, f)
+                os.replace(tmp, path)
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
             return result
 
         return wrapper

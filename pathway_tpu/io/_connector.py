@@ -190,6 +190,7 @@ def input_table(
     upsert: bool = False,
     auxiliary: bool = False,
     persistent_id: str | None = None,
+    recovery_policy: Any = None,
 ) -> Table:
     cols = schema.column_names()
     node = eg.InputNode(
@@ -208,6 +209,10 @@ def input_table(
     # snapshot stream stably across graph edits, and opts the source into
     # SELECTIVE_PERSISTING
     node.persistent_id = persistent_id
+    # restart/backoff/breaker supervision (ConnectorRecoveryPolicy,
+    # pathway_tpu.internals.resilience); None keeps the historical
+    # one-failure-drops-the-source behaviour
+    node.recovery_policy = recovery_policy
     dtypes = {c: schema.__columns__[c].dtype for c in cols}
     return Table(node, cols, dtypes, name=name)
 

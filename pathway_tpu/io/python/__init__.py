@@ -87,6 +87,15 @@ class _SubjectAdapter(RowSource):
     def __init__(self, subject: ConnectorSubject, schema: sch.SchemaMetaclass):
         self.subject = subject
         self.schema = schema
+        # forward the wrapped subject's replay contract: supervised
+        # restart and persistence resume inspect ``node.subject``, which
+        # is this adapter, not the user's ConnectorSubject
+        self.deterministic_replay = bool(
+            getattr(subject, "deterministic_replay", False)
+        )
+        hook = getattr(subject, "on_persistence_resume", None)
+        if hook is not None:
+            self.on_persistence_resume = hook
 
     def run(self, events: Any) -> None:
         self.subject._events = events
@@ -104,9 +113,23 @@ def read(
     schema: sch.SchemaMetaclass,
     autocommit_duration_ms: int | None = None,
     name: str = "python",
+    persistent_id: str | None = None,
+    recovery_policy: Any = None,
     **kwargs: Any,
 ) -> Table:
-    """Read a stream produced by a :class:`ConnectorSubject`."""
+    """Read a stream produced by a :class:`ConnectorSubject`.
+
+    ``recovery_policy`` (a
+    :class:`~pathway_tpu.internals.resilience.ConnectorRecoveryPolicy`)
+    opts the source into supervised restart with backoff; without one a
+    reader failure closes the stream after a single attempt."""
     adapter = _SubjectAdapter(subject, schema)
     upsert = bool(schema.primary_key_columns())
-    return input_table(adapter, schema, name=name, upsert=upsert)
+    return input_table(
+        adapter,
+        schema,
+        name=name,
+        upsert=upsert,
+        persistent_id=persistent_id,
+        recovery_policy=recovery_policy,
+    )

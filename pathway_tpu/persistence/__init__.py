@@ -210,7 +210,16 @@ class _FsBackend(_BackendImpl):
                         keep = f.tell()
                         count += 1
             else:
-                keep = offsets[n_records - 1] if n_records > 0 else 0
+                # clamp to end-of-log: a caller asking to keep more
+                # records than the scanned log holds (e.g. a commit count
+                # from a newer snapshot against an older log) keeps
+                # everything instead of IndexError-ing
+                if n_records <= 0:
+                    keep = 0
+                elif n_records >= len(offsets):
+                    keep = offsets[-1] if offsets else 0
+                else:
+                    keep = offsets[n_records - 1]
                 del offsets[n_records:]
             with open(path, "r+b") as f:
                 f.truncate(keep)

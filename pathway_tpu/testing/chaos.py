@@ -1,0 +1,233 @@
+"""Deterministic, seedable fault-injection harness for chaos testing.
+
+Drives the crash-recovery drills in ``tests/test_chaos_recovery.py`` and
+is usable against real pipelines: every fault is injected by
+monkey-patching a *specific* call site under a context manager, so a test
+reads as "this exact operation fails on its Nth invocation" — no sleeps,
+no racing kill signals, fully reproducible under a fixed ``seed``.
+
+Fault classes (mirrors the failure modes the supervisor and persistence
+layers must survive):
+
+- :meth:`chaos.raise_on_nth_call` — transient exception on the Nth call.
+- :meth:`chaos.inject_latency` — fixed or seeded-random delay per call
+  (exercises watchdogs and autocommit timers).
+- :meth:`chaos.torn_write` — an ``_FsBackend.append`` that writes a
+  *partial* record then dies (crash mid-append; replay must treat the
+  torn tail as absent).
+- :meth:`chaos.crash_between_snapshot_and_commit` — the operator
+  snapshot is persisted, then the process "dies" before the run
+  continues (resume must not double-apply).
+
+Usage::
+
+    from pathway_tpu.testing import chaos
+
+    with chaos(seed=7) as c:
+        c.raise_on_nth_call(SomeReader, "poll", n=3)
+        run_pipeline()
+    assert c.call_count(SomeReader, "poll") >= 3
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import threading
+import time as _time
+from typing import Any, Callable, Iterable
+
+__all__ = ["ChaosError", "chaos", "flaky_once"]
+
+
+class ChaosError(RuntimeError):
+    """The marker exception raised by injected faults."""
+
+
+class chaos:
+    """Seedable fault-injection context manager (restores every patch on
+    exit, even when the body raises)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        #: (owner, attr, original) in application order
+        self._patches: list[tuple[Any, str, Any]] = []
+        #: one counter PER PATCH (faults may stack on the same attr; a
+        #: shared per-attr counter would double-count each call)
+        self._counters: dict[tuple[int, str, int], int] = {}
+        self._lock = threading.Lock()
+        self._entered = False
+
+    # -- lifecycle ------------------------------------------------------
+    def __enter__(self) -> "chaos":
+        self._entered = True
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.restore()
+
+    def restore(self) -> None:
+        """Undo every patch (reverse order)."""
+        while self._patches:
+            owner, attr, orig = self._patches.pop()
+            setattr(owner, attr, orig)
+
+    # -- bookkeeping ----------------------------------------------------
+    def _counter_key(self, owner: Any, attr: str) -> tuple[int, str, int]:
+        """Reserve a fresh counter slot for one patch."""
+        return (id(owner), attr, len(self._patches))
+
+    def _bump(self, key: tuple[int, str, int]) -> int:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + 1
+            return self._counters[key]
+
+    def call_count(self, owner: Any, attr: str) -> int:
+        """How many times the patched ``owner.attr`` was invoked (with
+        stacked faults each call passes through every layer once, so the
+        max across this attr's patch counters is the invocation count)."""
+        with self._lock:
+            return max(
+                (
+                    v
+                    for (oid, a, _i), v in self._counters.items()
+                    if oid == id(owner) and a == attr
+                ),
+                default=0,
+            )
+
+    def _patch(self, owner: Any, attr: str, replacement: Callable) -> None:
+        orig = getattr(owner, attr)
+        self._patches.append((owner, attr, orig))
+        setattr(owner, attr, replacement)
+
+    # -- faults ---------------------------------------------------------
+    def raise_on_nth_call(
+        self,
+        owner: Any,
+        attr: str,
+        n: int,
+        exc_factory: Callable[[], BaseException] | None = None,
+        every: bool = False,
+    ) -> None:
+        """The Nth invocation (1-based) of ``owner.attr`` raises; with
+        ``every=True`` every invocation from the Nth on raises (a
+        permanent fault instead of a transient one)."""
+        orig = getattr(owner, attr)
+        key = self._counter_key(owner, attr)
+        make_exc = exc_factory or (
+            lambda: ChaosError(f"injected fault: {attr} call #{n}")
+        )
+
+        @functools.wraps(orig)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            count = self._bump(key)
+            if count == n or (every and count >= n):
+                raise make_exc()
+            return orig(*args, **kwargs)
+
+        self._patch(owner, attr, wrapper)
+
+    def inject_latency(
+        self,
+        owner: Any,
+        attr: str,
+        delay_s: float = 0.05,
+        jitter_s: float = 0.0,
+        limit: int | None = None,
+    ) -> None:
+        """Sleep before each call of ``owner.attr`` (``delay_s`` plus a
+        seeded uniform draw from ``[0, jitter_s]``); ``limit`` bounds how
+        many calls are delayed."""
+        orig = getattr(owner, attr)
+        key = self._counter_key(owner, attr)
+
+        @functools.wraps(orig)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            count = self._bump(key)
+            if limit is None or count <= limit:
+                _time.sleep(delay_s + self.rng.uniform(0.0, jitter_s))
+            return orig(*args, **kwargs)
+
+        self._patch(owner, attr, wrapper)
+
+    def torn_write(
+        self,
+        backend_impl: Any,
+        on_nth: int = 1,
+        keep_fraction: float = 0.5,
+    ) -> None:
+        """The Nth ``append`` on a filesystem persistence backend writes
+        the length header plus only ``keep_fraction`` of the payload,
+        then raises :class:`ChaosError` — exactly what a crash mid-append
+        leaves on disk.  ``read_all``/``replay_events`` must treat the
+        torn tail as absent."""
+        orig = backend_impl.append
+        key = self._counter_key(backend_impl, "append")
+
+        def wrapper(stream: str, record: bytes, durable: bool = True) -> None:
+            count = self._bump(key)
+            if count != on_nth:
+                return orig(stream, record, durable)
+            # write a torn record exactly as _FsBackend lays them out:
+            # full length header, truncated payload, no trailing bytes
+            keep = max(0, min(len(record) - 1, int(len(record) * keep_fraction)))
+            with backend_impl._lock:
+                backend_impl._offsets.pop(stream, None)
+                f = backend_impl._handle(stream)
+                f.write(len(record).to_bytes(8, "little"))
+                f.write(record[:keep])
+                f.flush()
+                backend_impl._drop_handle(stream)
+            raise ChaosError(
+                f"injected torn write on stream {stream!r} (append #{count})"
+            )
+
+        self._patch(backend_impl, "append", wrapper)
+
+    def crash_between_snapshot_and_commit(self, hooks: Any, on_nth: int = 1) -> None:
+        """``PersistenceHooks.save_operator_snapshot`` persists the
+        snapshot blob, then raises — the crash window between an operator
+        snapshot landing on disk and the run carrying on.  Resume from
+        that snapshot must replay only the committed tail (no loss, no
+        double-apply)."""
+        orig = hooks.save_operator_snapshot
+        key = self._counter_key(hooks, "save_operator_snapshot")
+
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            count = self._bump(key)
+            result = orig(*args, **kwargs)
+            if count == on_nth:
+                raise ChaosError(
+                    f"injected crash after operator snapshot #{count}"
+                )
+            return result
+
+        self._patch(hooks, "save_operator_snapshot", wrapper)
+
+
+def flaky_once(
+    items: Iterable[Any],
+    fail_before_index: int,
+    exc_factory: Callable[[], BaseException] | None = None,
+) -> Callable[[], Iterable[Any]]:
+    """Generator factory for a transiently-faulty source: the FIRST pass
+    raises just before yielding item ``fail_before_index``; every later
+    pass yields all items.  Pairs with a deterministic-replay reader +
+    :class:`~pathway_tpu.internals.resilience.ConnectorRecoveryPolicy`
+    to drill restart-with-resume (each row delivered exactly once)."""
+    items = list(items)
+    state = {"tripped": False}
+    make_exc = exc_factory or (
+        lambda: ChaosError(f"injected source fault before row {fail_before_index}")
+    )
+
+    def gen() -> Iterable[Any]:
+        for i, item in enumerate(items):
+            if not state["tripped"] and i == fail_before_index:
+                state["tripped"] = True
+                raise make_exc()
+            yield item
+
+    return gen

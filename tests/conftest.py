@@ -18,6 +18,18 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: deterministic chaos/fault-injection
+    # tests stay in tier-1 (marker `chaos`), long randomized drills are
+    # additionally marked `slow` and excluded
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection / crash-recovery test"
+    )
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from the tier-1 run"
+    )
+
+
 @pytest.fixture(autouse=True)
 def fresh_graph():
     """Reset the global graph between tests (reference

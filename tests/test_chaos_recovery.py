@@ -1,0 +1,232 @@
+"""Crash-recovery drills driven by the seedable fault-injection harness
+(``pathway_tpu.testing.chaos``): torn persistence writes, kill-mid-epoch
+restarts, crash between operator snapshot and commit."""
+
+import random
+import time as _time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.scheduler import Scheduler
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.resilience import ConnectorRecoveryPolicy
+from pathway_tpu.io._connector import DictSource, input_table
+from pathway_tpu.persistence import (
+    Backend,
+    Config,
+    PersistenceMode,
+    attach_persistence,
+)
+from pathway_tpu.testing import ChaosError, chaos, flaky_once
+
+pytestmark = pytest.mark.chaos
+
+
+class WordSchema(pw.Schema):
+    word: str
+
+
+ROWS = [{"word": w} for w in ["a", "b", "a", "c", "a", "b"]]
+EXPECTED = {"a": 3, "b": 2, "c": 1}
+
+
+def _build(gen, results, policy=None, name="wsrc"):
+    src = DictSource(gen, WordSchema, commit_every=2)
+    t = input_table(src, WordSchema, name=name, recovery_policy=policy)
+    counts = t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+
+    def on_change(key, row, time, is_addition):
+        if is_addition:
+            results[row["word"]] = row["n"]
+        elif results.get(row["word"]) == row["n"]:
+            del results[row["word"]]
+
+    pw.io.subscribe(counts, on_change=on_change)
+
+
+def _run(tmp_path, mode=None):
+    sched = Scheduler(G.engine_graph, autocommit_ms=10)
+    cfg = (
+        Config.simple_config(Backend.filesystem(tmp_path / "snap"))
+        if mode is None
+        else Config.simple_config(
+            Backend.filesystem(tmp_path / "snap"),
+            persistence_mode=mode,
+            snapshot_interval_ms=0,
+        )
+    )
+    attach_persistence(sched, cfg)
+    sched.run()
+    return sched
+
+
+# ---------------------------------------------------------------------------
+# harness smoke test (tier-1-safe: no engine, no sleeps beyond ~10ms)
+
+
+def test_chaos_smoke():
+    class Service:
+        def __init__(self):
+            self.calls = 0
+
+        def ping(self):
+            self.calls += 1
+            return "pong"
+
+    svc = Service()
+    with chaos(seed=3) as c:
+        c.raise_on_nth_call(svc, "ping", n=2)
+        assert svc.ping() == "pong"
+        with pytest.raises(ChaosError):
+            svc.ping()
+        assert svc.ping() == "pong"  # transient: only the 2nd call failed
+        assert c.call_count(svc, "ping") == 3
+        assert svc.calls == 2  # the faulted call never reached the body
+    assert svc.ping() == "pong"  # patch restored on exit
+
+    svc2 = Service()
+    with chaos() as c:
+        c.raise_on_nth_call(svc2, "ping", n=2, every=True)  # permanent fault
+        c.inject_latency(svc2, "ping", delay_s=0.001, jitter_s=0.002)
+        svc2.ping()
+        for _ in range(3):
+            with pytest.raises(ChaosError):
+                svc2.ping()
+
+
+def test_chaos_seeded_latency_is_deterministic():
+    draws = []
+    for _ in range(2):
+        c = chaos(seed=42)
+        draws.append([c.rng.uniform(0.0, 1.0) for _ in range(5)])
+    assert draws[0] == draws[1]
+
+
+# ---------------------------------------------------------------------------
+# torn persistence writes
+
+
+def test_torn_append_leaves_committed_prefix_readable(tmp_path):
+    impl = Backend.filesystem(tmp_path / "p")._impl
+    impl.append("s", b"first")
+    impl.append("s", b"second")
+    with chaos() as c:
+        c.torn_write(impl, on_nth=1, keep_fraction=0.5)
+        with pytest.raises(ChaosError):
+            impl.append("s", b"third-record-payload")
+    # the torn tail is invisible; the log keeps serving the full prefix
+    assert impl.read_all("s") == [b"first", b"second"]
+    # "restart": recovery truncates to the complete prefix (exactly what
+    # replay_events does), then appends land cleanly past the torn bytes
+    impl.truncate("s", 2)
+    impl.append("s", b"fourth")
+    assert impl.read_all("s") == [b"first", b"second", b"fourth"]
+
+
+def test_torn_write_during_run_recovers_on_restart(tmp_path):
+    """A crash mid-append while recording the input snapshot: the run
+    dies, the restart replays only complete committed records and the
+    reader resumes — final counts match the fault-free run."""
+    backend = Backend.filesystem(tmp_path / "snap")
+
+    results1: dict = {}
+    _build(lambda: iter(ROWS), results1)
+    sched = Scheduler(G.engine_graph, autocommit_ms=10)
+    attach_persistence(sched, Config.simple_config(backend))
+    with chaos() as c:
+        # tear a mid-log data record; the reader thread dies with
+        # ChaosError and the run finishes on the committed prefix
+        c.torn_write(backend._impl, on_nth=4, keep_fraction=0.3)
+        sched.run()
+
+    G.clear()
+    results2: dict = {}
+    _build(lambda: iter(ROWS), results2)
+    _run(tmp_path)
+    assert results2 == EXPECTED
+
+
+# ---------------------------------------------------------------------------
+# kill mid-epoch → resume: identical tables
+
+
+def test_kill_mid_epoch_resume_produces_identical_tables(tmp_path):
+    """Run 1 faults mid-stream and the supervisor restarts it under
+    persistence recording; run 2 resumes from the snapshot with appended
+    rows.  Both runs end exactly right — no loss, no double-apply."""
+    policy = ConnectorRecoveryPolicy(
+        max_restarts=3, initial_delay_ms=5, jitter_ms=0, seed=0
+    )
+    results1: dict = {}
+    _build(flaky_once(ROWS, 4), results1, policy=policy)
+    sched = _run(tmp_path)
+    assert results1 == EXPECTED
+    stats = next(
+        v for k, v in sched.connector_stats.items() if k.startswith("wsrc#")
+    )
+    assert stats["restarts"] == 1
+
+    # "restart the process": fresh graph, same snapshot dir, more input
+    G.clear()
+    rows2 = ROWS + [{"word": "a"}, {"word": "d"}]
+    results2: dict = {}
+    _build(lambda: iter(rows2), results2, policy=policy)
+    _run(tmp_path)
+    assert results2 == {"a": 4, "b": 2, "c": 1, "d": 1}
+
+
+# ---------------------------------------------------------------------------
+# crash between operator snapshot and commit
+
+
+def test_crash_after_operator_snapshot_resumes_exactly(tmp_path):
+    """OPERATOR_PERSISTING: the process dies right after an operator
+    snapshot lands on disk.  Resume must replay only the tail past the
+    snapshot's consumed counts — the restarted run's final counts equal a
+    fresh fault-free run's."""
+    results1: dict = {}
+    _build(lambda: iter(ROWS), results1)
+    sched = Scheduler(G.engine_graph, autocommit_ms=5)
+    attach_persistence(
+        sched,
+        Config.simple_config(
+            Backend.filesystem(tmp_path / "snap"),
+            persistence_mode=PersistenceMode.OPERATOR_PERSISTING,
+            snapshot_interval_ms=0,
+        ),
+    )
+    with chaos() as c:
+        c.crash_between_snapshot_and_commit(sched.persistence, on_nth=1)
+        with pytest.raises(ChaosError):
+            sched.run()
+    sched.stop()  # "the process died": reap reader threads before run 2
+    _time.sleep(0.05)
+
+    G.clear()
+    results2: dict = {}
+    _build(lambda: iter(ROWS), results2)
+    _run(tmp_path, mode=PersistenceMode.OPERATOR_PERSISTING)
+    assert results2 == EXPECTED
+
+
+# ---------------------------------------------------------------------------
+# randomized drill (excluded from tier-1)
+
+
+@pytest.mark.slow
+def test_randomized_fault_points_always_exactly_once(tmp_path):
+    """Sweep seeded random fault points over the stream; every drill must
+    deliver exactly-once after the supervised restart."""
+    rng = random.Random(2026)
+    policy = ConnectorRecoveryPolicy(
+        max_restarts=3, initial_delay_ms=5, jitter_ms=0, seed=0
+    )
+    for drill in range(5):
+        G.clear()
+        fail_at = rng.randrange(1, len(ROWS))
+        results: dict = {}
+        _build(flaky_once(ROWS, fail_at), results, policy=policy)
+        sched = Scheduler(G.engine_graph, autocommit_ms=10)
+        sched.run()
+        assert results == EXPECTED, (drill, fail_at, results)
