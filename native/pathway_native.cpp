@@ -122,8 +122,24 @@ struct Hasher {
     void u64le(uint64_t v) { bytes(&v, 8); }
 };
 
-// mirror of keys._feed — must stay byte-identical
-bool feed(Hasher& h, PyObject* v) {
+// collects the exact byte stream ``feed`` would hash — used as the memo
+// key for route_split's per-row digest cache
+struct ByteSink {
+    std::string& out;
+    void bytes(const void* p, size_t n) {
+        out.append(static_cast<const char*>(p), n);
+    }
+    void tag(uint8_t t) { out.push_back(static_cast<char>(t)); }
+    void u64le(uint64_t v) {
+        out.append(reinterpret_cast<const char*>(&v), 8);
+    }
+};
+
+// mirror of keys._feed — must stay byte-identical.  Templated over the
+// sink so route_split can serialize the fed bytes once (ByteSink) while
+// key hashing keeps streaming straight into BLAKE2b (Hasher).
+template <typename Sink>
+bool feed(Sink& h, PyObject* v) {
     if (v == Py_None) {
         h.tag(0x00);
         return true;
@@ -221,6 +237,44 @@ PyObject* digest_to_long(Hasher& h) {
     uint8_t out[16];
     pwnative::blake2b_final(&h.S, out);
     return pt_long_from_bytes_unsigned(out, 16);
+}
+
+// Pointer construction is a per-row cost in every hot loop (key hashing,
+// frame unpack), and calling the class pays the full type-call protocol
+// — comparable to parsing the whole row.  Pointer is a bare int subclass
+// (``__slots__ = ()``), so pre-3.12, where the PyLongObject layout is
+// public, clone the digits into a tp_alloc'd instance exactly as
+// CPython's long_subtype_new does.  The guards drop back to the call
+// protocol if Pointer ever grows a custom __new__/__init__ or storage
+// (and on 3.12+, where the int layout went opaque).  Steals ``num``.
+PyObject* pointer_from_long(PyObject* num) {
+    if (num == nullptr || g_pointer_type == nullptr) return num;
+    PyTypeObject* pt = reinterpret_cast<PyTypeObject*>(g_pointer_type);
+#if PY_VERSION_HEX < 0x030C0000
+    if (pt->tp_new == PyLong_Type.tp_new &&
+        pt->tp_init == PyLong_Type.tp_init &&
+        pt->tp_basicsize == PyLong_Type.tp_basicsize &&
+        pt->tp_itemsize == PyLong_Type.tp_itemsize &&
+        PyLong_CheckExact(num)) {
+        Py_ssize_t sz = Py_SIZE(num);
+        Py_ssize_t ndig = sz < 0 ? -sz : sz;
+        PyLongObject* p =
+            reinterpret_cast<PyLongObject*>(pt->tp_alloc(pt, ndig));
+        if (p == nullptr) {
+            Py_DECREF(num);
+            return nullptr;
+        }
+        Py_SET_SIZE(p, sz);
+        PyLongObject* src = reinterpret_cast<PyLongObject*>(num);
+        for (Py_ssize_t i = 0; i < ndig; i++)
+            p->ob_digit[i] = src->ob_digit[i];
+        Py_DECREF(num);
+        return reinterpret_cast<PyObject*>(p);
+    }
+#endif
+    PyObject* ptr = PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
+    Py_DECREF(num);
+    return ptr;
 }
 
 PyObject* py_ref_scalar(PyObject*, PyObject* args_tuple) {
@@ -383,8 +437,7 @@ PyObject* py_hash_prefix_ints(PyObject*, PyObject* args) {
             Py_DECREF(out);
             return nullptr;
         }
-        PyObject* ptr = PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
-        Py_DECREF(num);
+        PyObject* ptr = pointer_from_long(num);
         if (ptr == nullptr) {
             Py_DECREF(seq);
             Py_DECREF(out);
@@ -762,6 +815,50 @@ PyObject* py_rowwise_map(PyObject*, PyObject* args) {
             Py_DECREF(vals);
             if (nu == nullptr) goto fail;
             PyList_SET_ITEM(out, i, nu);
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+fail:
+    Py_DECREF(seq);
+    Py_DECREF(out);
+    return nullptr;
+}
+
+// the groupby fast path only needs the (rare) rows whose cells contain
+// the ERROR sentinel — scanning for them per row in Python costs more
+// than the whole native aggregation; this is one identity-compare pass
+PyObject* py_rows_with_error(PyObject*, PyObject* args) {
+    PyObject *batch, *sentinel;
+    if (!PyArg_ParseTuple(args, "OO", &batch, &sentinel)) return nullptr;
+    PyObject* seq =
+        PySequence_Fast(batch, "rows_with_error expects a sequence");
+    if (seq == nullptr) return nullptr;
+    PyObject* out = PyList_New(0);
+    if (out == nullptr) {
+        Py_DECREF(seq);
+        return nullptr;
+    }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            goto fail;
+        }
+        {
+            PyObject* values = PyTuple_GET_ITEM(u, 1);
+            if (!PyTuple_Check(values)) {
+                PyErr_SetString(PyExc_TypeError, "values must be tuples");
+                goto fail;
+            }
+            Py_ssize_t nv = PyTuple_GET_SIZE(values);
+            for (Py_ssize_t j = 0; j < nv; j++) {
+                if (PyTuple_GET_ITEM(values, j) == sentinel) {
+                    if (PyList_Append(out, u) < 0) goto fail;
+                    break;
+                }
+            }
         }
     }
     Py_DECREF(seq);
@@ -1358,6 +1455,37 @@ fail:
 // route cells (idx >= 0 -> values[idx], -1 -> row key) — byte-identical
 // to cluster.stable_shard / keys.ref_scalar, including the repr fallback
 // for unhashable cell types.
+// Route cells are drawn from a small domain (group keys, join keys)
+// while batches run to millions of rows, so the per-row BLAKE2b is
+// mostly recomputation: memoize the digest by the serialized cell
+// bytes.  The hash is a pure function of those bytes, so entries can
+// never go stale, and caching the digest (not the destination) keeps
+// the memo worker-count independent.  GIL-protected — route_split never
+// releases it.  Past the cap we stop inserting: a high-cardinality
+// route keeps its first entries hot and pays the hash for the rest.
+struct RouteDigest {
+    uint8_t b[16];
+};
+constexpr size_t kRouteMemoCap = 1 << 13;
+std::string g_route_buf;
+std::unordered_map<std::string, RouteDigest> g_route_memo;
+
+void route_digest(const std::string& cells, uint8_t out[16]) {
+    auto it = g_route_memo.find(cells);
+    if (it != g_route_memo.end()) {
+        std::memcpy(out, it->second.b, 16);
+        return;
+    }
+    Hasher h;
+    h.bytes(cells.data(), cells.size());
+    pwnative::blake2b_final(&h.S, out);
+    if (g_route_memo.size() < kRouteMemoCap) {
+        RouteDigest d;
+        std::memcpy(d.b, out, 16);
+        g_route_memo.emplace(cells, d);
+    }
+}
+
 PyObject* py_route_split(PyObject*, PyObject* args) {
     PyObject *batch, *idxs;
     long W;
@@ -1419,7 +1547,8 @@ PyObject* py_route_split(PyObject*, PyObject* args) {
                     goto fail;
                 continue;
             }
-            Hasher h;
+            g_route_buf.clear();
+            ByteSink sink{g_route_buf};
             bool ok = true;
             for (Py_ssize_t j = 0; j < nidx && ok; j++) {
                 Py_ssize_t ix = pos[(size_t)j];
@@ -1433,7 +1562,7 @@ PyObject* py_route_split(PyObject*, PyObject* args) {
                                     "route column out of range");
                     goto fail;
                 }
-                ok = feed(h, cell);
+                ok = feed(sink, cell);
             }
             if (!ok) {
                 // cell type outside the native feed set (datetime,
@@ -1446,7 +1575,7 @@ PyObject* py_route_split(PyObject*, PyObject* args) {
                 goto fail;
             }
             uint8_t dg[16];
-            pwnative::blake2b_final(&h.S, dg);
+            route_digest(g_route_buf, dg);
             uint64_t lo, hi;
             std::memcpy(&lo, dg, 8);
             std::memcpy(&hi, dg + 8, 8);
@@ -1727,6 +1856,8 @@ enum VmMethod : int64_t {
     M_DUR_NANOSECONDS, M_DUR_MICROSECONDS, M_DUR_MILLISECONDS,
     M_DUR_SECONDS, M_DUR_MINUTES, M_DUR_HOURS, M_DUR_DAYS, M_DUR_WEEKS,
     M_NUM_ABS, M_NUM_FILL_NA,
+    M_NUM_ROUND,                               // (x, decimals)
+    M_STR_SPLIT,                               // (s, maxsplit) | (s, sep, maxsplit)
     M_METHOD_COUNT,
 };
 
@@ -2718,6 +2849,55 @@ PyObject* vm_method_eval(int64_t mid, PyObject** args, int64_t nargs) {
             Py_INCREF(r);
             return r;
         }
+        case M_NUM_ROUND: {
+            // round(x, d): d is always passed by the closure, so the
+            // result keeps x's type (round(2.5, 0) == 2.0, not 2)
+            PyObject* d = args[1];
+            if (PyLong_CheckExact(d)) {
+                long nd = PyLong_AsLong(d);
+                if (nd == -1 && PyErr_Occurred()) {
+                    PyErr_Clear();  // huge ndigits: defer to __round__
+                } else if (PyLong_CheckExact(a0) && nd >= 0) {
+                    Py_INCREF(a0);  // ndigits >= 0 keeps an exact int
+                    return a0;
+                } else if (PyFloat_CheckExact(a0) && nd == 0) {
+                    // ties-to-even to an integral double — exactly
+                    // float.__round__(0), incl. nan/inf passthrough
+                    return PyFloat_FromDouble(
+                        std::nearbyint(PyFloat_AS_DOUBLE(a0)));
+                }
+            }
+            // decimal ndigits / bools / odd types: the type's __round__
+            // (what builtin round(x, d) dispatches to); missing __round__
+            // raises, which the caller maps to ERROR like the closure
+            return PyObject_CallMethod(a0, "__round__", "O", d);
+        }
+        case M_STR_SPLIT: {
+            // (s, maxsplit) = whitespace split; (s, sep, maxsplit) = by
+            // separator — exactly str.split(None|sep, maxsplit), wrapped
+            // to a tuple like the closure
+            if (!PyUnicode_Check(a0)) {
+                PyErr_SetString(PyExc_TypeError, "expected str");
+                return nullptr;
+            }
+            PyObject* sep = nargs >= 3 ? args[1] : nullptr;
+            if (sep != nullptr && !PyUnicode_Check(sep)) {
+                PyErr_SetString(PyExc_TypeError, "sep must be str");
+                return nullptr;
+            }
+            PyObject* ms = args[nargs - 1];
+            if (!PyLong_Check(ms)) {
+                PyErr_SetString(PyExc_TypeError, "maxsplit must be an int");
+                return nullptr;
+            }
+            Py_ssize_t maxsplit = PyLong_AsSsize_t(ms);
+            if (maxsplit == -1 && PyErr_Occurred()) return nullptr;
+            PyObject* lst = PyUnicode_Split(a0, sep, maxsplit);
+            if (lst == nullptr) return nullptr;  // empty sep: ValueError
+            PyObject* tup = PyList_AsTuple(lst);
+            Py_DECREF(lst);
+            return tup;
+        }
         default:
             PyErr_Format(PyExc_SystemError, "bad method id %lld",
                          (long long)mid);
@@ -3267,9 +3447,7 @@ PyObject* vm_eval(VmProgram* P, PyObject* key, PyObject* values,
                 if (ok) {
                     PyObject* num = digest_to_long(h);
                     if (num == nullptr) goto rowfail_ptr;
-                    r = PyObject_CallFunctionObjArgs(g_pointer_type, num,
-                                                     nullptr);
-                    Py_DECREF(num);
+                    r = pointer_from_long(num);
                 } else {
                     if (PyErr_Occurred()) PyErr_Clear();
                     // unsupported value type: defer to Python ref_scalar
@@ -3659,9 +3837,7 @@ PyObject* join_okey(PyObject* lk, PyObject* rk) {
     }
     PyObject* num = digest_to_long(h);
     if (num == nullptr) return nullptr;
-    PyObject* p = PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
-    Py_DECREF(num);
-    return p;
+    return pointer_from_long(num);
 }
 
 // okey = ref_scalar("__join_r__", int(rk)) — right-outer unmatched rows
@@ -3674,9 +3850,7 @@ PyObject* join_okey_r(PyObject* rk) {
     if (!feed_pylong_plain(h, rk)) return nullptr;
     PyObject* num = digest_to_long(h);
     if (num == nullptr) return nullptr;
-    PyObject* p = PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
-    Py_DECREF(num);
-    return p;
+    return pointer_from_long(num);
 }
 
 struct JoinCtx {
@@ -4793,6 +4967,24 @@ enum : uint8_t {
     WT_POINTER = 7, // u8 len + unsigned LE
     WT_TUPLE = 8,   // u8 arity + nested values
     WT_PICKLE = 9,  // u32 len + pickle bytes
+    WT_STRREF = 10, // varint index into the frame's string table
+};
+
+// Per-frame string interning: group/join key columns repeat a small
+// vocabulary across millions of rows, so the second and later
+// occurrences of a string in a frame encode as a 1-2 byte table ref and
+// decode as an INCREF of the already-built object (no UTF-8 decode, no
+// allocation).  The table is IMPLICIT: both sides append every WT_STR
+// they see (short ones, while there is room), so the wire carries no
+// table section and a frame without refs is byte-identical to the
+// pre-STRREF format.  The persistence codec (pack_kv) packs with
+// interning disabled — snapshot bytes stay stable — but its decoder
+// shares this logic and accepts refs regardless.
+constexpr size_t kWfInternCap = 1 << 16;
+constexpr size_t kWfInternMaxLen = 255;  // intern short strings only
+
+struct WfIntern {
+    std::unordered_map<std::string, uint32_t> map;
 };
 
 inline void wf_put_u32(std::string& b, uint32_t v) {
@@ -4813,7 +5005,8 @@ inline void wf_put_varint(std::string& b, long long sv) {
     b.push_back(static_cast<char>(v));
 }
 
-bool wf_pack_value(std::string& buf, PyObject* v);  // fwd (tuples recurse)
+bool wf_pack_value(std::string& buf, PyObject* v,
+                   WfIntern* intern);  // fwd (tuples recurse)
 
 // u32 length fields cap any single value at 4 GiB; bigger ones abort the
 // pack (the cluster layer falls back to whole-frame pickle) instead of
@@ -4846,7 +5039,7 @@ bool wf_pack_pickled(std::string& buf, PyObject* v) {
     return true;
 }
 
-bool wf_pack_value(std::string& buf, PyObject* v) {
+bool wf_pack_value(std::string& buf, PyObject* v, WfIntern* intern) {
     if (v == Py_None) {
         buf.push_back(static_cast<char>(WT_NONE));
     } else if (v == Py_True) {
@@ -4888,6 +5081,23 @@ bool wf_pack_value(std::string& buf, PyObject* v) {
                             "value too large for update frame");
             return false;
         }
+        if (intern != nullptr && static_cast<size_t>(n) <= kWfInternMaxLen) {
+            // the decoder appends the same strings to its table in the
+            // same order, so the insert-on-first-sight protocol below
+            // must stay byte-symmetric with the WT_STR decode path
+            std::string k(s, static_cast<size_t>(n));
+            auto it = intern->map.find(k);
+            if (it != intern->map.end()) {
+                buf.push_back(static_cast<char>(WT_STRREF));
+                wf_put_varint(buf, it->second);
+                return true;
+            }
+            if (intern->map.size() < kWfInternCap) {
+                intern->map.emplace(
+                    std::move(k),
+                    static_cast<uint32_t>(intern->map.size()));
+            }
+        }
         buf.push_back(static_cast<char>(WT_STR));
         wf_put_u32(buf, static_cast<uint32_t>(n));
         buf.append(s, static_cast<size_t>(n));
@@ -4907,7 +5117,8 @@ bool wf_pack_value(std::string& buf, PyObject* v) {
         buf.push_back(static_cast<char>(WT_TUPLE));
         buf.push_back(static_cast<char>(PyTuple_GET_SIZE(v)));
         for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(v); i++) {
-            if (!wf_pack_value(buf, PyTuple_GET_ITEM(v, i))) return false;
+            if (!wf_pack_value(buf, PyTuple_GET_ITEM(v, i), intern))
+                return false;
         }
     } else {
         return wf_pack_pickled(buf, v);  // datetime/ndarray/Json/...
@@ -4919,7 +5130,8 @@ bool wf_pack_value(std::string& buf, PyObject* v) {
 // whole-values pickle).  Both frame formats (updates, kv pairs) are this
 // row plus format-specific fields, so there is exactly ONE copy of the
 // value-encoding logic.
-bool wf_pack_row(std::string& buf, PyObject* key, PyObject* values) {
+bool wf_pack_row(std::string& buf, PyObject* key, PyObject* values,
+                 WfIntern* intern) {
     uint8_t kb[16];
     if (pt_long_as_bytes_unsigned(key, kb, sizeof kb) < 0) {
         // 3.13+ reports too-large keys without raising; keys are 128-bit
@@ -4932,7 +5144,7 @@ bool wf_pack_row(std::string& buf, PyObject* key, PyObject* values) {
     if (PyTuple_CheckExact(values) && PyTuple_GET_SIZE(values) < 255) {
         buf.push_back(static_cast<char>(PyTuple_GET_SIZE(values)));
         for (Py_ssize_t j = 0; j < PyTuple_GET_SIZE(values); j++) {
-            if (!wf_pack_value(buf, PyTuple_GET_ITEM(values, j)))
+            if (!wf_pack_value(buf, PyTuple_GET_ITEM(values, j), intern))
                 return false;
         }
         return true;
@@ -4942,41 +5154,83 @@ bool wf_pack_row(std::string& buf, PyObject* key, PyObject* values) {
 }
 
 
-PyObject* py_pack_updates(PyObject*, PyObject* batch) {
+// shared frame encoder: appends [u32 count] rows to `buf`; false with
+// exception set on failure (buf may hold a torn frame — callers discard)
+bool wf_pack_updates_frame(std::string& buf, PyObject* batch,
+                           WfIntern* intern) {
     PyObject* seq = PySequence_Fast(batch, "pack_updates expects a sequence");
-    if (seq == nullptr) return nullptr;
+    if (seq == nullptr) return false;
     Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
-    std::string buf;
-    buf.reserve(static_cast<size_t>(n) * 48 + 8);
+    if (buf.capacity() - buf.size() < static_cast<size_t>(n) * 48 + 8)
+        buf.reserve(buf.size() + static_cast<size_t>(n) * 48 + 8);
     wf_put_u32(buf, static_cast<uint32_t>(n));
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
         if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
             PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
             Py_DECREF(seq);
-            return nullptr;
+            return false;
         }
         if (!wf_pack_row(buf, PyTuple_GET_ITEM(u, 0),
-                         PyTuple_GET_ITEM(u, 1))) {
+                         PyTuple_GET_ITEM(u, 1), intern)) {
             Py_DECREF(seq);
-            return nullptr;
+            return false;
         }
         long long d = PyLong_AsLongLong(PyTuple_GET_ITEM(u, 2));
         if (d == -1 && PyErr_Occurred()) {
             Py_DECREF(seq);
-            return nullptr;
+            return false;
         }
         wf_put_varint(buf, d);
     }
     Py_DECREF(seq);
+    return true;
+}
+
+PyObject* py_pack_updates(PyObject*, PyObject* batch) {
+    std::string buf;
+    WfIntern intern;
+    if (!wf_pack_updates_frame(buf, batch, &intern)) return nullptr;
     return PyBytes_FromStringAndSize(buf.data(),
                                      static_cast<Py_ssize_t>(buf.size()));
+}
+
+PyObject* py_pack_updates_into(PyObject*, PyObject* args) {
+    // pack_updates_into(batch, bytearray) -> appended byte count.  The
+    // cluster sender threads build one coalesced transmission per peer by
+    // appending frames straight into a reusable bytearray; the scratch
+    // string is thread-local so its capacity persists across epochs (no
+    // per-epoch allocation churn on the exchange hot path).
+    PyObject* batch;
+    PyObject* target;
+    if (!PyArg_ParseTuple(args, "OO!:pack_updates_into", &batch,
+                          &PyByteArray_Type, &target))
+        return nullptr;
+    static thread_local std::string buf;
+    static thread_local WfIntern intern;
+    buf.clear();
+    // the string table is scoped to ONE frame (each frame in a coalesced
+    // transmission decodes with its own fresh reader), so the map resets
+    // per call even though its buckets persist for reuse
+    intern.map.clear();
+    if (!wf_pack_updates_frame(buf, batch, &intern)) return nullptr;
+    Py_ssize_t at = PyByteArray_GET_SIZE(target);
+    if (PyByteArray_Resize(target, at + static_cast<Py_ssize_t>(buf.size())) <
+        0)
+        return nullptr;
+    std::memcpy(PyByteArray_AS_STRING(target) + at, buf.data(), buf.size());
+    return PyLong_FromSsize_t(static_cast<Py_ssize_t>(buf.size()));
 }
 
 struct WfReader {
     const uint8_t* p;
     const uint8_t* end;
     bool fail = false;
+    // frame string table: borrowed refs to strings decoded so far (the
+    // built rows own them; decode errors abort the whole frame, so an
+    // entry can never dangle while the reader is live).  Mirrors the
+    // encoder's insert-on-first-sight protocol exactly.
+    std::vector<PyObject*> strtab;
 
     bool need(size_t n) {
         // sticky: a failed length read must poison the zero-length
@@ -5059,8 +5313,27 @@ PyObject* wf_unpack_value(WfReader& r) {
             uint32_t n = r.u32();
             const uint8_t* s = r.bytes(n);
             if (s == nullptr) break;
-            return PyUnicode_DecodeUTF8(reinterpret_cast<const char*>(s),
-                                        static_cast<Py_ssize_t>(n), nullptr);
+            PyObject* str = PyUnicode_DecodeUTF8(
+                reinterpret_cast<const char*>(s),
+                static_cast<Py_ssize_t>(n), nullptr);
+            // condition must match the encoder's intern gate exactly or
+            // the two sides' table indices diverge silently
+            if (str != nullptr && n <= kWfInternMaxLen &&
+                r.strtab.size() < kWfInternCap)
+                r.strtab.push_back(str);  // borrowed; rows own it
+            return str;
+        }
+        case WT_STRREF: {
+            uint64_t idx = r.varint();
+            if (r.fail) break;
+            if (idx >= r.strtab.size()) {
+                PyErr_SetString(PyExc_ValueError,
+                                "bad string ref in frame");
+                return nullptr;
+            }
+            PyObject* str = r.strtab[static_cast<size_t>(idx)];
+            Py_INCREF(str);
+            return str;
         }
         case WT_BYTES: {
             uint32_t n = r.u32();
@@ -5075,10 +5348,7 @@ PyObject* wf_unpack_value(WfReader& r) {
             if (kb == nullptr) break;
             PyObject* num = pt_long_from_bytes_unsigned(kb, klen);
             if (num == nullptr || g_pointer_type == nullptr) return num;
-            PyObject* ptr =
-                PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
-            Py_DECREF(num);
-            return ptr;
+            return pointer_from_long(num);
         }
         case WT_TUPLE: {
             uint8_t arity = r.u8();
@@ -5145,8 +5415,7 @@ bool wf_unpack_row(WfReader& r, PyObject** key_out, PyObject** values_out) {
         Py_DECREF(values);
         return false;
     }
-    PyObject* key = PyObject_CallFunctionObjArgs(g_pointer_type, num, nullptr);
-    Py_DECREF(num);
+    PyObject* key = pointer_from_long(num);
     if (key == nullptr) {
         Py_DECREF(values);
         return false;
@@ -5157,10 +5426,15 @@ bool wf_unpack_row(WfReader& r, PyObject** key_out, PyObject** values_out) {
 }
 
 PyObject* py_unpack_updates(PyObject*, PyObject* arg) {
-    char* data;
-    Py_ssize_t nbytes;
-    if (PyBytes_AsStringAndSize(arg, &data, &nbytes) < 0) return nullptr;
+    // accepts any C-contiguous buffer (bytes, bytearray, memoryview): the
+    // cluster reader threads decode frames from zero-copy slices of the
+    // reusable receive buffer
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+    const char* data = static_cast<const char*>(view.buf);
+    Py_ssize_t nbytes = view.len;
     if (g_update_type == nullptr || g_pointer_type == nullptr) {
+        PyBuffer_Release(&view);
         PyErr_SetString(PyExc_RuntimeError,
                         "unpack_updates: Update/Pointer types unregistered");
         return nullptr;
@@ -5169,11 +5443,15 @@ PyObject* py_unpack_updates(PyObject*, PyObject* arg) {
                reinterpret_cast<const uint8_t*>(data) + nbytes};
     uint32_t n = r.u32();
     if (r.fail) {
+        PyBuffer_Release(&view);
         PyErr_SetString(PyExc_ValueError, "truncated update frame");
         return nullptr;
     }
     PyObject* out = PyList_New(static_cast<Py_ssize_t>(n));
-    if (out == nullptr) return nullptr;
+    if (out == nullptr) {
+        PyBuffer_Release(&view);
+        return nullptr;
+    }
     for (uint32_t i = 0; i < n; i++) {
         PyObject *key, *values;
         if (!wf_unpack_row(r, &key, &values)) goto fail;
@@ -5210,8 +5488,10 @@ PyObject* py_unpack_updates(PyObject*, PyObject* arg) {
             PyList_SET_ITEM(out, static_cast<Py_ssize_t>(i), u);
         }
     }
+    PyBuffer_Release(&view);
     return out;
 fail:
+    PyBuffer_Release(&view);
     Py_DECREF(out);
     return nullptr;
 }
@@ -5233,8 +5513,10 @@ PyObject* py_pack_kv(PyObject*, PyObject* rows) {
             Py_DECREF(seq);
             return nullptr;
         }
+        // no interning: snapshot bytes must stay stable across releases
+        // (the shared decoder accepts refs regardless)
         if (!wf_pack_row(buf, PyTuple_GET_ITEM(kv, 0),
-                         PyTuple_GET_ITEM(kv, 1))) {
+                         PyTuple_GET_ITEM(kv, 1), nullptr)) {
             Py_DECREF(seq);
             return nullptr;
         }
@@ -5366,6 +5648,8 @@ PyMethodDef kMethods[] = {
      "BERT-tokenize a batch of ASCII texts (None marks python fallback)"},
     {"filter_batch", py_filter_batch, METH_VARARGS,
      "keep updates whose (key, values) satisfy the predicate"},
+    {"rows_with_error", py_rows_with_error, METH_VARARGS,
+     "select updates whose values contain the sentinel (identity compare)"},
     {"set_pointer_type", py_set_pointer_type, METH_O,
      "register the Pointer class for type-tagged hashing"},
     {"set_json_type", py_set_json_type, METH_O,
@@ -5374,6 +5658,8 @@ PyMethodDef kMethods[] = {
      "register the Update class for binary exchange frames"},
     {"pack_updates", py_pack_updates, METH_O,
      "serialize an update batch to a tagged binary frame"},
+    {"pack_updates_into", py_pack_updates_into, METH_VARARGS,
+     "append an update frame to a bytearray; returns appended byte count"},
     {"capture_batch", py_capture_batch, METH_VARARGS,
      "apply an update batch to capture state (stream list + rows dict)"},
     {"pack_kv", py_pack_kv, METH_O,

@@ -1,4 +1,4 @@
-"""Multi-worker execution: worker topology + collective exchange.
+"""Multi-worker execution: worker topology + pipelined collective exchange.
 
 The reference scales out by running the identical dataflow on every worker
 and exchanging records so that each stateful operator only keeps the rows
@@ -15,10 +15,25 @@ capability for the epoch-synchronous engine:
   every worker deposits one outbox per destination worker and receives the
   concatenation of what all workers sent it, merged in global worker order
   (deterministic, so N-worker runs produce the same output as 1-worker).
-- ``allgather(slot, obj)`` — small-object gather used for the epoch-cut
-  consensus: every worker receives the list of all workers' statuses and
-  applies the same decision function, so no asymmetric coordinator
-  broadcast is needed.
+- ``round_statuses(round_no, obj)`` — the per-round epoch-cut consensus:
+  every worker receives the list of all workers' statuses and applies the
+  same pure decision function, so no asymmetric coordinator broadcast is
+  needed.  This is the ONLY synchronization rendezvous on the steady-state
+  path — data exchanges are mailbox waits on the frames themselves.
+- ``allgather(slot, obj)`` — small-object gather for O(1) run-boundary
+  agreements (replay length, snapshot presence, final error log).
+
+Communication is PIPELINED rather than lock-step (the timely exchange
+pusher/puller split, ``external/timely-dataflow/communication/``): a
+dedicated sender thread per peer drains an outbound queue and coalesces
+everything queued into one writev-style transmission (so an epoch's
+per-operator frames and the round's status message share syscalls), and
+the per-peer reader threads deserialize frames into slot-keyed mailboxes
+as they arrive — serialization, transmission, and deserialization overlap
+operator compute instead of bracketing it.  Update payloads travel in the
+native binary codec (``pack_updates_into``/``unpack_updates``) appended
+straight into a reusable transmission buffer; without the native module
+they fall back to pickled plain tuples.
 
 A worker failure surfaces as a broken socket on every peer, failing the
 whole run — the reference behaves the same (a worker panic aborts the
@@ -32,6 +47,7 @@ import socket
 import struct
 import threading
 import time as _time
+from collections import deque
 from typing import Any, Callable
 
 from pathway_tpu.internals import keys as K
@@ -50,11 +66,138 @@ def stable_shard(*values: Any) -> int:
         return int(K.ref_scalar(repr(values)))
 
 
+# message kinds inside a transmission (see _PeerSender._encode_msg):
+#   transmission := [u64 body_len] body
+#   body         := [u32 n_msgs] msg*
+#   msg          := [u32 slot_len] slot_pickle [u8 kind] payload
+_K_OBJ = 0      # [u64 len] pickle — statuses, gathers, control objects
+_K_UPDATES = 1  # [u16 n_src][u16 n_dst] ([u64 len] packed_updates)* — binary
+_K_PLAIN = 2    # [u64 len] pickle of plain (int_key, values, diff) boxes
+
+
+class _PeerSender(threading.Thread):
+    """Outbound half of one peer link: drains a queue of (slot, kind,
+    payload) messages and ships everything queued at each wake as ONE
+    length-prefixed transmission (coalesced framing — an epoch's operator
+    frames and the round's status message share a single ``sendall``).
+    Serialization happens here, off the worker threads, into a buffer
+    whose capacity persists across epochs (no per-epoch allocation churn).
+    """
+
+    def __init__(self, peer: int, sock: socket.socket, links: "_ProcessLinks"):
+        super().__init__(daemon=True, name=f"pw-cluster-send-{peer}")
+        self.peer = peer
+        self.sock = sock
+        self.links = links
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._buf = bytearray()
+
+    def enqueue(self, slot: Any, kind: int, payload: Any) -> None:
+        with self._cv:
+            self._q.append((slot, kind, payload))
+            self._cv.notify()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+
+    def run(self) -> None:
+        links = self.links
+        try:
+            while True:
+                with self._cv:
+                    while not self._q and not self._stop:
+                        self._cv.wait()
+                    if not self._q:
+                        return  # stopped and drained
+                    items = list(self._q)
+                    self._q.clear()
+                # thread_time, not perf_counter: wall time in a helper
+                # thread mostly measures GIL waits while the workers run;
+                # this thread's own CPU is the compute it displaces
+                t0 = _time.thread_time()
+                body = self._encode(items)
+                t1 = _time.thread_time()
+                self.sock.sendall(body)
+                t2 = _time.thread_time()
+                st = links.stats
+                with links.stats_lock:
+                    st["transmissions"] += 1
+                    st["frames_sent"] += len(items)
+                    st["frames_coalesced"] += len(items) - 1
+                    st["bytes_sent"] += len(body)
+                    st["pack_ms"] += (t1 - t0) * 1e3
+                    st["send_ms"] += (t2 - t1) * 1e3
+        except Exception as e:  # socket OR encode failure: fail loudly
+            links._fail(f"send link to process {self.peer} lost: {e!r}")
+
+    # ------------------------------------------------------------------
+    def _encode(self, items: list) -> bytearray:
+        buf = self._buf
+        del buf[:]  # reset length, keep capacity across epochs
+        buf += b"\x00" * 12  # u64 body_len + u32 n_msgs, patched below
+        native = _native_mod.load()
+        for slot, kind, payload in items:
+            self._encode_msg(buf, slot, kind, payload, native)
+        struct.pack_into("<QI", buf, 0, len(buf) - 8, len(items))
+        return buf
+
+    @staticmethod
+    def _encode_msg(
+        buf: bytearray, slot: Any, kind: int, payload: Any, native: Any
+    ) -> None:
+        slot_data = pickle.dumps(slot, protocol=pickle.HIGHEST_PROTOCOL)
+        buf += struct.pack("<I", len(slot_data))
+        buf += slot_data
+        if kind == _K_OBJ:
+            data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+            buf += struct.pack("<BQ", _K_OBJ, len(data))
+            buf += data
+            return
+        # update boxes: payload[src_tid][dst_tid] is a list of Updates.
+        # Binary frames append straight into the transmission buffer (one
+        # C++ pass per box, length patched after the fact); a box the
+        # codec rejects rolls the whole msg back to the pickled fallback
+        # so the peer never sees a torn frame.
+        mark = len(buf)
+        if native is not None:
+            try:
+                n_src = len(payload)
+                n_dst = len(payload[0]) if n_src else 0
+                buf += struct.pack("<BHH", _K_UPDATES, n_src, n_dst)
+                pack_into = getattr(native, "pack_updates_into", None)
+                for row in payload:
+                    for box in row:
+                        at = len(buf)
+                        buf += b"\x00" * 8
+                        if pack_into is not None:
+                            n = pack_into(box, buf)
+                        else:
+                            data = native.pack_updates(box)
+                            buf += data
+                            n = len(data)
+                        struct.pack_into("<Q", buf, at, n)
+                return
+            except Exception:
+                del buf[mark:]
+        plain = [
+            [[(int(u[0]), u[1], u[2]) for u in box] for box in row]
+            for row in payload
+        ]
+        data = pickle.dumps(plain, protocol=pickle.HIGHEST_PROTOCOL)
+        buf += struct.pack("<BQ", _K_PLAIN, len(data))
+        buf += data
+
+
 class _ProcessLinks:
     """TCP full mesh between processes.  Process p listens on
     ``first_port + p``; every pair is connected once (higher pid dials
-    lower pid).  Frames are length-prefixed pickles of ``(slot, payload)``;
-    a reader thread per peer deposits frames into a slot-keyed inbox."""
+    lower pid).  Each link runs a sender thread (outbound queue, coalesced
+    transmissions) and a reader thread that decodes arriving frames into a
+    slot-keyed mailbox — ``recv_from_all`` is a pure mailbox wait."""
 
     _CONNECT_TIMEOUT_S = 30.0
 
@@ -62,10 +205,21 @@ class _ProcessLinks:
         self.process_id = process_id
         self.n_processes = n_processes
         self._socks: dict[int, socket.socket] = {}
-        self._send_locks: dict[int, threading.Lock] = {}
+        self._senders: dict[int, _PeerSender] = {}
         self._inbox: dict[Any, dict[int, Any]] = {}
         self._cv = threading.Condition()
         self._failed: str | None = None
+        self.stats: dict[str, Any] = {
+            "transmissions": 0,
+            "frames_sent": 0,
+            "frames_coalesced": 0,
+            "bytes_sent": 0,
+            "bytes_recv": 0,
+            "pack_ms": 0.0,
+            "send_ms": 0.0,
+            "unpack_ms": 0.0,
+        }
+        self.stats_lock = threading.Lock()
 
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -87,7 +241,9 @@ class _ProcessLinks:
                 f"({len(self._socks)}/{n_processes - 1} peers)"
             )
         for peer, sock in self._socks.items():
-            self._send_locks[peer] = threading.Lock()
+            sender = _PeerSender(peer, sock, self)
+            self._senders[peer] = sender
+            sender.start()
             threading.Thread(
                 target=self._read_loop, args=(peer, sock), daemon=True
             ).start()
@@ -131,26 +287,118 @@ class _ProcessLinks:
             buf += chunk
         return buf
 
+    @staticmethod
+    def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+        got = 0
+        n = len(view)
+        while got < n:
+            r = sock.recv_into(view[got:])
+            if not r:
+                raise ConnectionError("peer closed")
+            got += r
+
+    def _fail(self, msg: str) -> None:
+        with self._cv:
+            if self._failed is None:
+                self._failed = msg
+            self._cv.notify_all()
+
     def _read_loop(self, peer: int, sock: socket.socket) -> None:
+        native = _native_mod.load()
+        header = bytearray(8)
+        header_view = memoryview(header)
+        body = bytearray(1 << 16)  # grows to the largest transmission seen
         try:
             sock.settimeout(None)
             while True:
-                header = self._recv_exact(sock, 8)
-                (n,) = struct.unpack("<Q", header)
-                frame = pickle.loads(self._recv_exact(sock, n))
-                slot, payload = frame
+                self._recv_exact_into(sock, header_view)
+                (body_len,) = struct.unpack_from("<Q", header, 0)
+                if body_len > len(body):
+                    body = bytearray(body_len)
+                mv = memoryview(body)[:body_len]
+                self._recv_exact_into(sock, mv)
+                t0 = _time.thread_time()  # CPU displaced, not GIL waits
+                deposits = self._decode(mv, native)
+                dt = (_time.thread_time() - t0) * 1e3
+                with self.stats_lock:
+                    self.stats["bytes_recv"] += 8 + body_len
+                    self.stats["unpack_ms"] += dt
                 with self._cv:
-                    self._inbox.setdefault(slot, {})[peer] = payload
+                    box = self._inbox
+                    for slot, payload in deposits:
+                        box.setdefault(slot, {})[peer] = payload
                     self._cv.notify_all()
-        except (ConnectionError, OSError) as e:
-            with self._cv:
-                self._failed = f"link to process {peer} lost: {e!r}"
-                self._cv.notify_all()
+        except RuntimeError as e:
+            self._fail(str(e))
+        except Exception as e:  # socket OR decode failure: fail loudly
+            self._fail(f"link to process {peer} lost: {e!r}")
 
-    def send(self, peer: int, slot: Any, payload: Any) -> None:
-        data = pickle.dumps((slot, payload), protocol=pickle.HIGHEST_PROTOCOL)
-        with self._send_locks[peer]:
-            self._socks[peer].sendall(struct.pack("<Q", len(data)) + data)
+    @staticmethod
+    def _decode(mv: memoryview, native: Any) -> list:
+        """Decode one transmission into [(slot, payload)]; update payloads
+        come out as fully-built ``Update`` lists (deserialization happens
+        here on the reader thread, overlapping worker compute)."""
+        (n_msgs,) = struct.unpack_from("<I", mv, 0)
+        off = 4
+        out = []
+        for _ in range(n_msgs):
+            (slot_len,) = struct.unpack_from("<I", mv, off)
+            off += 4
+            slot = pickle.loads(mv[off : off + slot_len])
+            off += slot_len
+            kind = mv[off]
+            off += 1
+            if kind == _K_UPDATES:
+                if native is None:
+                    # peer packed binary frames we cannot parse (native
+                    # load failed only on THIS process, e.g. a corrupted
+                    # build cache): fail loudly rather than guess
+                    raise RuntimeError(
+                        "cluster exchange: peer sent binary frames but "
+                        "the native module is unavailable in this process"
+                    )
+                n_src, n_dst = struct.unpack_from("<HH", mv, off)
+                off += 4
+                unpack = native.unpack_updates
+                boxes = []
+                for _s in range(n_src):
+                    row = []
+                    for _d in range(n_dst):
+                        (blen,) = struct.unpack_from("<Q", mv, off)
+                        off += 8
+                        row.append(unpack(mv[off : off + blen]))
+                        off += blen
+                    boxes.append(row)
+                out.append((slot, boxes))
+                continue
+            (dlen,) = struct.unpack_from("<Q", mv, off)
+            off += 8
+            obj = pickle.loads(mv[off : off + dlen])
+            off += dlen
+            if kind == _K_PLAIN:
+                from pathway_tpu.engine.stream import Update
+                from pathway_tpu.internals.keys import Pointer
+
+                obj = [
+                    [
+                        [Update(Pointer(k), v, d) for k, v, d in box]
+                        for box in row
+                    ]
+                    for row in obj
+                ]
+            out.append((slot, obj))
+        return out
+
+    # ------------------------------------------------------------------
+    def send_async(self, peer: int, slot: Any, obj: Any) -> None:
+        """Queue a pickled-object message; the sender thread coalesces it
+        with whatever else is outbound to this peer."""
+        self._senders[peer].enqueue(slot, _K_OBJ, obj)
+
+    def send_updates_async(self, peer: int, slot: Any, boxes: list) -> None:
+        """Queue an update-box frame (``boxes[src_tid][dst_tid]`` lists of
+        Updates); serialization happens on the sender thread."""
+        self._senders[peer].enqueue(slot, _K_UPDATES, boxes)
 
     def recv_from_all(self, slot: Any) -> dict[int, Any]:
         """Block until every peer delivered a payload for ``slot``."""
@@ -164,6 +412,8 @@ class _ProcessLinks:
                 self._cv.wait(timeout=1.0)
 
     def close(self) -> None:
+        for sender in self._senders.values():
+            sender.stop()
         for sock in self._socks.values():
             try:
                 sock.close()
@@ -179,8 +429,9 @@ class Cluster:
     """Worker topology + collectives for ``threads × processes`` workers.
 
     Worker global index = ``process_id * threads + thread_id``.  Exchange
-    within a process is shared memory; across processes one aggregated
-    frame per peer per collective.
+    within a process is shared memory; across processes frames travel on
+    per-peer sender threads and coalesce into one transmission per peer
+    per drain (usually one per epoch round on the steady-state path).
     """
 
     def __init__(
@@ -204,9 +455,28 @@ class Cluster:
         self._local: dict[Any, Any] = {}  # slot -> per-tid deposits
         self._merged: dict[Any, Any] = {}  # slot -> per-tid results
         self._lock = threading.Lock()
+        #: collective-level counters (thread 0 only mutates, so no lock);
+        #: transport counters live on the links — exchange_stats() merges
+        self._stats: dict[str, Any] = {
+            "exchange_calls": 0,
+            "allgather_calls": 0,
+            "status_rounds": 0,
+            "recv_wait_ms": 0.0,
+            "allgather_wait_ms": 0.0,
+            "status_wait_ms": 0.0,
+        }
 
     def worker_index(self, thread_id: int) -> int:
         return self.process_id * self.threads + thread_id
+
+    def exchange_stats(self) -> dict[str, Any]:
+        """Snapshot of the exchange-overhead probe: collective counts and
+        wait times plus transport pack/send/unpack times and volumes."""
+        st = dict(self._stats)
+        if self._links is not None:
+            with self._links.stats_lock:
+                st.update(self._links.stats)
+        return st
 
     # ------------------------------------------------------------------
     def exchange(
@@ -214,100 +484,52 @@ class Cluster:
     ) -> list:
         """All-to-all: ``outboxes[w]`` holds this worker's updates destined
         to global worker ``w``; returns the merged inbox for this worker,
-        concatenated in global source-worker order."""
+        concatenated in global source-worker order.
+
+        Outbound frames are queued to the per-peer sender threads (which
+        pack them in the native binary codec and coalesce them with any
+        other outbound traffic); the wait below is a mailbox wait on the
+        peers' DATA — the reader threads have already deserialized it.
+        """
         T, P = self.threads, self.processes
         with self._lock:
             self._local.setdefault(slot, {})[thread_id] = outboxes
         self._barrier.wait()
         if thread_id == 0:
+            st = self._stats
+            st["exchange_calls"] += 1
             local = self._local.pop(slot)
-            # remote frame: ("b", payload) with payload[src_tid][dst_tid]
-            # a binary update frame packed in one C++ pass (tagged
-            # scalars; see native pack_updates) — the reference's timely
-            # exchange serializes records in binary the same way
-            # (external/timely-dataflow/communication/).  Without the
-            # native module: ("p", nested lists of plain (int_key,
-            # values, diff) tuples) — pickling the Pointer int-subclass
-            # directly goes through per-object copyreg and measures ~6x
-            # slower.  In-process workers share memory and skip all of
-            # this.
             if self._links is not None:
-                native = _native_mod.load()
                 for peer in range(P):
                     if peer == self.process_id:
                         continue
-                    payload: Any = None
-                    if native is not None:
-                        try:
-                            payload = (
-                                "b",
-                                [
-                                    [
-                                        native.pack_updates(
-                                            local[src_tid][peer * T + dst_tid]
-                                        )
-                                        for dst_tid in range(T)
-                                    ]
-                                    for src_tid in range(T)
-                                ],
-                            )
-                        except Exception:
-                            payload = None
-                    if payload is None:
-                        payload = (
-                            "p",
-                            [
-                                [
-                                    [
-                                        (int(u[0]), u[1], u[2])
-                                        for u in local[src_tid][peer * T + dst_tid]
-                                    ]
-                                    for dst_tid in range(T)
-                                ]
-                                for src_tid in range(T)
-                            ],
-                        )
-                    self._links.send(peer, slot, payload)
+                    boxes = [
+                        [
+                            local[src_tid][peer * T + dst_tid]
+                            for dst_tid in range(T)
+                        ]
+                        for src_tid in range(T)
+                    ]
+                    self._links.send_updates_async(peer, slot, boxes)
+                t0 = _time.perf_counter()
                 remote = self._links.recv_from_all(slot)
+                st["recv_wait_ms"] += (_time.perf_counter() - t0) * 1e3
             else:
                 remote = {}
             merged: list[list] = [[] for _ in range(T)]
             base = self.process_id * T
             for src_pid in range(P):
-                for src_tid in range(T):
-                    if src_pid == self.process_id:
+                if src_pid == self.process_id:
+                    for src_tid in range(T):
                         boxes = local[src_tid]
                         for dst_tid in range(T):
                             merged[dst_tid].extend(boxes[base + dst_tid])
-                    else:
-                        kind, payload = remote[src_pid]
-                        if kind == "b":
-                            native = _native_mod.load()
-                            if native is None:
-                                # peer packed binary frames we cannot parse
-                                # (native load failed only on THIS process,
-                                # e.g. a corrupted build cache): fail loudly
-                                # rather than AttributeError on None
-                                raise RuntimeError(
-                                    "cluster exchange: peer sent binary "
-                                    "frames but the native module is "
-                                    "unavailable in this process"
-                                )
-                            for dst_tid in range(T):
-                                merged[dst_tid].extend(
-                                    native.unpack_updates(
-                                        payload[src_tid][dst_tid]
-                                    )
-                                )
-                        else:
-                            from pathway_tpu.engine.stream import Update
-                            from pathway_tpu.internals.keys import Pointer
-
-                            for dst_tid in range(T):
-                                merged[dst_tid].extend(
-                                    Update(Pointer(k), v, d)
-                                    for k, v, d in payload[src_tid][dst_tid]
-                                )
+                else:
+                    rows = remote[src_pid]  # decoded by the reader thread
+                    for src_tid in range(T):
+                        row = rows[src_tid]
+                        for dst_tid in range(T):
+                            merged[dst_tid].extend(row[dst_tid])
             with self._lock:
                 self._merged[slot] = merged
         self._barrier.wait()
@@ -319,22 +541,28 @@ class Cluster:
                 self._merged.pop(slot, None)
         return result
 
-    def allgather(self, slot: Any, thread_id: int, obj: Any) -> list:
-        """Every worker contributes one object; every worker receives the
-        list of all objects in global worker order.  Epoch-cut consensus
-        applies the same pure decision function to this list everywhere."""
+    # ------------------------------------------------------------------
+    def _gather(
+        self, slot: Any, thread_id: int, obj: Any, calls_key: str, wait_key: str
+    ) -> list:
+        """Shared gather: every worker contributes one object; every worker
+        receives the list of all objects in global worker order."""
         T, P = self.threads, self.processes
         with self._lock:
             self._local.setdefault(slot, {})[thread_id] = obj
         self._barrier.wait()
         if thread_id == 0:
+            st = self._stats
+            st[calls_key] += 1
             local = self._local.pop(slot)
             if self._links is not None:
                 payload = [local[tid] for tid in range(T)]
                 for peer in range(P):
                     if peer != self.process_id:
-                        self._links.send(peer, slot, payload)
+                        self._links.send_async(peer, slot, payload)
+                t0 = _time.perf_counter()
                 remote = self._links.recv_from_all(slot)
+                st[wait_key] += (_time.perf_counter() - t0) * 1e3
             else:
                 remote = {}
             gathered: list = []
@@ -355,6 +583,25 @@ class Cluster:
                 self._merged.pop(slot, None)
                 self._local.pop(("__done__", slot), None)
         return gathered
+
+    def allgather(self, slot: Any, thread_id: int, obj: Any) -> list:
+        """Run-boundary gather (replay length, snapshot presence, final
+        error log): O(1) calls per run.  The per-round epoch-cut gather is
+        :meth:`round_statuses` — keeping them distinct keeps the steady
+        state at exactly one synchronization rendezvous per round."""
+        return self._gather(
+            slot, thread_id, obj, "allgather_calls", "allgather_wait_ms"
+        )
+
+    def round_statuses(self, round_no: int, thread_id: int, status: Any) -> list:
+        """Epoch-cut consensus for one scheduler round: gathers every
+        worker's status tuple.  The status message rides the same framed
+        stream as data — the sender thread coalesces it with any operator
+        frames still outbound (piggybacked consensus), and an idle round
+        sends it as a lone tiny transmission (the empty-frame fallback)."""
+        return self._gather(
+            ("s", round_no), thread_id, status, "status_rounds", "status_wait_ms"
+        )
 
     def close(self) -> None:
         self._barrier.abort()  # free local threads blocked in a collective

@@ -734,8 +734,19 @@ class GroupByNode(Node):
             # native fast path: reducer args are plain column positions
             # (fast_spec), so scanning the raw cells is exact; the C
             # partials skip sum-like error args and the multiset stores
-            # them symmetrically — extract is masked while poisoned
-            for u in batch:
+            # them symmetrically — extract is masked while poisoned.
+            # The sentinel scan itself runs in C too: a per-update Python
+            # any() over the cells costs more than the aggregation.
+            from pathway_tpu.internals import native as _native
+
+            native = _native.load()
+            err_rows = batch
+            if native is not None:
+                try:
+                    err_rows = native.rows_with_error(batch, api.ERROR)
+                except (native.Unsupported, AttributeError):
+                    err_rows = batch
+            for u in err_rows:
                 if not any(v is api.ERROR for v in u.values):
                     continue
                 gvals = self.group_fn(u.key, u.values)

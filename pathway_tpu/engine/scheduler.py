@@ -659,6 +659,7 @@ class Scheduler:
         for c in ctxs:
             c.error_sink_enabled = self._has_error_sink
         errors: list[BaseException] = []
+        self._active_cluster = cluster  # live exchange probe (monitoring)
 
         def work(tid: int) -> None:
             try:
@@ -676,6 +677,7 @@ class Scheduler:
         work(0)
         for w in workers:
             w.join()
+        self._active_cluster = None
         if errors:
             raise errors[0]
         # the returned (worker-0) context carries every worker's operator
@@ -689,6 +691,9 @@ class Scheduler:
         else:
             for c in ctxs[1:]:
                 ctxs[0].error_log.extend(c.error_log)
+        # exchange-overhead probe: pack/send/unpack/wait totals for this
+        # process's collectives, surfaced through monitoring and bench
+        ctxs[0].stats["exchange"] = cluster.exchange_stats()
         return ctxs[0]
 
     def _worker_loop(self, cluster: Cluster, tid: int, ctx: RunContext) -> None:
@@ -811,12 +816,16 @@ class Scheduler:
                 snap_elapsed_ms,
             )
             _tr0 = _time.monotonic()
-            statuses = cluster.allgather(("s", round_no), tid, status)
+            # round_statuses, NOT allgather: the per-round consensus rides
+            # the pipelined sender streams (piggybacked with data frames),
+            # keeping the steady state at ONE synchronization rendezvous
+            # per round; allgather stays for O(1) run-boundary agreements
+            statuses = cluster.round_statuses(round_no, tid, status)
             if _EPOCH_TRACE:
                 import sys as _sys
 
                 _sys.stderr.write(
-                    f"[trace w{w}] round {round_no} allgather "
+                    f"[trace w{w}] round {round_no} status gather "
                     f"{(_time.monotonic() - _tr0)*1e3:.1f}ms "
                     f"buf={sum(len(b) for b in buffers.values())} "
                     f"t={_time.monotonic():.3f}\n"

@@ -13,7 +13,7 @@ observable behaviour as the Python closures.  High-traffic
 ``.dt``/``.str``/``.num`` namespace methods lower to ``OP_METHOD`` with a
 native implementation per method (reference evaluates these enums in Rust,
 ``src/engine/expression.rs:26-340``); subtrees with no native lowering
-(UDF ``apply``, zoneinfo conversions, ``str.split``) fall back to their
+(UDF ``apply``, zoneinfo conversions) fall back to their
 ordinary ``_compile`` closure, embedded as a single ``CALL_PY``
 instruction; the rest of the expression still runs native.
 
@@ -55,10 +55,11 @@ OP_POINTER = 20
 OP_METHOD = 21
 
 # (method name, operand count) -> native method id — must mirror enum
-# VmMethod in native/pathway_native.cpp.  Methods not listed here (split,
-# to_utc, to_naive_in_timezone, from_timestamp, num.round, ...) run as
-# CALL_PY closures: either they need the zoneinfo database, or exact
-# float-rounding parity with the Python builtin is not worth replicating.
+# VmMethod in native/pathway_native.cpp.  Methods not listed here
+# (to_utc, to_naive_in_timezone, from_timestamp, ...) run as CALL_PY
+# closures: they need the zoneinfo database.  str.split maps BOTH
+# arities to one id — the native op distinguishes whitespace vs
+# separator splitting by operand count.
 _METHOD_IDS = {
     ("str.lower", 1): 0,
     ("str.upper", 1): 1,
@@ -114,6 +115,9 @@ _METHOD_IDS = {
     ("dt.weeks", 1): 45,
     ("num.abs", 1): 46,
     ("num.fill_na", 2): 47,
+    ("num.round", 2): 48,
+    ("str.split", 2): 49,  # whitespace split: (s, maxsplit)
+    ("str.split", 3): 49,  # separator split: (s, sep, maxsplit)
 }
 
 # binary op ids — must mirror enum VmBin

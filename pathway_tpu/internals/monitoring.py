@@ -38,6 +38,9 @@ class ProberStats:
     #: connector names whose source gave up under on_failure="degrade" —
     #: their downstream tables are stale, not complete
     stale_connectors: list[str] = field(default_factory=list)
+    #: exchange-overhead probe from cluster runs: collective counts plus
+    #: pack/send/unpack/wait milliseconds (empty for single-worker runs)
+    exchange: dict[str, Any] = field(default_factory=dict)
 
 
 def collect_stats(sched: Any) -> ProberStats:
@@ -71,7 +74,20 @@ def collect_stats(sched: Any) -> ProberStats:
         stale_connectors=sorted(
             name for name, c in connectors.items() if c.get("stale")
         ),
+        exchange=_exchange_stats(sched, ctx),
     )
+
+
+def _exchange_stats(sched: Any, ctx: Any) -> dict[str, Any]:
+    """Live exchange probe while a cluster run is active; the final
+    snapshot stashed on the context afterwards."""
+    cluster = getattr(sched, "_active_cluster", None)
+    if cluster is not None:
+        try:
+            return cluster.exchange_stats()
+        except Exception:
+            pass
+    return dict(ctx.stats.get("exchange", {}))
 
 
 def start_dashboard(

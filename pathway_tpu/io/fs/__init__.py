@@ -39,6 +39,36 @@ def _list_files(path: str) -> list[str]:
     return [path] if os.path.exists(path) else []
 
 
+def _nonempty_lines_before(f, nbytes: int, block: int) -> int:
+    """Count non-empty lines in the first ``nbytes`` of an open binary
+    file — the global line-seq base for a byte-range share (row keys hash
+    the global sequence number, so a worker starting mid-file must know
+    how many lines precede it).  Newline counting is memchr-speed with no
+    per-line allocation; only blocks actually containing empty lines pay
+    a split."""
+    count = 0
+    prev_nl = True  # start-of-file behaves like "just after a newline"
+    left = nbytes
+    while left > 0:
+        b = f.read(min(block, left))
+        if not b:
+            break
+        left -= len(b)
+        if b"\n\n" not in b and not (prev_nl and b.startswith(b"\n")):
+            # no empty line anywhere: every newline ends a non-empty line
+            count += b.count(b"\n")
+        else:
+            parts = b.split(b"\n")
+            if len(parts) > 1:
+                # parts[0] closes a line opened earlier (non-empty if it
+                # has bytes here or had any before this block)
+                if parts[0] or not prev_nl:
+                    count += 1
+                count += sum(1 for p in parts[1:-1] if p)
+        prev_nl = b.endswith(b"\n")
+    return count
+
+
 class _FilesSource(RowSource):
     """Reads lines of files under a path; in streaming mode polls for new
     files and appended lines (reference filesystem scanner + dir watching)."""
@@ -84,12 +114,14 @@ class _FilesSource(RowSource):
         self._part = (0, 1)
 
     def partition(self, worker: int, n_workers: int) -> "_FilesSource | None":
-        """Disjoint LINE-INDEX share per worker: with a stateless parser
-        each worker parses only its 1/n of the lines (stateful parsers see
-        every line and filter at emit).  Row keys are identical to a
-        single-worker run, so persistence resume and N-vs-1-worker outputs
-        stay exact.  Downstream placement is the consumers' business —
-        every routed operator re-exchanges its input."""
+        """Disjoint share per worker: static files with stateless parsers
+        split by BYTE RANGE (each worker reads only its 1/n of the file);
+        streaming appends fall back to the interleaved line-index share
+        (stateful parsers see every line and filter at emit).  Row keys
+        are identical to a single-worker run either way, so persistence
+        resume and N-vs-1-worker outputs stay exact.  Downstream placement
+        is the consumers' business — every routed operator re-exchanges
+        its input."""
         import copy
 
         sub = copy.copy(self)
@@ -112,6 +144,23 @@ class _FilesSource(RowSource):
             else None
         )
         w, n = self._part
+        # static files with stateless parsers partition by BYTE RANGE:
+        # the interleaved line share makes every worker read AND split the
+        # whole file (the split allocates one object per line), a fixed
+        # per-process cost that grows with worker count.  A byte range
+        # reads 1/n of the file; the seq base for key stability comes
+        # from a newline count over the prefix (no allocation).  Line
+        # ownership changes, but keys hash the global line seq, so the
+        # union of shares is byte-identical to a single-worker run.
+        byte_range = None
+        if (
+            n > 1
+            and start_offset == 0
+            and self.mode == "static"
+            and self._stateless_parser
+        ):
+            size = os.path.getsize(fp)
+            byte_range = (size * w // n, size * (w + 1) // n)
 
         def emit_rows(rows: list, line_seqs: list[int]) -> None:
             nonlocal chunk
@@ -171,13 +220,17 @@ class _FilesSource(RowSource):
             if not lines:
                 return
             emit_filter = False
-            if n > 1 and self._stateless_parser:
+            if byte_range is not None:
+                # byte-range share: every line handed to us is owned
+                owned_seqs: "list[int] | range" = range(
+                    base, base + len(lines)
+                )
+                owned_lines = lines
+            elif n > 1 and self._stateless_parser:
                 # owned line indices form an arithmetic progression:
                 # first index i with (base + i) % n == w, then every n-th
                 first = (w - base) % n
-                owned_seqs: "list[int] | range" = range(
-                    base + first, base + len(lines), n
-                )
+                owned_seqs = range(base + first, base + len(lines), n)
                 owned_lines = lines[first::n]
             else:
                 owned_seqs = range(base, base + len(lines))
@@ -220,6 +273,72 @@ class _FilesSource(RowSource):
         # with block reads), splitting on b"\n"; only COMPLETE lines are
         # consumed in streaming mode (a writer mid-append retries later)
         with open(fp, "rb") as f:
+            if byte_range is not None:
+                lo, hi = byte_range
+                start = 0
+                if lo > 0:
+                    # a line spanning the lo boundary belongs to the
+                    # worker owning its first byte: skip to the first line
+                    # START at/after lo.  Seeking to lo-1 makes a boundary
+                    # landing exactly on a line start discard nothing (the
+                    # byte at lo-1 is then the previous line's newline).
+                    start = size  # no line starts here: emit nothing
+                    f.seek(lo - 1)
+                    probe = lo - 1
+                    while True:
+                        data = f.read(_BLOCK)
+                        if not data:
+                            break
+                        nl = data.find(b"\n")
+                        if nl >= 0:
+                            start = probe + nl + 1
+                            break
+                        probe += len(data)
+                f.seek(0)
+                seq = _nonempty_lines_before(f, start, _BLOCK)
+                f.seek(start)
+                offset = start
+                while offset < hi:
+                    data = f.read(_BLOCK)
+                    if not data:
+                        break
+                    at_eof = len(data) < _BLOCK
+                    cut = -1
+                    if offset + len(data) > hi:
+                        # the line containing byte hi-1 is the last one
+                        # owned; consume through its newline and stop
+                        cut = data.find(b"\n", hi - 1 - offset)
+                    if cut >= 0:
+                        complete = data[: cut + 1]
+                        f.seek(offset + len(complete))
+                    elif at_eof:
+                        complete = data  # static: unterminated tail too
+                    else:
+                        nl = data.rfind(b"\n")
+                        if nl < 0:
+                            # single line longer than the block: keep
+                            # reading until its newline (or EOF)
+                            parts = [data]
+                            while True:
+                                more = f.read(_BLOCK)
+                                if not more:
+                                    break
+                                mnl = more.find(b"\n")
+                                if mnl >= 0:
+                                    parts.append(more[: mnl + 1])
+                                    break
+                                parts.append(more)
+                            complete = b"".join(parts)
+                        else:
+                            complete = data[: nl + 1]
+                        f.seek(offset + len(complete))
+                    parse_and_emit(complete)
+                    offset += len(complete)
+                    if cut >= 0:
+                        break
+                if chunk:
+                    add_many(chunk)
+                return size, seq
             f.seek(start_offset)
             offset = start_offset
             while True:

@@ -1,0 +1,51 @@
+"""``bench.py --smoke`` is the benchmark driver's own CI check: a
+seconds-long run over a tiny corpus that exercises the host-plane
+sections (including the multi-process exchange probe) end to end and
+must emit the driver contract — the LAST stdout line is one JSON object.
+Keeps the committed BENCH numbers honest: if the driver rots, this fails
+in tier-1 instead of at artifact-refresh time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_emits_wellformed_metrics():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        env=env,
+        capture_output=True,
+        timeout=240,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+    lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+    assert lines, "no stdout from bench.py --smoke"
+    doc = json.loads(lines[-1])  # driver contract: last line is the JSON
+
+    assert doc["smoke"] is True
+    assert doc["metric"] == "smoke_wordcount_rows_per_sec"
+    assert isinstance(doc["value"], (int, float)) and doc["value"] > 0
+    extra = doc["extra"]
+    # the pipelined-exchange probe ran: both cluster sizes and the
+    # overhead/efficiency keys the README rows trace back to
+    for key in (
+        "wordcount_rows_per_sec",
+        "wordcount_1proc_rows_per_sec",
+        "wordcount_multiprocess_rows_per_sec",
+        "wordcount_exchange_overhead_pct",
+        "wordcount_cpu_normalized_efficiency_2proc",
+        "select_rows_per_sec",
+        "strdt_rows_per_sec",
+    ):
+        assert isinstance(extra[key], (int, float)), key
+    stats = extra["wordcount_exchange_stats"]
+    assert stats["transmissions"] > 0
+    assert stats["status_rounds"] > 0

@@ -648,6 +648,56 @@ def test_method_num_parity(native):
     )
 
 
+def test_method_num_round_parity(native):
+    rows = [
+        (2.5, 0, 0), (3.5, 0, 0), (-2.5, 0, 0), (2.675, 2, 0),
+        (1.0005, 3, 0), (-0.5, 0, 0), (0.0, 0, 0),
+        (float("nan"), 0, 0), (float("inf"), 1, 0), (float("-inf"), 0, 0),
+        (123456.789, -2, 0), (5, 0, 0), (-7, 3, 0), (2**100, 2, 0),
+        (12345, -2, 0),                # negative ndigits on an int
+        (True, 0, 0),                  # bool: int.__round__ keeps int
+        (None, 0, 0), (E, 0, 0),
+        ("x", 0, 0),                   # non-numeric -> ERROR
+        (1.5, True, 0),                # bool ndigits is a valid int
+        (1.5, None, 0),                # round(x, None) -> int, both paths
+        (1.5, 2.0, 0),                 # float ndigits -> ERROR
+        (7, 2**70, 0), (1.5, 2**70, 0),  # ndigits beyond C long
+    ]
+    exprs = [
+        X.num.round(), X.num.round(0), X.num.round(1), X.num.round(2),
+        X.num.round(-1), X.num.round(-2), X.num.round(Y),
+    ]
+    _assert_parity_rows(native, exprs, rows)
+
+
+def test_method_str_split_parity(native):
+    rows = [
+        ("a b  c", " ", 0),
+        ("  lead and trail  ", " ", 1),
+        ("csv,data,,123", ",", 2),
+        ("", ",", 0),
+        ("one", "::", 5),
+        ("a::b::c::d", "::", 2),
+        ("tab\tnew\nline mix", ",", 0),
+        ("ÜniCödé Στρ x", "ö", 1),      # non-ASCII text and separator
+        ("x" * 50 + " " + "y" * 50, "x", 0),
+        ("a,b", "", 0),                  # empty sep -> ValueError -> ERROR
+        ("a b", ",", -1),
+        (None, ",", 0),
+        (E, ",", 0),
+        (123, ",", 0),                   # non-str subject -> ERROR
+        ("a b", 7, 0),                   # non-str sep -> ERROR
+        ("a b", ",", None),              # non-int maxsplit -> ERROR
+        ("a b", ",", True),              # bool maxsplit is a valid int
+        ("a,b c,d", ",", 2**70),         # maxsplit beyond ssize_t: ERROR
+    ]
+    exprs = [
+        X.str.split(), X.str.split(None, 1), X.str.split(" "),
+        X.str.split(",", 1), X.str.split(Y), X.str.split(Y, Z),
+    ]
+    _assert_parity_rows(native, exprs, rows)
+
+
 def test_method_fallbacks_still_lower(native):
     """Methods outside the native set embed as CALL_PY but the program
     still compiles (mixed native + fallback in one select)."""

@@ -199,6 +199,43 @@ def test_partitioned_reader_covers_all_rows(tmp_path):
     assert all(shares[w] for w in range(W))  # balanced enough to be nonempty
 
 
+def test_steady_state_one_barrier_per_round(tmp_path):
+    """Piggybacked epoch-cut consensus: the per-round status gather rides
+    the data streams (``round_statuses``), so ``allgather`` stays an O(1)
+    run-boundary primitive.  Counted directly — the steady-state path must
+    not regress to a second rendezvous per round."""
+    words = [f"w{i % 11}" for i in range(200)]
+    input_file = tmp_path / "w.jsonl"
+    input_file.write_text("\n".join(json.dumps({"word": w}) for w in words))
+
+    results: dict = {}
+    _wordcount_results(input_file, results)
+    sched = Scheduler(G.engine_graph, autocommit_ms=5)
+    cluster = Cluster(threads=2)
+    allgather_slots: list = []
+    orig_allgather = cluster.allgather
+
+    def counting_allgather(slot, thread_id, obj):
+        allgather_slots.append(slot)
+        return orig_allgather(slot, thread_id, obj)
+
+    cluster.allgather = counting_allgather  # type: ignore[method-assign]
+    try:
+        sched.run_cluster(cluster)
+    finally:
+        stats = cluster.exchange_stats()
+        cluster.close()
+
+    assert results  # the pipeline actually ran
+    assert stats["status_rounds"] >= 2
+    # every allgather is a known run-boundary slot — never a per-round one
+    boundary = {("replay_len",), ("snap_presence",), ("errlog", "final")}
+    assert set(allgather_slots) <= boundary, allgather_slots
+    # O(1) per run: both threads call each boundary slot once
+    assert len(allgather_slots) <= 2 * len(boundary)
+    assert stats["allgather_calls"] <= len(boundary)
+
+
 # ---------------------------------------------------------------------------
 # multi-process TCP cluster
 
@@ -393,3 +430,77 @@ def test_cluster_operator_snapshot_kill_restart(tmp_path):
     for w in words:
         expected[w] = expected.get(w, 0) + 1
     assert state == expected
+
+
+_STATS_PROGRAM = textwrap.dedent(
+    """
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.jsonlines.read({input!r}, schema=S, mode="static")
+    counts = t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+    pw.io.jsonlines.write(counts, {output!r})
+    ctx = pw.run(autocommit_duration_ms=20, monitoring_level="none")
+    print("EXCHANGE_STATS=" + json.dumps(ctx.stats.get("exchange", {{}})))
+    """
+)
+
+
+def test_two_process_exchange_stats(tmp_path):
+    """The pipelined transport reports its overhead probe: framed
+    transmissions flowed, the status consensus rode them every round, and
+    allgather stayed a run-boundary constant."""
+    words = ["apple", "pear", "apple", "plum", "apple", "pear"] * 20
+    input_file = tmp_path / "w.jsonl"
+    input_file.write_text("\n".join(json.dumps({"word": w}) for w in words))
+    output_file = tmp_path / "out.jsonl"
+
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        _STATS_PROGRAM.format(
+            repo=REPO, input=str(input_file), output=str(output_file)
+        )
+    )
+    env = dict(os.environ)
+    env["PATHWAY_THREADS"] = "2"
+    env["PATHWAY_PROCESSES"] = "2"
+    env["PATHWAY_FIRST_PORT"] = str(next_port(3))
+    procs = []
+    for pid in range(2):
+        e = dict(env)
+        e["PATHWAY_PROCESS_ID"] = str(pid)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(prog)],
+                env=e,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    all_stats = []
+    for p in procs:
+        out, err = p.communicate(timeout=90)
+        assert p.returncode == 0, err.decode()[-2000:]
+        line = next(
+            l for l in out.decode().splitlines() if l.startswith("EXCHANGE_STATS=")
+        )
+        all_stats.append(json.loads(line[len("EXCHANGE_STATS="):]))
+    assert _final_counts(output_file) == {"apple": 60, "pear": 40, "plum": 20}
+
+    for stats in all_stats:
+        # data moved over the framed transport and was accounted for
+        assert stats["transmissions"] > 0
+        assert stats["frames_sent"] >= stats["transmissions"]
+        assert stats["bytes_sent"] > 0 and stats["bytes_recv"] > 0
+        assert stats["exchange_calls"] > 0
+        # consensus piggybacked on the stream: many status rounds, but
+        # allgather held to the run-boundary slots only
+        assert stats["status_rounds"] >= 2
+        assert stats["allgather_calls"] <= 3
+        for key in ("pack_ms", "send_ms", "unpack_ms", "recv_wait_ms",
+                    "status_wait_ms"):
+            assert stats[key] >= 0.0

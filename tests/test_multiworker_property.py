@@ -1,0 +1,106 @@
+"""Property test: randomized pipelines are worker-count invariant.
+
+The scale-out contract (reference thread-count CI matrix,
+``tests/utils.py:37-50``) says ANY pipeline produces identical output at
+any worker count — not just the hand-picked ones in test_multiworker.py.
+Each seed deterministically generates a small pipeline from a closed
+grammar (filter / select / groupby-reduce / join, all mapping the column
+shape ``(k: str, a: int, b: int)`` to itself) and runs it at 1, 2 and 4
+thread workers; the captured rows, keys included, must match exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.cluster import Cluster
+from pathway_tpu.engine.graph import CaptureNode
+from pathway_tpu.engine.scheduler import Scheduler
+from pathway_tpu.internals.parse_graph import G
+
+_LETTERS = "abcdefg"
+
+
+class _S(pw.Schema):
+    k: str
+    a: int
+    b: int
+
+
+def _write_inputs(tmp_path, seed: int):
+    rng = random.Random(seed + 7919)  # data stream separate from pipeline
+    main = tmp_path / "main.jsonl"
+    main.write_text(
+        "\n".join(
+            json.dumps(
+                {
+                    "k": rng.choice(_LETTERS),
+                    "a": rng.randint(-50, 50),
+                    "b": rng.randint(0, 9),
+                }
+            )
+            for _ in range(60)
+        )
+    )
+    # lookup side: at most one row per key so joins stay 1:N
+    side = tmp_path / "side.jsonl"
+    side.write_text(
+        "\n".join(
+            json.dumps({"k": k, "a": rng.randint(-10, 10), "b": rng.randint(0, 9)})
+            for k in _LETTERS
+            if rng.random() < 0.8
+        )
+    )
+    return main, side
+
+
+def _apply_stage(rng: random.Random, t, side):
+    op = rng.choice(["filter", "select", "groupby", "join"])
+    if op == "filter":
+        c = rng.randint(-20, 20)
+        if rng.random() < 0.5:
+            return t.filter(t.a > c)
+        return t.filter(t.b != (c % 7))
+    if op == "select":
+        c = rng.randint(1, 5)
+        return t.select(t.k, a=t.a * c + t.b, b=t.b + 1)
+    if op == "groupby":
+        return t.groupby(t.k).reduce(
+            t.k,
+            a=pw.reducers.sum(t.a),
+            b=pw.reducers.max(t.b),
+        )
+    j = t.join(side, t.k == side.k)
+    return j.select(t.k, a=pw.left.a + pw.right.a, b=pw.left.b + pw.right.b)
+
+
+def _run_pipeline(seed: int, n_threads: int, main_file, side_file) -> dict:
+    G.clear()
+    rng = random.Random(seed)
+    t = pw.io.jsonlines.read(str(main_file), schema=_S, mode="static")
+    side = pw.io.jsonlines.read(str(side_file), schema=_S, mode="static")
+    for _ in range(rng.randint(2, 4)):
+        t = _apply_stage(rng, t, side)
+    cap = CaptureNode(G.engine_graph, t._node)
+    sched = Scheduler(G.engine_graph, autocommit_ms=10)
+    cluster = Cluster(threads=n_threads)
+    try:
+        ctx = sched.run_cluster(cluster)
+    finally:
+        cluster.close()
+    return dict(ctx.state(cap)["rows"])
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 5, 8])
+def test_random_pipeline_worker_count_invariant(tmp_path, seed):
+    main_file, side_file = _write_inputs(tmp_path, seed)
+    baseline = _run_pipeline(seed, 1, main_file, side_file)
+    for n_threads in (2, 4):
+        got = _run_pipeline(seed, n_threads, main_file, side_file)
+        assert got == baseline, (
+            f"seed {seed}: {n_threads}-worker run diverged from single-worker"
+        )
