@@ -640,9 +640,11 @@ def bench_streaming_latency(extra: dict) -> None:
     from pathway_tpu.internals.parse_graph import G
 
     results = {}
-    for rate in (10_000, 20_000, 30_000):
+    rates = (5_000,) if SMOKE else (10_000, 20_000, 30_000)
+    for rate in rates:
         G.clear()
-        n_msgs = min(rate * 2, 40_000)  # ~2s of traffic per rate step
+        # ~2s of traffic per rate step (~1s in smoke)
+        n_msgs = min(rate, 6_000) if SMOKE else min(rate * 2, 40_000)
 
         class Source(pw.io.python.ConnectorSubject):
             def run(self) -> None:
@@ -688,18 +690,46 @@ def bench_streaming_latency(extra: dict) -> None:
             return round(lats[min(len(lats) - 1, int(p * len(lats)))] * 1000.0, 1)
 
         achieved = n_msgs / wall
+        # per-stage breakdown straight from the scheduler's latency probe
+        # (ingest -> cut -> process -> sink -> e2e, streaming-safe
+        # log-bucketed histograms; same numbers /metrics exports)
+        sched = G.active_scheduler
+        stages = sched.latency.snapshot() if sched is not None else {}
         results[str(rate)] = {
             "p50_ms": pct(0.50),
             "p95_ms": pct(0.95),
             "p99_ms": pct(0.99),
             "achieved_msgs_per_sec": round(achieved),
+            "stages": stages,
         }
         log(
             f"streaming latency @ {rate} msg/s offered: "
             f"p50={pct(0.50)}ms p95={pct(0.95)}ms p99={pct(0.99)}ms "
             f"({achieved:.0f} msg/s achieved)"
         )
+        for name, st in sorted(stages.items()):
+            log(
+                f"  stage {name:>8}: p50={st['p50_ms']}ms "
+                f"p95={st['p95_ms']}ms p99={st['p99_ms']}ms "
+                f"(n={st['count']})"
+            )
     extra["streaming_latency_vs_rate"] = results
+    if SMOKE:
+        # smoke gate: with wakeup-driven cuts the tail tracks the median
+        # — a p99/p50 dispersion blowout means a wait loop regressed to
+        # timer polling somewhere
+        probe = results[str(rates[0])]
+        dispersion = probe["p99_ms"] / max(probe["p50_ms"], 0.1)
+        extra["streaming_latency_smoke"] = {
+            "p50_ms": probe["p50_ms"],
+            "p99_ms": probe["p99_ms"],
+            "dispersion_p99_over_p50": round(dispersion, 2),
+        }
+        if dispersion > 25.0:
+            raise RuntimeError(
+                f"streaming latency dispersion p99/p50 = {dispersion:.1f} "
+                "exceeds the 25x smoke bound"
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -736,10 +766,10 @@ def main() -> None:
         (bench_wordcount_multiprocess, "wordcount_multiprocess"),
         (bench_select, "select"),
         (bench_strdt, "strdt"),
+        (bench_streaming_latency, "streaming_latency"),
     ]
     if not SMOKE:
         sections += [
-            (bench_streaming_latency, "streaming_latency"),
             (bench_embed, "embed"),
         ]
     for fn, slug in sections:

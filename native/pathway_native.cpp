@@ -33,7 +33,9 @@
 #include <datetime.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cctype>
+#include <chrono>
 #include <climits>
 #include <cmath>
 #include <cstdint>
@@ -1858,6 +1860,10 @@ enum VmMethod : int64_t {
     M_NUM_ABS, M_NUM_FILL_NA,
     M_NUM_ROUND,                               // (x, decimals)
     M_STR_SPLIT,                               // (s, maxsplit) | (s, sep, maxsplit)
+    M_DT_FROM_TIMESTAMP,                       // (x, scale) -> naive UTC
+    M_DT_UTC_FROM_TIMESTAMP,                   // (x, scale) -> aware UTC
+    M_DT_TO_UTC,                               // (d, tz_table) naive local -> aware UTC
+    M_DT_TO_NAIVE_TZ,                          // (d, tz_table) aware -> naive local
     M_METHOD_COUNT,
 };
 
@@ -1917,6 +1923,81 @@ bool ensure_datetime_cache() {
     }
     g_dt_module_cache = mod;
     return true;
+}
+
+// epoch-microseconds -> datetime with the given tzinfo (Py_None = naive)
+// and fold; years outside datetime's [1, 9999] raise ValueError (the
+// Python closures raise the same way -> row ERROR either path).
+PyObject* dt_from_epoch_us(int64_t us_total, PyObject* tzinfo, int fold) {
+    int64_t days = us_total >= 0
+                       ? us_total / 86400000000LL
+                       : -((-us_total + 86399999999LL) / 86400000000LL);
+    int64_t rem = us_total - days * 86400000000LL;  // [0, 86400e6)
+    int64_t y, mo, dd;
+    civil_from_days(days, &y, &mo, &dd);
+    if (y < 1 || y > 9999) {
+        PyErr_SetString(PyExc_ValueError, "year out of range");
+        return nullptr;
+    }
+    int64_t s = rem / 1000000, us = rem % 1000000;
+    return PyDateTimeAPI->DateTime_FromDateAndTimeAndFold(
+        (int)y, (int)mo, (int)dd, (int)(s / 3600), (int)((s / 60) % 60),
+        (int)(s % 60), (int)us, tzinfo, fold, PyDateTimeAPI->DateTimeType);
+}
+
+// ---- packed tz transition tables (internals/tztable.py) --------------
+//
+// A full table is the 9-tuple (name, trans_utc, lkeys0, lkeys1, offs,
+// off_before, after_off|None, zoneinfo_instance, fallback): the pure
+// Python ``zoneinfo`` transition arrays packed as native int64 byte
+// strings.  ``offs[i]`` is the utc offset (whole seconds) that applies
+// AFTER transition i; ``lkeys{0,1}`` are the local-side bisection keys
+// for fold 0/1 (``ZoneInfo._trans_local``), ``trans_utc`` the utc-side
+// keys.  A 2-tuple (name, fallback) marks a zone that could not be
+// packed: every value takes the Python fallback (the exact expression
+// closure).  Timestamps outside the packed range with a rule footer
+// (``_TZStr`` — post-2037 for most DST zones) also fall back per value,
+// so native results are bit-identical to ``zoneinfo``'s answers.
+
+struct TzTable {
+    const int64_t* trans_utc;
+    const int64_t* lk0;
+    const int64_t* lk1;
+    const int64_t* offs;
+    int64_t n;
+    int64_t off_before;
+    bool has_after;
+    int64_t after_off;
+};
+
+bool tz_table_view(PyObject* tbl, TzTable* out) {
+    Py_ssize_t nb = -1;
+    const char* arrs[4] = {nullptr, nullptr, nullptr, nullptr};
+    for (int i = 0; i < 4; i++) {
+        PyObject* b = PyTuple_GET_ITEM(tbl, i + 1);
+        if (!PyBytes_Check(b) || (nb >= 0 && PyBytes_GET_SIZE(b) != nb) ||
+            PyBytes_GET_SIZE(b) % 8 != 0) {
+            PyErr_SetString(PyExc_TypeError, "bad tz table arrays");
+            return false;
+        }
+        nb = PyBytes_GET_SIZE(b);
+        arrs[i] = PyBytes_AS_STRING(b);
+    }
+    out->trans_utc = reinterpret_cast<const int64_t*>(arrs[0]);
+    out->lk0 = reinterpret_cast<const int64_t*>(arrs[1]);
+    out->lk1 = reinterpret_cast<const int64_t*>(arrs[2]);
+    out->offs = reinterpret_cast<const int64_t*>(arrs[3]);
+    out->n = nb / 8;
+    PyObject* ob = PyTuple_GET_ITEM(tbl, 5);
+    PyObject* oa = PyTuple_GET_ITEM(tbl, 6);
+    if (!PyLong_Check(ob) || (oa != Py_None && !PyLong_Check(oa))) {
+        PyErr_SetString(PyExc_TypeError, "bad tz table offsets");
+        return false;
+    }
+    out->off_before = PyLong_AsLongLong(ob);
+    out->has_after = oa != Py_None;
+    out->after_off = out->has_after ? PyLong_AsLongLong(oa) : 0;
+    return !PyErr_Occurred();
 }
 
 // ---- strptime (Python datetime.strptime semantics for the common
@@ -2897,6 +2978,177 @@ PyObject* vm_method_eval(int64_t mid, PyObject** args, int64_t nargs) {
             PyObject* tup = PyList_AsTuple(lst);
             Py_DECREF(lst);
             return tup;
+        }
+        case M_DT_FROM_TIMESTAMP:
+        case M_DT_UTC_FROM_TIMESTAMP: {
+            // (x, scale): datetime.fromtimestamp(x / scale, tz=utc)
+            // [.replace(tzinfo=None) for the naive variant].  Replicates
+            // CPython's conversion: modf split, fractional microseconds
+            // rounded half-even (_PyTime_ROUND_HALF_EVEN), carry
+            // normalized into [0, 1e6).
+            double xv;
+            if (PyFloat_Check(a0)) {
+                xv = PyFloat_AS_DOUBLE(a0);
+            } else if (PyLong_Check(a0)) {
+                xv = PyLong_AsDouble(a0);
+                if (xv == -1.0 && PyErr_Occurred()) return nullptr;
+            } else {
+                PyErr_SetString(PyExc_TypeError, "expected int|float");
+                return nullptr;
+            }
+            if (!PyFloat_Check(args[1])) {
+                PyErr_SetString(PyExc_TypeError, "scale must be float");
+                return nullptr;
+            }
+            double t = xv / PyFloat_AS_DOUBLE(args[1]);
+            // datetime covers years [1, 9999]; anything outside (incl.
+            // nan/inf) raises like fromtimestamp does -> row ERROR
+            if (!(t >= -62135596800.0 && t <= 253402300800.0)) {
+                PyErr_SetString(PyExc_OverflowError,
+                                "timestamp out of range");
+                return nullptr;
+            }
+            double intpart;
+            double usf = std::modf(t, &intpart) * 1e6;
+            double rounded = std::round(usf);
+            if (std::fabs(usf - rounded) == 0.5)
+                rounded = 2.0 * std::round(usf / 2.0);
+            int64_t secs = (int64_t)intpart;
+            int64_t us = (int64_t)rounded;
+            if (us >= 1000000) {
+                us -= 1000000;
+                secs += 1;
+            } else if (us < 0) {
+                us += 1000000;
+                secs -= 1;
+            }
+            if (!ensure_datetime_cache()) return nullptr;
+            return dt_from_epoch_us(
+                secs * 1000000 + us,
+                mid == M_DT_UTC_FROM_TIMESTAMP ? g_utc_singleton : Py_None,
+                0);
+        }
+        case M_DT_TO_UTC:
+        case M_DT_TO_NAIVE_TZ: {
+            // (d, tz_table): zoneinfo conversions over the packed
+            // transition tables (see TzTable above).  to_utc mirrors
+            // ZoneInfo._find_trans over the local-side keys (lookup
+            // ignores microseconds, like _get_local_timestamp);
+            // to_naive_in_timezone mirrors ZoneInfo.fromutc over the
+            // utc-side keys including its fold detection.
+            PyObject* tbl = args[1];
+            if (!PyTuple_Check(tbl) || (PyTuple_GET_SIZE(tbl) != 9 &&
+                                        PyTuple_GET_SIZE(tbl) != 2)) {
+                PyErr_SetString(PyExc_TypeError, "bad tz table");
+                return nullptr;
+            }
+            PyObject* fallback =
+                PyTuple_GET_ITEM(tbl, PyTuple_GET_SIZE(tbl) - 1);
+            if (!PyDateTime_Check(a0)) {
+                PyErr_SetString(PyExc_TypeError, "expected datetime");
+                return nullptr;
+            }
+            PyObject* tzinfo = PyDateTime_DATE_GET_TZINFO(a0);
+            bool to_utc = mid == M_DT_TO_UTC;
+            if (PyTuple_GET_SIZE(tbl) == 2 ||
+                (!to_utc && tzinfo == Py_None))  // naive astimezone =
+                                                 // system-local: Python
+                return PyObject_CallFunctionObjArgs(fallback, a0, nullptr);
+            if (!ensure_datetime_cache()) return nullptr;
+            TzTable T;
+            if (!tz_table_view(tbl, &T)) return nullptr;
+            int64_t days = days_from_civil(PyDateTime_GET_YEAR(a0),
+                                           PyDateTime_GET_MONTH(a0),
+                                           PyDateTime_GET_DAY(a0));
+            int64_t fsecs = (int64_t)PyDateTime_DATE_GET_HOUR(a0) * 3600 +
+                            PyDateTime_DATE_GET_MINUTE(a0) * 60 +
+                            PyDateTime_DATE_GET_SECOND(a0);
+            int64_t field_us = (days * 86400 + fsecs) * 1000000 +
+                               PyDateTime_DATE_GET_MICROSECOND(a0);
+            if (to_utc) {
+                // wall fields -> aware UTC; input tzinfo (if any) is
+                // discarded, exactly like d.replace(tzinfo=zone)
+                int64_t ts = days * 86400 + fsecs;
+                const int64_t* lk =
+                    PyDateTime_DATE_GET_FOLD(a0) ? T.lk1 : T.lk0;
+                int64_t off;
+                if (T.n == 0 || ts > lk[T.n - 1]) {
+                    if (!T.has_after)  // rule footer: per-value Python
+                        return PyObject_CallFunctionObjArgs(fallback, a0,
+                                                            nullptr);
+                    off = T.after_off;
+                } else if (ts < lk[0]) {
+                    off = T.off_before;
+                } else {
+                    int64_t idx =
+                        (int64_t)(std::upper_bound(lk, lk + T.n, ts) - lk) -
+                        1;
+                    off = T.offs[idx];
+                }
+                return dt_from_epoch_us(field_us - off * 1000000,
+                                        g_utc_singleton, 0);
+            }
+            // to_naive_in_timezone: aware -> naive local wall time.
+            // astimezone short-circuits when the input already carries
+            // the SAME zone instance (fields kept verbatim).
+            if (tzinfo == PyTuple_GET_ITEM(tbl, 7))
+                return PyDateTimeAPI->DateTime_FromDateAndTimeAndFold(
+                    PyDateTime_GET_YEAR(a0), PyDateTime_GET_MONTH(a0),
+                    PyDateTime_GET_DAY(a0), PyDateTime_DATE_GET_HOUR(a0),
+                    PyDateTime_DATE_GET_MINUTE(a0),
+                    PyDateTime_DATE_GET_SECOND(a0),
+                    PyDateTime_DATE_GET_MICROSECOND(a0), Py_None,
+                    PyDateTime_DATE_GET_FOLD(a0),
+                    PyDateTimeAPI->DateTimeType);
+            // input offset via Python (arbitrary tzinfo), the
+            // M_DT_TIMESTAMP pattern
+            PyObject* off_o = PyObject_CallMethod(a0, "utcoffset", nullptr);
+            if (off_o == nullptr) return nullptr;
+            if (off_o == Py_None) {
+                Py_DECREF(off_o);
+                return PyObject_CallFunctionObjArgs(fallback, a0, nullptr);
+            }
+            if (!PyDelta_Check(off_o)) {
+                Py_DECREF(off_o);
+                PyErr_SetString(PyExc_TypeError, "bad utcoffset");
+                return nullptr;
+            }
+            int64_t in_off_us =
+                ((int64_t)PyDateTime_DELTA_GET_DAYS(off_o) * 86400 +
+                 PyDateTime_DELTA_GET_SECONDS(off_o)) *
+                    1000000 +
+                PyDateTime_DELTA_GET_MICROSECONDS(off_o);
+            Py_DECREF(off_o);
+            int64_t utc_us = field_us - in_off_us;
+            // fromutc's lookup key: civil seconds of the utc-labelled
+            // datetime, i.e. floor(utc_us / 1e6)
+            int64_t ts = utc_us >= 0 ? utc_us / 1000000
+                                     : -((-utc_us + 999999) / 1000000);
+            int64_t off;
+            int fold = 0;
+            if (T.n >= 1 && ts < T.trans_utc[0]) {
+                off = T.off_before;
+            } else if (T.n == 0 || ts > T.trans_utc[T.n - 1]) {
+                // footer region: fixed-offset zones with no transitions
+                // are native; rule footers / post-last-transition go to
+                // Python (fromutc's corner branches)
+                if (T.n == 0 && T.has_after)
+                    off = T.after_off;
+                else
+                    return PyObject_CallFunctionObjArgs(fallback, a0,
+                                                        nullptr);
+            } else {
+                int64_t idx = (int64_t)(std::upper_bound(
+                                            T.trans_utc, T.trans_utc + T.n,
+                                            ts) -
+                                        T.trans_utc);  // >= 1
+                off = T.offs[idx - 1];
+                int64_t off_prev =
+                    idx >= 2 ? T.offs[idx - 2] : T.off_before;
+                fold = (off_prev - off) > (ts - T.trans_utc[idx - 1]) ? 1
+                                                                      : 0;
+            }
+            return dt_from_epoch_us(utc_us + off * 1000000, Py_None, fold);
         }
         default:
             PyErr_Format(PyExc_SystemError, "bad method id %lld",
@@ -5615,6 +5867,121 @@ PyObject* py_capture_batch(PyObject*, PyObject* args) {
     Py_RETURN_NONE;
 }
 
+// ---- per-stage latency instrumentation -------------------------------
+//
+// Streaming-safe latency histograms for the event-driven scheduler:
+// log-bucketed (8 sub-buckets per octave, ~12% resolution) so a
+// long-running pipeline aggregates unbounded samples in fixed memory
+// and p50/p95/p99 stay queryable at any moment.  Buckets are atomics:
+// connector reader threads, worker threads and the monitoring server
+// touch the same histogram concurrently.  The bucket function is
+// mirrored by the Python fallback in internals/monitoring.py.
+
+constexpr int kLatBuckets = 488;  // idx(2^62 ns) == 487
+
+struct LatHist {
+    std::atomic<uint64_t> buckets[kLatBuckets];
+    std::atomic<uint64_t> count{0};
+    std::atomic<int64_t> sum{0};
+    std::atomic<int64_t> maxv{0};
+    LatHist() {
+        for (auto& b : buckets) b.store(0, std::memory_order_relaxed);
+    }
+};
+
+inline int lat_bucket(int64_t ns) {
+    if (ns < 16) return ns < 0 ? 0 : (int)ns;
+    int msb = 63 - __builtin_clzll((uint64_t)ns);
+    return 16 + (msb - 4) * 8 + (int)((ns >> (msb - 3)) & 7);
+}
+
+// geometric bucket midpoint (exact for the 16 unit buckets)
+inline int64_t lat_bucket_rep(int idx) {
+    if (idx < 16) return idx;
+    int msb = 4 + (idx - 16) / 8;
+    int sub = (idx - 16) % 8;
+    int64_t lo = (1LL << msb) | ((int64_t)sub << (msb - 3));
+    return lo + (1LL << (msb - 3)) / 2;
+}
+
+int64_t mono_ns_now() {
+    return (int64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+}
+
+void lat_hist_free(PyObject* cap) {
+    delete static_cast<LatHist*>(
+        PyCapsule_GetPointer(cap, "pathway_tpu.lathist"));
+}
+
+PyObject* py_monotonic_ns(PyObject*, PyObject*) {
+    return PyLong_FromLongLong(mono_ns_now());
+}
+
+PyObject* py_hist_new(PyObject*, PyObject*) {
+    return PyCapsule_New(new LatHist(), "pathway_tpu.lathist",
+                         lat_hist_free);
+}
+
+inline LatHist* lat_hist_from(PyObject* cap) {
+    return static_cast<LatHist*>(
+        PyCapsule_GetPointer(cap, "pathway_tpu.lathist"));
+}
+
+PyObject* py_hist_record(PyObject*, PyObject* args) {
+    PyObject* cap;
+    long long ns;
+    if (!PyArg_ParseTuple(args, "OL", &cap, &ns)) return nullptr;
+    LatHist* h = lat_hist_from(cap);
+    if (h == nullptr) return nullptr;
+    if (ns < 0) ns = 0;
+    h->buckets[lat_bucket(ns)].fetch_add(1, std::memory_order_relaxed);
+    h->count.fetch_add(1, std::memory_order_relaxed);
+    h->sum.fetch_add(ns, std::memory_order_relaxed);
+    int64_t prev = h->maxv.load(std::memory_order_relaxed);
+    while (ns > prev &&
+           !h->maxv.compare_exchange_weak(prev, ns,
+                                          std::memory_order_relaxed)) {
+    }
+    Py_RETURN_NONE;
+}
+
+PyObject* py_hist_snapshot(PyObject*, PyObject* args) {
+    PyObject* cap;
+    if (!PyArg_ParseTuple(args, "O", &cap)) return nullptr;
+    LatHist* h = lat_hist_from(cap);
+    if (h == nullptr) return nullptr;
+    uint64_t counts[kLatBuckets];
+    uint64_t total = 0;
+    for (int i = 0; i < kLatBuckets; i++) {
+        counts[i] = h->buckets[i].load(std::memory_order_relaxed);
+        total += counts[i];
+    }
+    int64_t sum = h->sum.load(std::memory_order_relaxed);
+    int64_t maxv = h->maxv.load(std::memory_order_relaxed);
+    const double qs[3] = {0.50, 0.95, 0.99};
+    double out[3] = {0.0, 0.0, 0.0};
+    if (total > 0) {
+        for (int q = 0; q < 3; q++) {
+            double target = qs[q] * (double)total;
+            uint64_t cum = 0;
+            for (int i = 0; i < kLatBuckets; i++) {
+                cum += counts[i];
+                if ((double)cum >= target && cum > 0) {
+                    int64_t rep = lat_bucket_rep(i);
+                    out[q] = (double)(rep < maxv ? rep : maxv);
+                    break;
+                }
+            }
+        }
+    }
+    return Py_BuildValue(
+        "{s:K,s:L,s:L,s:d,s:d,s:d}", "count", (unsigned long long)total,
+        "sum_ns", (long long)sum, "max_ns", (long long)maxv, "p50_ns",
+        out[0], "p95_ns", out[1], "p99_ns", out[2]);
+}
+
 PyMethodDef kMethods[] = {
     {"ref_scalar", py_ref_scalar, METH_VARARGS,
      "128-bit key hash of the argument values"},
@@ -5685,6 +6052,14 @@ PyMethodDef kMethods[] = {
     {"hnsw_search", py_hnsw_search, METH_VARARGS,
      "batch ANN search: (slots, distances) per query"},
     {"hnsw_len", py_hnsw_len, METH_O, "live item count"},
+    {"monotonic_ns", py_monotonic_ns, METH_NOARGS,
+     "steady-clock nanoseconds (latency probe timestamps)"},
+    {"hist_new", py_hist_new, METH_NOARGS,
+     "new log-bucketed concurrent latency histogram"},
+    {"hist_record", py_hist_record, METH_VARARGS,
+     "record a nanosecond sample into a histogram"},
+    {"hist_snapshot", py_hist_snapshot, METH_VARARGS,
+     "count/sum/max and p50/p95/p99 of a histogram"},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef kModule = {PyModuleDef_HEAD_INIT, "pathway_native",

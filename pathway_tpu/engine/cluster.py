@@ -53,7 +53,43 @@ from typing import Any, Callable
 from pathway_tpu.internals import keys as K
 from pathway_tpu.internals import native as _native_mod
 
-__all__ = ["Cluster", "stable_shard"]
+__all__ = ["Cluster", "WakeupHub", "stable_shard"]
+
+
+class WakeupHub:
+    """Shared wakeup channel for the event-driven scheduler loops.
+
+    Every producer of scheduler-relevant work notifies the hub: connector
+    threads on enqueue, the exchange reader threads on frame arrival, any
+    worker depositing into a collective (so siblings parked between rounds
+    join the next round immediately), the GC pacer, and ``stop()``.  The
+    consumer side is a *generation wait*: a worker snapshots ``seq()``
+    BEFORE it drains its queues, and later parks in ``wait(seen, ...)`` —
+    if anything was produced in between, the generation already moved and
+    the wait returns immediately (no lost-wakeup window)."""
+
+    def __init__(self) -> None:
+        self._cv = threading.Condition()
+        self._seq = 0
+
+    def seq(self) -> int:
+        with self._cv:
+            return self._seq
+
+    def notify(self) -> None:
+        with self._cv:
+            self._seq += 1
+            self._cv.notify_all()
+
+    def wait(self, seen: int, timeout: float) -> bool:
+        """Park until the generation moves past ``seen`` (or timeout, the
+        autocommit-bounded heartbeat); True iff a wakeup arrived."""
+        with self._cv:
+            if self._seq != seen:
+                return True
+            if timeout > 0.0:
+                self._cv.wait(timeout)
+            return self._seq != seen
 
 
 def stable_shard(*values: Any) -> int:
@@ -201,9 +237,16 @@ class _ProcessLinks:
 
     _CONNECT_TIMEOUT_S = 30.0
 
-    def __init__(self, process_id: int, n_processes: int, first_port: int):
+    def __init__(
+        self,
+        process_id: int,
+        n_processes: int,
+        first_port: int,
+        hub: "WakeupHub | None" = None,
+    ):
         self.process_id = process_id
         self.n_processes = n_processes
+        self._hub = hub
         self._socks: dict[int, socket.socket] = {}
         self._senders: dict[int, _PeerSender] = {}
         self._inbox: dict[Any, dict[int, Any]] = {}
@@ -302,6 +345,8 @@ class _ProcessLinks:
             if self._failed is None:
                 self._failed = msg
             self._cv.notify_all()
+        if self._hub is not None:
+            self._hub.notify()
 
     def _read_loop(self, peer: int, sock: socket.socket) -> None:
         native = _native_mod.load()
@@ -328,6 +373,10 @@ class _ProcessLinks:
                     for slot, payload in deposits:
                         box.setdefault(slot, {})[peer] = payload
                     self._cv.notify_all()
+                if self._hub is not None:
+                    # frame arrival is a scheduler-relevant event: wake any
+                    # worker parked between rounds so it joins this round
+                    self._hub.notify()
         except RuntimeError as e:
             self._fail(str(e))
         except Exception as e:  # socket OR decode failure: fail loudly
@@ -401,7 +450,12 @@ class _ProcessLinks:
         self._senders[peer].enqueue(slot, _K_UPDATES, boxes)
 
     def recv_from_all(self, slot: Any) -> dict[int, Any]:
-        """Block until every peer delivered a payload for ``slot``."""
+        """Block until every peer delivered a payload for ``slot``.
+
+        A pure notified wait: the reader threads ``notify_all`` on every
+        deposit and ``_fail`` notifies on link loss, so no poll interval
+        is needed — the old ``wait(timeout=1.0)`` quantized the exchange
+        tail to the poll grid whenever a wakeup was missed."""
         with self._cv:
             while True:
                 if self._failed is not None:
@@ -409,7 +463,7 @@ class _ProcessLinks:
                 got = self._inbox.get(slot)
                 if got is not None and len(got) == self.n_processes - 1:
                     return self._inbox.pop(slot)
-                self._cv.wait(timeout=1.0)
+                self._cv.wait()
 
     def close(self) -> None:
         for sender in self._senders.values():
@@ -446,8 +500,15 @@ class Cluster:
         self.processes = processes
         self.process_id = process_id
         self.n_workers = threads * processes
+        #: shared wakeup channel: connector enqueues, frame arrivals,
+        #: collective deposits, the gc pacer and stop() all notify it;
+        #: the scheduler's idle branch parks on it instead of sleeping
+        self.wakeup = WakeupHub()
+        #: per-stage latency probe (set by the scheduler); exchange recv
+        #: waits are recorded here when present
+        self.latency: Any = None
         self._links = (
-            _ProcessLinks(process_id, processes, first_port)
+            _ProcessLinks(process_id, processes, first_port, hub=self.wakeup)
             if processes > 1
             else None
         )
@@ -492,6 +553,10 @@ class Cluster:
         peers' DATA — the reader threads have already deserialized it.
         """
         T, P = self.threads, self.processes
+        # exchange stage = this worker's whole all-to-all (barrier sync +
+        # mailbox recv + merge); recorded once per collective on thread 0
+        lat = self.latency if thread_id == 0 else None
+        t_x0 = _time.perf_counter() if lat is not None else 0.0
         with self._lock:
             self._local.setdefault(slot, {})[thread_id] = outboxes
         self._barrier.wait()
@@ -513,7 +578,8 @@ class Cluster:
                     self._links.send_updates_async(peer, slot, boxes)
                 t0 = _time.perf_counter()
                 remote = self._links.recv_from_all(slot)
-                st["recv_wait_ms"] += (_time.perf_counter() - t0) * 1e3
+                wait_s = _time.perf_counter() - t0
+                st["recv_wait_ms"] += wait_s * 1e3
             else:
                 remote = {}
             merged: list[list] = [[] for _ in range(T)]
@@ -539,6 +605,8 @@ class Cluster:
             merged[thread_id] = None  # type: ignore[call-overload]
             if all(m is None for m in merged):
                 self._merged.pop(slot, None)
+        if lat is not None:
+            lat.record("exchange", int((_time.perf_counter() - t_x0) * 1e9))
         return result
 
     # ------------------------------------------------------------------
@@ -550,6 +618,9 @@ class Cluster:
         T, P = self.threads, self.processes
         with self._lock:
             self._local.setdefault(slot, {})[thread_id] = obj
+        # a worker entering a collective is itself a wakeup: siblings
+        # parked in the scheduler's idle branch must join this round
+        self.wakeup.notify()
         self._barrier.wait()
         if thread_id == 0:
             st = self._stats
@@ -605,6 +676,7 @@ class Cluster:
 
     def close(self) -> None:
         self._barrier.abort()  # free local threads blocked in a collective
+        self.wakeup.notify()  # free threads parked in the idle branch
         if self._links is not None:
             self._links.close()
 

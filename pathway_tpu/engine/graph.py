@@ -1399,6 +1399,22 @@ class AsyncMapNode(Node):
 # Outputs
 
 
+def _record_sink_latency(ctx) -> None:
+    """Per-stage latency probe at a sink (sink = epoch cut -> delivery
+    here, e2e = earliest connector enqueue -> delivery); anchors are set
+    by the scheduler only for live streaming epochs."""
+    lat = getattr(ctx, "latency", None)
+    if lat is None:
+        return
+    done_ns = lat.now_ns()
+    cut_ns = getattr(ctx, "epoch_cut_ns", None)
+    if cut_ns is not None:
+        lat.record("sink", done_ns - cut_ns)
+    origin_ns = getattr(ctx, "epoch_origin_ns", None)
+    if origin_ns is not None:
+        lat.record("e2e", done_ns - origin_ns)
+
+
 class OutputNode(Node):
     """subscribe_table (reference ``src/engine/graph.rs:754``,
     ``SubscribeCallbacks`` ``:569``)."""
@@ -1429,6 +1445,7 @@ class OutputNode(Node):
                 self._on_change(u.key, u.values, time, u.diff)
         if inbatches[0]:
             ctx.state(self)["saw_data"] = True
+            _record_sink_latency(ctx)
         return []
 
     def on_time_end(self, ctx, time):
@@ -1525,6 +1542,8 @@ class CaptureNode(Node):
 
     def process(self, ctx, time, inbatches):
         st = ctx.state(self)
+        if inbatches[0]:
+            _record_sink_latency(ctx)
         native = _native.load()
         if native is not None:
             native.capture_batch(st["stream"], st["rows"], inbatches[0], time)

@@ -51,7 +51,14 @@ _EPOCH_TRACE = _os.environ.get("PATHWAY_EPOCH_TRACE") == "1"
 
 
 class ConnectorEvents:
-    """Callback bundle handed to a connector subject's reader thread."""
+    """Callback bundle handed to a connector subject's reader thread.
+
+    Every event carries a monotonic enqueue timestamp (5th tuple element)
+    so the scheduler's drain can measure queue residency (the "ingest"
+    latency stage), and every enqueue fires the optional ``wake`` hook —
+    in cluster mode that is the :class:`~pathway_tpu.engine.cluster.
+    WakeupHub`, so a parked worker loop reacts to arrival instead of
+    discovering it on the next poll tick."""
 
     #: with persistence, the number of already-replayed events this reader
     #: should skip (cooperative resume; see pathway_tpu.persistence)
@@ -63,10 +70,14 @@ class ConnectorEvents:
         node_id: int,
         stop_event: threading.Event | None = None,
         stats: dict | None = None,
+        now_ns: Callable[[], int] | None = None,
+        wake: Callable[[], None] | None = None,
     ):
         self._q = q
         self._node_id = node_id
         self._stop_event = stop_event
+        self._now_ns = now_ns if now_ns is not None else _time.monotonic_ns
+        self._wake = wake
         #: per-connector counters (reference src/connectors/monitoring.rs);
         #: approximate under concurrent readers — monitoring only
         self.stats = stats if stats is not None else {}
@@ -80,13 +91,18 @@ class ConnectorEvents:
         """True once the scheduler is shutting down; readers should return."""
         return self._stop_event is not None and self._stop_event.is_set()
 
+    def _put(self, kind: str, key: Any, values: Any) -> None:
+        self._q.put((self._node_id, kind, key, values, self._now_ns()))
+        if self._wake is not None:
+            self._wake()
+
     def add(self, key: Pointer, values: tuple) -> None:
         self.stats["rows"] += 1
-        self._q.put((self._node_id, "add", key, values))
+        self._put("add", key, values)
 
     def remove(self, key: Pointer, values: tuple) -> None:
         self.stats["retractions"] += 1
-        self._q.put((self._node_id, "remove", key, values))
+        self._put("remove", key, values)
 
     def add_many(self, rows: list) -> None:
         """Chunked ingest: ``rows`` is a list of (key, values) additions
@@ -96,15 +112,15 @@ class ConnectorEvents:
         scheduler's epoch work."""
         if rows:
             self.stats["rows"] += len(rows)
-            self._q.put((self._node_id, "batch", _build_adds(rows), None))
+            self._put("batch", _build_adds(rows), None)
 
     def commit(self) -> None:
         self.stats["commits"] += 1
-        self._q.put((self._node_id, "commit", None, None))
+        self._put("commit", None, None)
 
     def close(self) -> None:
         self.stats["closed"] = True
-        self._q.put((self._node_id, "close", None, None))
+        self._put("close", None, None)
 
 
 class Scheduler:
@@ -130,6 +146,23 @@ class Scheduler:
         )
         self.ctx.error_sink_enabled = self._has_error_sink
         self._stop = threading.Event()
+        #: per-stage latency probe (ingest/cut/process/exchange/sink/e2e);
+        #: native atomic histograms, surfaced via monitoring + /metrics
+        from pathway_tpu.internals.monitoring import LatencyProbe
+
+        self.latency = LatencyProbe()
+        #: adaptive micro-batch row budget: cut as soon as this many rows
+        #: are buffered, even inside the settle window
+        try:
+            self._epoch_max_rows = int(
+                _os.environ.get("PATHWAY_EPOCH_MAX_ROWS", "32768")
+            )
+        except ValueError:
+            self._epoch_max_rows = 32768
+        #: live connector queues (stop() drops a wake sentinel in each)
+        self._live_queues: list["queue.Queue"] = []
+        #: live cluster while run_cluster is active (exchange probe + hub)
+        self._active_cluster: Cluster | None = None
         #: persistence hooks (set by pathway_tpu.persistence.attach_persistence)
         self.persistence: Any = None
         #: epoch-boundary GC sweep hook (set by internals.run._ManagedGc);
@@ -165,6 +198,42 @@ class Scheduler:
                 nid: dict(p)
                 for nid, p in ctx.stats.get("operators", {}).items()
             }
+
+    def _settle_s(self, last_epoch_s: float) -> float:
+        """Adaptive micro-batch settle window (seconds): after the last
+        arrival, wait this long for the queue to drain before cutting.
+        Scaled to the last epoch's cost (a cheap graph cuts almost
+        immediately; an expensive one batches more), floored at 0.5 ms and
+        capped at a quarter of the autocommit interval — the interval
+        itself remains only the upper bound on hold time."""
+        return min(max(last_epoch_s * 0.25, 0.0005), self.autocommit_ms / 4000.0)
+
+    def _replay_speedup(self) -> float:
+        """Replay speed factor for REALTIME_REPLAY inter-commit gaps:
+        ``PATHWAY_REPLAY_SPEEDUP`` env wins, else the persistence config's
+        ``replay_speedup``; values <= 0 mean "as fast as possible"."""
+        env = _os.environ.get("PATHWAY_REPLAY_SPEEDUP")
+        if env:
+            try:
+                return float(env)
+            except ValueError:
+                pass
+        cfg = getattr(self.persistence, "config", None)
+        try:
+            return float(getattr(cfg, "replay_speedup", 1.0))
+        except (TypeError, ValueError):
+            return 1.0
+
+    def wake(self) -> None:
+        """Nudge the streaming loops out of their event waits: notifies
+        the cluster hub (parked multi-worker idle branches) and drops a
+        ``None`` sentinel into each live connector queue (single-worker
+        ``q.get``).  Called by ``stop()`` and the GC pacer."""
+        cluster = self._active_cluster
+        if cluster is not None:
+            cluster.wakeup.notify()
+        for q in list(self._live_queues):
+            q.put(None)
 
     def _snapshot_interval(self) -> float:
         """Snapshot rate limit in ms — ONE policy for single-worker and
@@ -526,11 +595,21 @@ class Scheduler:
                 ):
                     # REALTIME_REPLAY honours recorded inter-commit gaps
                     # (reference RealtimeReplay); SPEEDRUN and resume run
-                    # flat out.  Gaps cap at 5 s so a long-idle recording
-                    # stays usable.
+                    # flat out.  Gaps divide by the replay speed factor
+                    # (persistence ``replay_speedup`` / env
+                    # PATHWAY_REPLAY_SPEEDUP) and cap at 5 s so a
+                    # long-idle recording stays usable; the wait is on
+                    # the stop event, so shutdown interrupts it instead
+                    # of sleeping through.
                     if prev_wall is not None and wall > prev_wall:
-                        _time.sleep(min(wall - prev_wall, 5.0))
+                        speedup = self._replay_speedup()
+                        if speedup > 0:
+                            self._stop.wait(
+                                min((wall - prev_wall) / speedup, 5.0)
+                            )
                     prev_wall = wall
+                if self._stop.is_set():
+                    break
                 self.run_epoch(t, {node_id: batch})
                 t += TIME_STEP
             if self.persistence.replay_only:
@@ -560,14 +639,42 @@ class Scheduler:
         auxiliaries = [n for n in live_inputs if getattr(n, "auxiliary", False)]
         open_subjects = {n.id for n in primaries}
         buffers: dict[int, list[Update]] = defaultdict(list)
-        last_cut = _time.monotonic()
+        lat = self.latency
+        now_ns = lat.now_ns
+        self._live_queues.append(q)
+        autocommit_s = self.autocommit_ms / 1000.0
         commit_requested = False
+        rows_buffered = 0
+        #: monotonic instants of the oldest / newest buffered arrival
+        first_arrival: float | None = None
+        last_arrival = 0.0
+        #: earliest enqueue timestamp among buffered events (e2e origin)
+        origin_ns: int | None = None
+        last_epoch_s = 0.0
         while True:
-            timeout = self.autocommit_ms / 1000.0
+            # Event-driven wait: ``q.get`` wakes the instant a connector
+            # enqueues (or stop() drops its sentinel).  Idle, the
+            # autocommit interval is only a defensive heartbeat; with data
+            # buffered the wait is the adaptive micro-batch window — cut
+            # as soon as the queue drains and settles, at the row budget,
+            # or at the autocommit deadline, whichever comes first.
+            now = _time.monotonic()
+            if first_arrival is not None:
+                settle = self._settle_s(last_epoch_s)
+                deadline = min(
+                    last_arrival + settle, first_arrival + autocommit_s
+                )
+                timeout = deadline - now
+            else:
+                timeout = autocommit_s
+            item = None
             try:
-                item = q.get(timeout=timeout)
+                if timeout > 0.0:
+                    item = q.get(timeout=timeout)
+                else:
+                    item = q.get_nowait()
             except queue.Empty:
-                item = None
+                pass
             # Greedy drain: pull everything already queued into the buffers
             # in one pass, so epoch size tracks the actual backlog instead
             # of one queue item per loop iteration (an epoch that takes
@@ -578,19 +685,30 @@ class Scheduler:
             # checks below run even against a producer that enqueues as
             # fast as we drain.
             drained = 0
+            data_drained = False
+            drain_ns = now_ns()
             while item is not None:
-                nid, kind, key, values = item
+                nid, kind, key, values, enq_ns = item
                 if kind == "add":
                     buffers[nid].append(Update(key, values, 1))
+                    rows_buffered += 1
                 elif kind == "batch":
                     buffers[nid].extend(key)
+                    rows_buffered += len(key)
                 elif kind == "remove":
                     buffers[nid].append(Update(key, values, -1))
+                    rows_buffered += 1
                 elif kind == "commit":
                     commit_requested = True
                     break
                 elif kind == "close":
                     open_subjects.discard(nid)
+                if kind in ("add", "batch", "remove"):
+                    data_drained = True
+                    if enq_ns is not None:
+                        lat.record("ingest", drain_ns - enq_ns)
+                        if origin_ns is None or enq_ns < origin_ns:
+                            origin_ns = enq_ns
                 drained += 1
                 if drained >= 8192:
                     break  # bounded pass: cut/stop checks must run
@@ -599,9 +717,20 @@ class Scheduler:
                 except queue.Empty:
                     item = None
             now = _time.monotonic()
-            have_data = any(buffers.values())
+            if data_drained:
+                last_arrival = now
+                if first_arrival is None:
+                    first_arrival = now
+            have_data = rows_buffered > 0
+            settle = self._settle_s(last_epoch_s)
             should_cut = have_data and (
-                commit_requested or (now - last_cut) * 1000.0 >= self.autocommit_ms
+                commit_requested
+                or rows_buffered >= self._epoch_max_rows
+                or (q.empty() and now - last_arrival >= settle)
+                or (
+                    first_arrival is not None
+                    and now - first_arrival >= autocommit_s
+                )
             )
             if should_cut:
                 inject = {nid: b for nid, b in buffers.items() if b}
@@ -609,13 +738,25 @@ class Scheduler:
                 commit_requested = False
                 for nid, b in inject.items():
                     consumed[nid] = consumed.get(nid, 0) + len(b)
+                cut_ns = now_ns()
+                if origin_ns is not None:
+                    lat.record("cut", cut_ns - origin_ns)
+                # sink/e2e stage anchors for the output nodes of this epoch
+                self.ctx.latency = lat
+                self.ctx.epoch_origin_ns = origin_ns
+                self.ctx.epoch_cut_ns = cut_ns
+                ep0 = _time.monotonic()
                 self.run_epoch(t, inject)
+                last_epoch_s = _time.monotonic() - ep0
+                self.ctx.epoch_origin_ns = None
+                self.ctx.epoch_cut_ns = None
+                lat.record("process", int(last_epoch_s * 1e9))
                 t += TIME_STEP
+                rows_buffered = 0
+                first_arrival = None
+                origin_ns = None
                 if self.gc_tick is not None:
                     self.gc_tick()
-                # post-epoch timestamp: the cut timer measures idle/buffer
-                # time, not epoch processing time
-                last_cut = _time.monotonic()
                 if (
                     self.persistence is not None
                     and self.persistence.operator_mode
@@ -744,6 +885,11 @@ class Scheduler:
             self._finish(ctx=ctx, cluster=cluster, tid=tid)
             return
 
+        hub = cluster.wakeup
+        lat = self.latency
+        now_ns = lat.now_ns
+        if tid == 0:
+            cluster.latency = lat  # exchange recv waits feed the probe
         q: "queue.Queue" = queue.Queue()
         wrappers: dict[int, Any] = {}
         for node, subject in my_inputs:
@@ -755,6 +901,7 @@ class Scheduler:
                 replayed_counts.get(node.id, 0),
                 ctx,
                 worker=w,
+                wake=hub.notify,
             )
 
         my_primaries = {
@@ -765,31 +912,54 @@ class Scheduler:
         buffers: dict[int, list[Update]] = defaultdict(list)
         round_no = 0
         commit_requested = False
-        last_cut = _time.monotonic()
+        autocommit_s = self.autocommit_ms / 1000.0
+        rows_buffered = 0
+        first_arrival: float | None = None
+        last_arrival = 0.0
+        origin_ns: int | None = None
+        last_epoch_s = 0.0
         while True:
+            # generation snapshot BEFORE the drain: anything enqueued or
+            # delivered after this point re-triggers the idle wait below
+            # immediately (no lost-wakeup window)
+            wake_seen = hub.seq()
             # drain whatever is buffered right now (non-blocking, bounded).
             # A commit item ENDS the drain: rows enqueued after a commit
             # belong to the next transaction — merging across it would
             # consolidate an add with its later retraction into nothing
             # (timed update streams rely on the boundary).
             drained = 0
+            data_drained = False
+            drain_ns = now_ns()
             while drained < 8192:
                 try:
-                    nid, kind, key, values = q.get_nowait()
+                    item = q.get_nowait()
                 except queue.Empty:
                     break
+                if item is None:
+                    continue  # wake sentinel from stop()
+                nid, kind, key, values, enq_ns = item
                 drained += 1
                 if kind == "add":
                     buffers[nid].append(Update(key, values, 1))
+                    rows_buffered += 1
                 elif kind == "batch":
                     buffers[nid].extend(key)
+                    rows_buffered += len(key)
                 elif kind == "remove":
                     buffers[nid].append(Update(key, values, -1))
+                    rows_buffered += 1
                 elif kind == "commit":
                     commit_requested = True
                     break
                 elif kind == "close":
                     open_subjects.discard(nid)
+                if kind in ("add", "batch", "remove"):
+                    data_drained = True
+                    if enq_ns is not None:
+                        lat.record("ingest", drain_ns - enq_ns)
+                        if origin_ns is None or enq_ns < origin_ns:
+                            origin_ns = enq_ns
 
             aux_pending = sum(
                 getattr(n.subject, "pending_count", lambda: 0)() for n in my_aux
@@ -803,7 +973,24 @@ class Scheduler:
             # statuses so every worker reaches the same CUT/FINISH/WAIT
             # verdict — local clocks only enter via the gathered elapsed
             now = _time.monotonic()
-            elapsed_ms = (now - last_cut) * 1000.0
+            if data_drained:
+                last_arrival = now
+                if first_arrival is None:
+                    first_arrival = now
+            # hold time of the oldest buffered arrival: the autocommit
+            # interval bounds how long data may be HELD, not a fixed cut
+            # cadence — an idle stretch no longer counts toward it
+            elapsed_ms = (
+                (now - first_arrival) * 1000.0 if first_arrival is not None else 0.0
+            )
+            settle_s = self._settle_s(last_epoch_s)
+            # adaptive micro-batch vote: this worker's queue drained and
+            # settled (or hit the row budget) — gathered below, so ANY
+            # worker's vote cuts the epoch cluster-wide
+            wants_cut = rows_buffered > 0 and (
+                rows_buffered >= self._epoch_max_rows
+                or (q.empty() and (now - last_arrival) >= settle_s)
+            )
             snap_elapsed_ms = (now - self._last_snapshot_at.get(w, 0.0)) * 1000.0
             status = (
                 any(buffers.values()) or not q.empty(),
@@ -814,6 +1001,7 @@ class Scheduler:
                 elapsed_ms,
                 tuple(sorted(nid for nid, b in buffers.items() if b)),
                 snap_elapsed_ms,
+                wants_cut,
             )
             _tr0 = _time.monotonic()
             # round_statuses, NOT allgather: the per-round consensus rides
@@ -838,6 +1026,7 @@ class Scheduler:
             stop = any(s[4] for s in statuses)
             autocommit_due = max(s[5] for s in statuses) >= self.autocommit_ms
             buffered_ids = {nid for s in statuses for nid in s[6]}
+            any_wants_cut = any(s[8] for s in statuses)
             # snapshot decision is a pure function of the GATHERED statuses
             # (max elapsed-since-snapshot), so every worker snapshots at the
             # same cut epoch — a per-worker clock decision here would let
@@ -845,23 +1034,41 @@ class Scheduler:
             # recovery (rows exchanged in the gap epoch lost or doubled)
             snapshot_due = max(s[7] for s in statuses)
             source_done = all_closed and no_aux
-            if buffered_ids and (any_commit or autocommit_due or source_done or stop):
+            if buffered_ids and (
+                any_commit or any_wants_cut or autocommit_due or source_done or stop
+            ):
                 inject = {nid: b for nid, b in buffers.items() if b}
                 buffers = defaultdict(list)
                 commit_requested = False
                 consumed = getattr(ctx, "consumed", {})
                 for nid, b in inject.items():
                     consumed[nid] = consumed.get(nid, 0) + len(b)
+                cut_ns = now_ns()
+                if origin_ns is not None:
+                    lat.record("cut", cut_ns - origin_ns)
+                # sink/e2e anchors for output nodes (ctx is per worker —
+                # sinks route to worker 0, which records against its own
+                # locally-buffered origin)
+                ctx.latency = lat
+                ctx.epoch_origin_ns = origin_ns
+                ctx.epoch_cut_ns = cut_ns
+                ep0 = _time.monotonic()
                 # only exchange at operators data can actually reach — the
                 # closure is identical on every worker (same gathered ids)
                 self.run_epoch(
                     t, inject, ctx=ctx, cluster=cluster, tid=tid,
                     active=self.active_closure(buffered_ids),
                 )
+                last_epoch_s = _time.monotonic() - ep0
+                ctx.epoch_origin_ns = None
+                ctx.epoch_cut_ns = None
+                lat.record("process", int(last_epoch_s * 1e9))
                 t += TIME_STEP
+                rows_buffered = 0
+                first_arrival = None
+                origin_ns = None
                 if tid == 0 and self.gc_tick is not None:
                     self.gc_tick()  # gc is process-wide: one thread sweeps
-                last_cut = _time.monotonic()
                 if (
                     self.persistence is not None
                     and self.persistence.operator_mode
@@ -876,11 +1083,25 @@ class Scheduler:
             elif stop or (source_done and not any_data):
                 break
             else:
-                # pace the next status round: a status round is one small
-                # allgather (~sub-ms on localhost), so cap the idle sleep
-                # at 10ms — a 200ms autocommit must not add 40ms of
-                # latency to every drain step
-                _time.sleep(min(self.autocommit_ms / 5.0, 10.0) / 1000.0)
+                # event-driven park (replaces the fixed poll sleep): wait
+                # on the cluster hub, woken by a local connector enqueue,
+                # a peer frame arrival, any worker entering the next
+                # round's collective, the GC pacer, or stop().  With data
+                # buffered the wait is bounded by the remaining settle /
+                # autocommit-hold window; idle it is bounded by the
+                # autocommit interval as a defensive heartbeat only.
+                if q.empty():
+                    now = _time.monotonic()
+                    if first_arrival is not None:
+                        deadline = min(
+                            last_arrival + settle_s,
+                            first_arrival + autocommit_s,
+                        )
+                        wait_s = deadline - now
+                    else:
+                        wait_s = autocommit_s
+                    if wait_s > 0.0:
+                        hub.wait(wake_seen, wait_s)
         ctx.time = t
         self._finish(
             ctx=ctx, cluster=cluster, tid=tid,
@@ -1005,6 +1226,7 @@ class Scheduler:
         replayed: int,
         ctx: Any,
         worker: int = 0,
+        wake: Callable[[], None] | None = None,
     ) -> threading.Thread:
         """Start the connector supervisor for one live input.  The reader
         no longer dies permanently on the first exception: the supervisor
@@ -1022,7 +1244,14 @@ class Scheduler:
 
         def make_events(resume: int) -> Any:
             with self._prober_lock:
-                events: Any = ConnectorEvents(q, node.id, self._stop, stats=cstats)
+                events: Any = ConnectorEvents(
+                    q,
+                    node.id,
+                    self._stop,
+                    stats=cstats,
+                    now_ns=self.latency.now_ns,
+                    wake=wake,
+                )
             if self.persistence is not None:
                 events = self.persistence.wrap_events(
                     node, events, resume, worker=worker
@@ -1055,3 +1284,6 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        # wake any loop parked in an event wait so shutdown is immediate
+        # (q.get / hub.wait would otherwise run out their heartbeat first)
+        self.wake()

@@ -55,11 +55,11 @@ OP_POINTER = 20
 OP_METHOD = 21
 
 # (method name, operand count) -> native method id — must mirror enum
-# VmMethod in native/pathway_native.cpp.  Methods not listed here
-# (to_utc, to_naive_in_timezone, from_timestamp, ...) run as CALL_PY
-# closures: they need the zoneinfo database.  str.split maps BOTH
-# arities to one id — the native op distinguishes whitespace vs
-# separator splitting by operand count.
+# VmMethod in native/pathway_native.cpp.  str.split maps BOTH arities to
+# one id — the native op distinguishes whitespace vs separator splitting
+# by operand count.  to_utc / to_naive_in_timezone carry their zone's
+# packed transition table (internals/tztable.py) as a constant operand,
+# so the zoneinfo database is consulted at graph build, not per row.
 _METHOD_IDS = {
     ("str.lower", 1): 0,
     ("str.upper", 1): 1,
@@ -118,6 +118,10 @@ _METHOD_IDS = {
     ("num.round", 2): 48,
     ("str.split", 2): 49,  # whitespace split: (s, maxsplit)
     ("str.split", 3): 49,  # separator split: (s, sep, maxsplit)
+    ("dt.from_timestamp", 2): 50,  # (x, scale)
+    ("dt.utc_from_timestamp", 2): 51,  # (x, scale)
+    ("dt.to_utc", 2): 52,  # (d, tz_table)
+    ("dt.to_naive_in_timezone", 2): 53,  # (d, tz_table)
 }
 
 # binary op ids — must mirror enum VmBin

@@ -284,18 +284,32 @@ class DateTimeNamespace(_Namespace):
     def to_utc(self, from_timezone: str) -> ColumnExpression:
         from zoneinfo import ZoneInfo
 
-        def conv(d: _dtm.datetime) -> _dtm.datetime:
+        from pathway_tpu.internals.tztable import build_tz_table
+
+        # _tbl is the packed transition-table operand the native VM
+        # converts with; the closure stays the semantic ground truth and
+        # doubles as the native per-value fallback (called without _tbl)
+        def conv(d: _dtm.datetime, _tbl: Any = None) -> _dtm.datetime:
             return d.replace(tzinfo=ZoneInfo(from_timezone)).astimezone(_UTC)
 
-        return self._m("dt.to_utc", conv, dt.DATE_TIME_UTC)
+        return self._m(
+            "dt.to_utc", conv, dt.DATE_TIME_UTC, build_tz_table(from_timezone, conv)
+        )
 
     def to_naive_in_timezone(self, timezone: str) -> ColumnExpression:
         from zoneinfo import ZoneInfo
 
-        def conv(d: _dtm.datetime) -> _dtm.datetime:
+        from pathway_tpu.internals.tztable import build_tz_table
+
+        def conv(d: _dtm.datetime, _tbl: Any = None) -> _dtm.datetime:
             return d.astimezone(ZoneInfo(timezone)).replace(tzinfo=None)
 
-        return self._m("dt.to_naive_in_timezone", conv, dt.DATE_TIME_NAIVE)
+        return self._m(
+            "dt.to_naive_in_timezone",
+            conv,
+            dt.DATE_TIME_NAIVE,
+            build_tz_table(timezone, conv),
+        )
 
     def round(self, duration: Any) -> ColumnExpression:
         return self._m("dt.round", _round_dt, self._expr._dtype, duration)
@@ -329,19 +343,23 @@ class DateTimeNamespace(_Namespace):
         return self._m("dt.weeks", lambda d: d.days // 7, dt.INT)
 
     def from_timestamp(self, unit: str = "s") -> ColumnExpression:
+        # scale rides along as a float operand so the VM lowers by
+        # (name, arity), like timestamp() above
         scale = {"ns": 1e9, "us": 1e6, "ms": 1e3, "s": 1.0}[unit]
         return self._m(
             "dt.from_timestamp",
-            lambda x: _dtm.datetime.fromtimestamp(x / scale, tz=_UTC).replace(tzinfo=None),
+            lambda x, sc: _dtm.datetime.fromtimestamp(x / sc, tz=_UTC).replace(tzinfo=None),
             dt.DATE_TIME_NAIVE,
+            scale,
         )
 
     def utc_from_timestamp(self, unit: str = "s") -> ColumnExpression:
         scale = {"ns": 1e9, "us": 1e6, "ms": 1e3, "s": 1.0}[unit]
         return self._m(
             "dt.utc_from_timestamp",
-            lambda x: _dtm.datetime.fromtimestamp(x / scale, tz=_UTC),
+            lambda x, sc: _dtm.datetime.fromtimestamp(x / sc, tz=_UTC),
             dt.DATE_TIME_UTC,
+            scale,
         )
 
 

@@ -9,7 +9,148 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
-__all__ = ["MonitoringLevel", "ProberStats", "collect_stats", "start_dashboard"]
+__all__ = [
+    "LatencyProbe",
+    "MonitoringLevel",
+    "ProberStats",
+    "STAGES",
+    "collect_stats",
+    "start_dashboard",
+]
+
+#: pipeline stages instrumented by the scheduler (ISSUE 4 tentpole c):
+#:   ingest   — connector enqueue -> scheduler drain (queue residency)
+#:   cut      — first buffered arrival -> epoch cut decision (batching hold)
+#:   process  — one epoch of operator propagation (run_epoch wall time)
+#:   exchange — cluster mailbox wait for peer frames (recv side)
+#:   sink     — epoch cut -> update delivered to an output node
+#:   e2e      — earliest enqueue in the epoch -> sink delivery
+STAGES = ("ingest", "cut", "process", "exchange", "sink", "e2e")
+
+_LAT_BUCKETS = 488  # mirrors kLatBuckets in native/pathway_native.cpp
+
+
+def _lat_bucket(ns: int) -> int:
+    """Python mirror of the native ``lat_bucket``: 16 exact unit buckets,
+    then 8 sub-buckets per octave (~12% relative resolution)."""
+    if ns < 16:
+        return ns if ns > 0 else 0
+    msb = ns.bit_length() - 1
+    idx = 16 + (msb - 4) * 8 + ((ns >> (msb - 3)) & 7)
+    return idx if idx < _LAT_BUCKETS else _LAT_BUCKETS - 1
+
+
+def _lat_rep(idx: int) -> int:
+    """Representative (midpoint) nanosecond value of bucket ``idx``."""
+    if idx < 16:
+        return idx
+    msb = (idx - 16) // 8 + 4
+    sub = (idx - 16) % 8
+    lo = (1 << msb) | (sub << (msb - 3))
+    return lo + (1 << (msb - 3)) // 2
+
+
+class _PyHist:
+    """Fallback histogram when the native module is unavailable; same
+    bucket layout and snapshot contract as the C++ ``LatHist``."""
+
+    __slots__ = ("buckets", "count", "sum_ns", "max_ns", "_lock")
+
+    def __init__(self) -> None:
+        self.buckets = [0] * _LAT_BUCKETS
+        self.count = 0
+        self.sum_ns = 0
+        self.max_ns = 0
+        self._lock = threading.Lock()
+
+    def record(self, ns: int) -> None:
+        if ns < 0:
+            ns = 0
+        with self._lock:
+            self.buckets[_lat_bucket(ns)] += 1
+            self.count += 1
+            self.sum_ns += ns
+            if ns > self.max_ns:
+                self.max_ns = ns
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            buckets = list(self.buckets)
+            count, sum_ns, max_ns = self.count, self.sum_ns, self.max_ns
+
+        def q(target: float) -> float:
+            cum = 0
+            for i, c in enumerate(buckets):
+                if not c:
+                    continue
+                cum += c
+                if cum >= target:
+                    return float(min(_lat_rep(i), max_ns))
+            return float(max_ns)
+
+        return {
+            "count": count,
+            "sum_ns": sum_ns,
+            "max_ns": max_ns,
+            "p50_ns": q(0.50 * count) if count else 0.0,
+            "p95_ns": q(0.95 * count) if count else 0.0,
+            "p99_ns": q(0.99 * count) if count else 0.0,
+        }
+
+
+class LatencyProbe:
+    """Per-stage latency histograms for the streaming hot path.
+
+    Recording is one native call per sample (atomic log-bucket increment,
+    no lock, safe from any thread); snapshots reduce the buckets to
+    p50/p95/p99 without ever resetting them, so the probe is streaming-
+    safe — concurrent recording during a snapshot at worst lands a sample
+    in the next read."""
+
+    def __init__(self) -> None:
+        native = None
+        try:
+            from pathway_tpu.internals import native as _native_mod
+
+            native = _native_mod.load()
+        except Exception:
+            native = None
+        if native is not None and hasattr(native, "hist_new"):
+            self._native = native
+            self._h = {s: native.hist_new() for s in STAGES}
+            self.now_ns = native.monotonic_ns
+            self._record = native.hist_record
+        else:
+            self._native = None
+            self._h = {s: _PyHist() for s in STAGES}
+            self.now_ns = time.monotonic_ns
+            self._record = lambda h, ns: h.record(ns)
+
+    def record(self, stage: str, ns: int) -> None:
+        self._record(self._h[stage], ns)
+
+    def record_since(self, stage: str, t0_ns: int) -> None:
+        self._record(self._h[stage], self.now_ns() - t0_ns)
+
+    def snapshot(self) -> dict[str, dict]:
+        """``{stage: {count, p50_ms, p95_ms, p99_ms, max_ms, mean_ms}}``
+        for every stage that has recorded at least one sample."""
+        out: dict[str, dict] = {}
+        for s in STAGES:
+            h = self._h[s]
+            d = self._native.hist_snapshot(h) if self._native else h.snapshot()
+            n = d["count"]
+            if not n:
+                continue
+            out[s] = {
+                "count": n,
+                "p50_ms": d["p50_ns"] / 1e6,
+                "p95_ms": d["p95_ns"] / 1e6,
+                "p99_ms": d["p99_ns"] / 1e6,
+                "max_ms": d["max_ns"] / 1e6,
+                "mean_ms": d["sum_ns"] / n / 1e6,
+            }
+        return out
 
 
 class MonitoringLevel:
@@ -41,6 +182,9 @@ class ProberStats:
     #: exchange-overhead probe from cluster runs: collective counts plus
     #: pack/send/unpack/wait milliseconds (empty for single-worker runs)
     exchange: dict[str, Any] = field(default_factory=dict)
+    #: per-stage streaming latency histogram snapshot
+    #: ({stage: {count, p50_ms, p95_ms, p99_ms, max_ms, mean_ms}})
+    latency: dict[str, Any] = field(default_factory=dict)
 
 
 def collect_stats(sched: Any) -> ProberStats:
@@ -75,7 +219,20 @@ def collect_stats(sched: Any) -> ProberStats:
             name for name, c in connectors.items() if c.get("stale")
         ),
         exchange=_exchange_stats(sched, ctx),
+        latency=latency_stats(sched),
     )
+
+
+def latency_stats(sched: Any) -> dict[str, Any]:
+    """Per-stage latency snapshot from the scheduler's probe (empty when
+    the scheduler has not recorded any samples yet)."""
+    probe = getattr(sched, "latency", None)
+    if probe is None:
+        return {}
+    try:
+        return probe.snapshot()
+    except Exception:
+        return {}
 
 
 def _exchange_stats(sched: Any, ctx: Any) -> dict[str, Any]:

@@ -82,7 +82,29 @@ def _metrics_text(sched: Any) -> str:
                 f'pathway_tpu_operator_latency_ms_total{{operator="{label}"}} '
                 f"{p['total_ms']:.3f}"
             )
+    # per-stage streaming latency histograms (ISSUE 4 tentpole c): the
+    # scheduler's LatencyProbe reduced to quantile gauges per stage
+    lat = _latency_snapshot(sched)
+    if lat:
+        lines.append("# TYPE pathway_tpu_stage_latency_ms gauge")
+        lines.append("# TYPE pathway_tpu_stage_latency_count gauge")
+        for stage, d in sorted(lat.items()):
+            for qk in ("p50", "p95", "p99", "max"):
+                lines.append(
+                    f'pathway_tpu_stage_latency_ms{{stage="{stage}",'
+                    f'quantile="{qk}"}} {d[qk + "_ms"]:.4f}'
+                )
+            lines.append(
+                f'pathway_tpu_stage_latency_count{{stage="{stage}"}} '
+                f"{d['count']}"
+            )
     return "\n".join(lines) + "\n# EOF\n"
+
+
+def _latency_snapshot(sched: Any) -> dict[str, Any]:
+    from pathway_tpu.internals.monitoring import latency_stats
+
+    return latency_stats(sched)
 
 
 def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
@@ -98,6 +120,7 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         "epoch": sched.ctx.time,
                         "operators": len(sched.graph.nodes),
                         "errors": len(sched.ctx.error_log),
+                        "latency": _latency_snapshot(sched),
                     }
                 ).encode()
                 ctype = "application/json"

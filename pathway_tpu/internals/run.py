@@ -128,7 +128,15 @@ def _run_inner(
     with telemetry.span(
         "graph_runner.run", operators=len(G.engine_graph.nodes)
     ), _ManagedGc() as mgc:
-        sched.gc_tick = mgc.maybe_sweep
+
+        def _gc_tick() -> None:
+            # the GC pacer is a wakeup source too: a sweep can take long
+            # enough that parked workers' deadlines passed — notify the
+            # scheduler's event waits so they re-evaluate immediately
+            if mgc.maybe_sweep():
+                sched.wake()
+
+        sched.gc_tick = _gc_tick
         if threads * processes > 1:
             # multi-worker topology from the spawn env contract
             # (PATHWAY_THREADS × PATHWAY_PROCESSES, reference config.rs:86-120)
@@ -219,7 +227,7 @@ class _ManagedGc:
         self._next_due = self._last_sweep + self._interval
         return self
 
-    def maybe_sweep(self) -> None:
+    def maybe_sweep(self) -> bool:
         """Sweep cycles if due — called by the scheduler between epochs,
         when transient row data is already dead.  Sweeps are PACED by
         their own cost: a sweep that took ``t`` seconds pushes the next
@@ -231,12 +239,13 @@ class _ManagedGc:
         at 1/2/4 processes on the 2M-line wordcount).  Cycle garbage
         only accumulates from the few objects that survive epochs, so
         deferring sweeps costs memory slowly; leaks still get collected,
-        just amortized."""
+        just amortized.  Returns True when a sweep actually ran (the
+        caller treats that as a wakeup-worthy event)."""
         if not self._was_enabled:
-            return
+            return False
         now = self._time.monotonic()
         if now < self._next_due:
-            return
+            return False
         self._sweeps += 1
         # young generations every sweep; a full collection every 8th so
         # gen-2 cycles (promoted survivors) cannot leak over a long
@@ -246,6 +255,7 @@ class _ManagedGc:
         self._last_sweep = self._time.monotonic()
         cost = self._last_sweep - t0
         self._next_due = self._last_sweep + max(self._interval, cost / 0.02)
+        return True
 
     def __exit__(self, *exc: Any) -> None:
         if self._was_enabled:

@@ -451,11 +451,16 @@ class Config:
         snapshot_interval_ms: int = 0,
         persistence_mode: PersistenceMode = PersistenceMode.PERSISTING,
         continue_after_replay: bool = True,
+        replay_speedup: float = 1.0,
     ):
         self.backend = backend
         self.snapshot_interval_ms = snapshot_interval_ms
         self.persistence_mode = persistence_mode
         self.continue_after_replay = continue_after_replay
+        #: REALTIME_REPLAY speed factor: recorded inter-commit gaps are
+        #: divided by this before sleeping (2.0 = replay twice as fast;
+        #: <= 0 = no gap sleeps).  Env PATHWAY_REPLAY_SPEEDUP overrides.
+        self.replay_speedup = replay_speedup
 
     @classmethod
     def simple_config(cls, backend: Backend, **kwargs: Any) -> "Config":

@@ -1,0 +1,52 @@
+"""Capture BENCH_r06.json — host-plane rerun for the event-driven
+streaming runtime round: full-size wordcount + 2-proc exchange
+efficiency + streaming latency-vs-rate with the per-stage breakdown.
+
+Run from the repo root: ``JAX_PLATFORMS=cpu python scripts/bench_r06.py``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PATHWAY_GC_INTERVAL_S", "10")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402  (repo-root import)
+
+
+def main() -> None:
+    extra: dict = {}
+    t0 = time.perf_counter()
+    bench.bench_wordcount(extra)
+    bench.bench_wordcount_multiprocess(extra)
+    bench.bench_streaming_latency(extra)
+    wall = time.perf_counter() - t0
+    doc = {
+        "cmd": (
+            "JAX_PLATFORMS=cpu python scripts/bench_r06.py "
+            "(bench.bench_wordcount + bench.bench_wordcount_multiprocess "
+            "+ bench.bench_streaming_latency, full 2M-line corpus)"
+        ),
+        "host": "1-core driver box, CPU-only (no TPU attached)",
+        "wall_seconds": round(wall, 1),
+        "parsed": {
+            "metric": "streaming_latency_p99_ms_30k",
+            "value": extra["streaming_latency_vs_rate"]["30000"]["p99_ms"],
+            "unit": "ms",
+            "extra": extra,
+        },
+    }
+    out = os.path.join(os.path.dirname(__file__), "..", "BENCH_r06.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc["parsed"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,3 +49,20 @@ def test_bench_smoke_emits_wellformed_metrics():
     stats = extra["wordcount_exchange_stats"]
     assert stats["transmissions"] > 0
     assert stats["status_rounds"] > 0
+    # the streaming-latency probe ran and its dispersion gate held: a
+    # p99/p50 blowout (raised inside bench.py) would surface here as a
+    # streaming_latency_error key instead of the smoke summary
+    assert "streaming_latency_error" not in extra, extra.get(
+        "streaming_latency_error"
+    )
+    probe = extra["streaming_latency_smoke"]
+    assert probe["p50_ms"] > 0
+    assert probe["p99_ms"] >= probe["p50_ms"]
+    assert probe["dispersion_p99_over_p50"] <= 25.0
+    # per-stage breakdown present for the probed rate, with the wakeup
+    # pipeline's stages all recording
+    (rate_entry,) = extra["streaming_latency_vs_rate"].values()
+    stages = rate_entry["stages"]
+    for stage in ("ingest", "cut", "process", "sink", "e2e"):
+        assert stages[stage]["count"] > 0, stage
+        assert stages[stage]["p50_ms"] <= stages[stage]["p99_ms"]

@@ -699,17 +699,126 @@ def test_method_str_split_parity(native):
 
 
 def test_method_fallbacks_still_lower(native):
-    """Methods outside the native set embed as CALL_PY but the program
-    still compiles (mixed native + fallback in one select)."""
-    from pathway_tpu.internals.dtype import DateTimeNaive
-
-    rows = [(DateTimeNaive(2020, 3, 4, 10, 20, 30), 2.0, 0), (None, 1.0, 0)]
+    """Expressions outside the native set (user UDFs via apply) embed as
+    CALL_PY but the program still compiles (mixed native + fallback in
+    one select)."""
+    rows = [(3.0, 2.0, 0), (None, 1.0, 0), (E, 4.0, 0)]
     exprs = [
-        X.dt.to_utc("Europe/Paris"),
-        X.dt.to_naive_in_timezone("Asia/Tokyo"),
+        ex.ApplyExpression(lambda v: (v or 0.0) * 10.0, float, (X,), {}),
         Y.num.round(1),
     ]
     _assert_parity_rows(native, exprs, rows, expect_native=False)
+
+
+def _tz_rows():
+    """Adversarial datetimes for tz lowering: DST gap/fold (both folds),
+    far past/future (rule-footer fallback), month/year edges, aware
+    inputs.  Plain ``datetime`` rows: the schema-annotation subclasses
+    survive ``replace``/``astimezone`` on the closure path but the native
+    constructor builds the base type — the VALUES must match."""
+    import datetime as dtm
+    from zoneinfo import ZoneInfo
+
+    d = dtm.datetime
+    return [
+        (d(2021, 7, 1, 12, 0, 0, tzinfo=dtm.timezone.utc), 0, 0),
+        (d(2021, 7, 1, 12, 0, 0, tzinfo=ZoneInfo("Asia/Tokyo")), 0, 0),
+        (d(2020, 3, 4, 10, 20, 30, 123456), 0, 0),
+        (d(2024, 3, 10, 2, 30, 0), 0, 0),     # US spring-forward gap
+        (d(2024, 11, 3, 1, 30, 0), 0, 0),     # US fall-back fold=0
+        (d(2024, 11, 3, 1, 30, 0, fold=1), 0, 0),  # ... fold=1
+        (d(2024, 3, 31, 2, 30, 0), 0, 0),     # EU spring-forward gap
+        (d(2024, 10, 27, 2, 30, 0, fold=1), 0, 0),  # EU fall-back
+        (d(1900, 1, 1, 0, 0, 0), 0, 0),       # before first transition
+        (d(2090, 6, 15, 12, 0, 0), 0, 0),     # past last: rule footer
+        (d(1969, 12, 31, 23, 59, 59), 0, 0),
+        (d(2000, 2, 29, 23, 59, 59, 999999), 0, 0),
+        (None, 0, 0),
+        (E, 0, 0),
+        ("not a datetime", 0, 0),
+    ]
+
+
+@pytest.mark.parametrize(
+    "tz",
+    [
+        "America/New_York",
+        "Europe/Paris",
+        "Asia/Tokyo",
+        "Australia/Lord_Howe",  # 30-minute DST shift
+        "UTC",
+    ],
+)
+def test_method_tz_convert_parity(native, tz):
+    """dt.to_utc / dt.to_naive_in_timezone lower natively (packed
+    transition tables) and match the ZoneInfo closures row by row."""
+    exprs = [X.dt.to_utc(tz), X.dt.to_naive_in_timezone(tz)]
+    _assert_parity_rows(native, exprs, _tz_rows())
+
+
+def test_method_tz_convert_unknown_zone_falls_back(native):
+    """An unpackable zone still lowers (sentinel table -> per-value
+    Python fallback inside the native method) and errors identically."""
+    exprs = [
+        X.dt.to_utc("No/Such_Zone"),
+        X.dt.to_naive_in_timezone("No/Such_Zone"),
+    ]
+    _assert_parity_rows(native, exprs, _tz_rows())
+
+
+def test_method_from_timestamp_parity(native):
+    rows = [
+        (0, 0, 0),
+        (1, 0, 0),
+        (-1, 0, 0),
+        (1700000000, 0, 0),
+        (1700000000.123456, 0, 0),
+        (-62135596800, 0, 0),            # year 1 boundary
+        (253402300799.999, 0, 0),        # year 9999 tail
+        (253402300801.0, 0, 0),          # out of range -> ERROR
+        (2.5e-06, 0, 0),                 # microsecond rounding (half-even)
+        (3.5e-06, 0, 0),
+        (float("nan"), 0, 0),            # -> ERROR
+        (float("inf"), 0, 0),            # -> ERROR
+        (2**70, 0, 0),                   # -> ERROR (overflow)
+        (None, 0, 0),
+        (E, 0, 0),
+        ("x", 0, 0),                     # non-numeric -> ERROR
+        (True, 0, 0),                    # bool is a valid number
+    ]
+    for unit in ("s", "ms", "us", "ns"):
+        exprs = [
+            X.dt.from_timestamp(unit),
+            X.dt.utc_from_timestamp(unit),
+        ]
+        _assert_parity_rows(native, exprs, rows)
+
+
+def test_tz_pipeline_compiles_without_call_py(native):
+    """Satellite: a strptime -> tz-convert -> format pipeline must lower
+    to a program with NO CALL_PY ops (the whole chain runs natively)."""
+    exprs = [
+        X.str.parse_datetime("%Y-%m-%d %H:%M:%S")
+        .dt.to_utc("Europe/Paris")
+        .dt.to_naive_in_timezone("Asia/Tokyo")
+        .dt.strftime("%Y-%m-%dT%H:%M:%S"),
+        Y.dt.from_timestamp("ms").dt.strftime("%H:%M:%S"),
+    ]
+    for e in exprs:
+        asm = expr_vm._Asm(LAYOUT)
+        expr_vm._lower(e, asm)
+        ops = [asm.code[i] for i in range(0, len(asm.code), 2)]
+        assert expr_vm.OP_CALL_PY not in ops, "program contains CALL_PY"
+        assert not asm.pyfuncs, "program embeds a Python fallback closure"
+    progs = expr_vm.lower_programs(exprs, LAYOUT)
+    assert progs is not None, "pipeline must lower natively"
+    rows = [
+        ("2024-03-31 02:30:00", 1700000000123, 0),
+        ("2020-01-01 00:00:00", 0, 0),
+        ("not a date", -1, 0),
+        (None, None, 0),
+    ]
+    _assert_parity_rows(native, exprs, rows)
 
 
 def test_method_strptime_matches_python_over_format_grid(native):
