@@ -74,6 +74,25 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def smoke_analyze(graph_name: str) -> None:
+    """--smoke gate: run the pre-flight static analyzer on the bench
+    graph just built and abort on error-severity findings — the bench
+    graphs double as analyzer regression fixtures."""
+    if not SMOKE:
+        return
+    from pathway_tpu.analysis import SEV_ERROR, analyze, format_diagnostics
+
+    diags = analyze()
+    errors = [d for d in diags if d.severity == SEV_ERROR]
+    if errors:
+        log(format_diagnostics(diags))
+        raise SystemExit(
+            f"{graph_name}: static analysis found {len(errors)} "
+            "error-severity finding(s)"
+        )
+    log(f"{graph_name}: analyzer clean ({len(diags)} warning(s))")
+
+
 def device_peak_flops(dev) -> float | None:
     kind = getattr(dev, "device_kind", "").lower()
     for sub, peak in _PEAKS:
@@ -420,6 +439,7 @@ def bench_wordcount(extra: dict) -> None:
     lines = pw.io.jsonlines.read(fp, schema=S, mode="static")
     counts = lines.groupby(lines.word).reduce(lines.word, c=pw.reducers.count())
     cap = counts._capture_node()
+    smoke_analyze("wordcount")
     ctx = pw.run(
         persistence_config=pw.persistence.Config(
             backend=pw.persistence.Backend.filesystem(pdir)
@@ -681,6 +701,7 @@ def bench_streaming_latency(extra: dict) -> None:
                 lats.append(time.perf_counter() - row["last_produced"])
 
         pw.io.subscribe(counts, on_change)
+        smoke_analyze(f"streaming_latency@{rate}")
         t0 = time.perf_counter()
         pw.run(autocommit_duration_ms=50, monitoring_level=pw.MonitoringLevel.NONE)
         wall = time.perf_counter() - t0

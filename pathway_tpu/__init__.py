@@ -195,6 +195,14 @@ def __getattr__(name: str) -> Any:
         from pathway_tpu.internals.yaml_loader import load_yaml
 
         return load_yaml
+    if name == "analysis":
+        import pathway_tpu.analysis as analysis
+
+        return analysis
+    if name in ("analyze", "Diagnostic", "AnalysisError"):
+        from pathway_tpu import analysis
+
+        return getattr(analysis, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -248,4 +256,7 @@ __all__ = [
     "set_license_key",
     "set_monitoring_config",
     "G",
+    "analyze",
+    "Diagnostic",
+    "AnalysisError",
 ]

@@ -1,0 +1,107 @@
+"""Pre-flight static analysis of the captured dataflow graph.
+
+``analyze()`` walks the engine graph (``internals/parse_graph.G``) and
+the expression-VM programs compiled for it, BEFORE execution, and
+returns structured :class:`Diagnostic` findings — the build-time
+equivalent of the checks the reference Rust engine does inside
+``trait Graph`` (``src/engine/graph.rs``), plus perf and state-growth
+lints no runtime check can give you:
+
+- ``PW-T001`` (error)   type mismatch: join keys, concat columns, or a
+  declared column dtype the bytecode contradicts
+- ``PW-P001`` (warning) CALL_PY fallback on a streaming (hot) path
+- ``PW-S001`` (warning) unwindowed join/groupby over a streaming source
+- ``PW-S002`` (error)   append-only violation (deduplicate over a
+  retracting upstream)
+- ``PW-D001`` (warning) dead column: computed, never read
+- ``PW-N001`` (warning) nullability flowing into a non-optional
+  sink-reaching column
+
+Three surfaces: ``pathway_tpu.analyze()``, the CLI ``pathway_tpu lint
+program.py``, and strict mode (``pw.run(strict=True)`` /
+``PATHWAY_STRICT=1``) which refuses to start connectors while
+error-severity findings exist.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.analysis.diagnostics import (
+    CODES,
+    SEV_ERROR,
+    SEV_INFO,
+    SEV_WARNING,
+    AnalysisError,
+    Diagnostic,
+    count_by_severity,
+    format_diagnostics,
+    sort_diagnostics,
+)
+from pathway_tpu.analysis.graph_facts import GraphFacts
+from pathway_tpu.analysis.passes import ALL_PASSES
+
+__all__ = [
+    "analyze",
+    "lint_file",
+    "Diagnostic",
+    "AnalysisError",
+    "CODES",
+    "SEV_ERROR",
+    "SEV_WARNING",
+    "SEV_INFO",
+    "count_by_severity",
+    "format_diagnostics",
+    "GraphFacts",
+]
+
+
+def analyze(graph: Any = None) -> list[Diagnostic]:
+    """Statically analyze a captured graph (default: the global parse
+    graph) and return sorted diagnostics.  Never raises on exotic
+    graphs: a pass that cannot reason about a node skips it."""
+    if graph is None:
+        from pathway_tpu.internals.parse_graph import G
+
+        graph = G.engine_graph
+    engine_graph = getattr(graph, "engine_graph", graph)
+    facts = GraphFacts(engine_graph)
+    diags: list[Diagnostic] = []
+    for p in ALL_PASSES:
+        try:
+            diags.extend(p(engine_graph, facts))
+        except Exception:  # a broken pass must not block the run
+            continue
+    return sort_diagnostics(diags)
+
+
+def lint_file(path: str) -> list[Diagnostic]:
+    """Execute a pipeline script with ``pw.run``/``run_all`` stubbed to
+    no-ops so the graph gets BUILT but never executed, then analyze it.
+    Powers the CLI ``lint`` subcommand."""
+    import runpy
+
+    from pathway_tpu.internals import run as run_mod
+    from pathway_tpu.internals.parse_graph import G
+
+    saved_run, saved_run_all = run_mod.run, run_mod.run_all
+
+    def _no_run(*a: Any, **k: Any) -> None:
+        return None
+
+    G.clear()
+    run_mod.run = _no_run  # type: ignore[assignment]
+    run_mod.run_all = _no_run  # type: ignore[assignment]
+    import pathway_tpu as pw
+
+    pw_run, pw_run_all = pw.run, pw.run_all
+    pw.run = _no_run  # type: ignore[assignment]
+    pw.run_all = _no_run  # type: ignore[assignment]
+    try:
+        runpy.run_path(path, run_name="__main__")
+        return analyze()
+    finally:
+        run_mod.run = saved_run  # type: ignore[assignment]
+        run_mod.run_all = saved_run_all  # type: ignore[assignment]
+        pw.run = pw_run  # type: ignore[assignment]
+        pw.run_all = pw_run_all  # type: ignore[assignment]

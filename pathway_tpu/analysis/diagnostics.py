@@ -1,0 +1,104 @@
+"""Diagnostic records for the pre-flight static analyzer.
+
+Each finding carries a STABLE code (``PW-Xnnn``) so CI gates, dashboards
+and strict mode can match on it without parsing prose.  Codes:
+
+==========  ========  =====================================================
+code        severity  meaning
+==========  ========  =====================================================
+PW-T001     error     type mismatch (join keys, concat columns, or a
+                      declared column dtype the bytecode contradicts)
+PW-P001     warning   CALL_PY fallback in a program on a streaming (hot)
+                      path — the row loop drops off the native VM
+PW-S001     warning   unwindowed join/groupby over a streaming source:
+                      operator state grows without bound
+PW-S002     error     append-only violation: an operator that requires
+                      append-only input is fed retractions
+PW-D001     warning   dead column: computed by a select but never read by
+                      any downstream consumer
+PW-N001     warning   nullability leak: an optionally-None value flows
+                      into a column declared non-optional at a sink-reaching
+                      select
+==========  ========  =====================================================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+SEV_INFO = "info"
+
+_SEV_ORDER = {SEV_ERROR: 0, SEV_WARNING: 1, SEV_INFO: 2}
+
+#: every code the analyzer can emit, with its fixed severity
+CODES: dict[str, str] = {
+    "PW-T001": SEV_ERROR,
+    "PW-P001": SEV_WARNING,
+    "PW-S001": SEV_WARNING,
+    "PW-S002": SEV_ERROR,
+    "PW-D001": SEV_WARNING,
+    "PW-N001": SEV_WARNING,
+}
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One static-analysis finding (reference: the Rust engine's
+    build-time ``DataError``/trace plumbing, surfaced here as data
+    instead of an exception so callers can batch and filter)."""
+
+    code: str
+    severity: str
+    message: str
+    #: user file:line that created the offending operator (Node.trace)
+    trace: str = ""
+    node_id: int | None = None
+    node_name: str = ""
+    #: free-form extras (column name, dtypes involved, ...)
+    details: dict[str, Any] = field(default_factory=dict)
+
+    def format(self) -> str:
+        loc = f" at {self.trace}" if self.trace else ""
+        op = f" [{self.node_name}#{self.node_id}]" if self.node_id is not None else ""
+        return f"{self.code} {self.severity}: {self.message}{op}{loc}"
+
+
+class AnalysisError(RuntimeError):
+    """Raised by ``run(strict=True)`` when error-severity findings exist.
+
+    Carries the full diagnostic list in ``.diagnostics``."""
+
+    def __init__(self, diagnostics: list[Diagnostic]):
+        self.diagnostics = list(diagnostics)
+        errors = [d for d in self.diagnostics if d.severity == SEV_ERROR]
+        lines = "\n".join("  " + d.format() for d in errors)
+        super().__init__(
+            f"static analysis found {len(errors)} error-severity "
+            f"finding(s); refusing to run (strict mode):\n{lines}"
+        )
+
+
+def sort_diagnostics(diags: list[Diagnostic]) -> list[Diagnostic]:
+    """Severity-major, then graph order — stable for tests and CLI."""
+    return sorted(
+        diags,
+        key=lambda d: (
+            _SEV_ORDER.get(d.severity, 9),
+            d.code,
+            d.node_id if d.node_id is not None else 1 << 30,
+        ),
+    )
+
+
+def format_diagnostics(diags: list[Diagnostic]) -> str:
+    return "\n".join(d.format() for d in diags)
+
+
+def count_by_severity(diags: list[Diagnostic]) -> dict[str, int]:
+    out = {SEV_ERROR: 0, SEV_WARNING: 0, SEV_INFO: 0}
+    for d in diags:
+        out[d.severity] = out.get(d.severity, 0) + 1
+    return out

@@ -14,7 +14,7 @@ import os
 import subprocess
 import sys
 
-__all__ = ["main", "spawn"]
+__all__ = ["main", "spawn", "lint"]
 
 
 def spawn(
@@ -47,6 +47,27 @@ def spawn(
     return code
 
 
+def lint(program: str, *, werror: bool = False) -> int:
+    """Build ``program``'s dataflow graph without running it and print
+    the pre-flight analyzer's findings (``pathway_tpu/analysis/``).
+    Exit 1 on error-severity diagnostics (or any finding with
+    ``--werror``), 0 on a clean graph."""
+    from pathway_tpu.analysis import SEV_ERROR, format_diagnostics, lint_file
+
+    diags = lint_file(program)
+    if diags:
+        print(format_diagnostics(diags))
+    errors = sum(1 for d in diags if d.severity == SEV_ERROR)
+    warnings = len(diags) - errors
+    print(
+        f"{program}: {errors} error(s), {warnings} warning(s)",
+        file=sys.stderr,
+    )
+    if errors or (werror and diags):
+        return 1
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="pathway_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -62,6 +83,17 @@ def main(argv: list[str] | None = None) -> int:
 
     se = sub.add_parser("spawn-from-env", help="spawn using $PATHWAY_SPAWN_ARGS")
 
+    lp = sub.add_parser(
+        "lint",
+        help="statically analyze a pipeline's graph without running it",
+    )
+    lp.add_argument("program", help="Python file that builds the graph")
+    lp.add_argument(
+        "--werror",
+        action="store_true",
+        help="exit non-zero on warnings too",
+    )
+
     args = parser.parse_args(argv)
     if args.command == "spawn":
         return spawn(
@@ -76,6 +108,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "spawn-from-env":
         spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "").split()
         return main(["spawn", *spawn_args])
+    if args.command == "lint":
+        return lint(args.program, werror=args.werror)
     return 2
 
 

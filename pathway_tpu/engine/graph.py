@@ -135,6 +135,11 @@ class Node:
         #: re-annotated with it — reference OperatorProperties.trace,
         #: ``src/engine/graph.rs:441-463``)
         self.trace = _user_trace()
+        #: build-time annotations consumed by the pre-flight static
+        #: analyzer (pathway_tpu/analysis/): expression ASTs, declared
+        #: column names/dtypes, join-key pairs.  Never read by the engine
+        #: hot path and never shipped across processes.
+        self.meta: dict[str, Any] = {}
 
     def exchange_routes(self) -> list | None:
         """Multi-worker co-location: one route function per input port

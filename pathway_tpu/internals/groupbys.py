@@ -206,6 +206,20 @@ class GroupedTable:
             name="groupby",
             fast_spec=(tuple(fast_group), tuple(fast_reds)) if fast_ok else None,
         )
+        grouping_names = [
+            g._name for g in self._grouping if isinstance(g, ColumnReference)
+        ]
+        used: set[str] = set(grouping_names)
+        for re_expr in reducer_slots:
+            for a in re_expr._args:
+                try:
+                    for r in a._references():
+                        if r._name != "id":
+                            used.add(r._name)
+                except Exception:
+                    pass
+        node.meta["groupby"] = {"grouping": grouping_names}
+        node.meta["used_cols"] = sorted(used)
         inter_cols = inter_names + [f"__r{i}" for i in range(len(reducer_slots))]
         inter_dtypes: dict[str, dt.DType] = {}
         for i, g in enumerate(self._grouping):

@@ -143,6 +143,18 @@ class JoinResult:
             left_id_only=left_id_only,
             jk_programs=jk_programs,
         )
+        self._node.meta["join"] = {
+            "kind": kind.value,
+            "on": [
+                (
+                    smart_name(le) or "<expr>",
+                    getattr(le, "_dtype", dt.ANY),
+                    smart_name(re_) or "<expr>",
+                    getattr(re_, "_dtype", dt.ANY),
+                )
+                for le, re_ in zip(left_exprs, right_exprs)
+            ],
+        }
 
     # ------------------------------------------------------------------
     def _layout(self) -> _Layout:
@@ -221,6 +233,14 @@ class JoinResult:
             return tuple(c(kv) for c in compiled)
 
         node = eg.RowwiseNode(G.engine_graph, self._node, row_fn, name="join_select")
+        node.meta["used_cols"] = sorted(
+            {
+                r._name
+                for e in exprs
+                for r in e._references()
+                if r._name != "id"
+            }
+        )
         dtypes: dict[str, dt.DType] = {}
         for n, e in zip(names, exprs):
             if isinstance(e, ColumnReference) and not isinstance(e._table, ThisMetaclass):
@@ -232,6 +252,13 @@ class JoinResult:
                     dtypes[n] = e._dtype
             else:
                 dtypes[n] = e._dtype
+        node.meta["select"] = {
+            "kind": "join_select",
+            "names": names,
+            "exprs": exprs,
+            "layout": layout,
+            "dtypes": [dtypes[n] for n in names],
+        }
         return Table(node, names, dtypes, name="join")
 
     def filter(self, expr: Any) -> "JoinResult":

@@ -185,6 +185,10 @@ class ProberStats:
     #: per-stage streaming latency histogram snapshot
     #: ({stage: {count, p50_ms, p95_ms, p99_ms, max_ms, mean_ms}})
     latency: dict[str, Any] = field(default_factory=dict)
+    #: pre-flight static-analyzer finding counts by severity
+    #: ({"error": n, "warning": n, "info": n}) — what this deployed
+    #: graph was warned about before it started
+    analysis: dict[str, int] = field(default_factory=dict)
 
 
 def collect_stats(sched: Any) -> ProberStats:
@@ -220,6 +224,7 @@ def collect_stats(sched: Any) -> ProberStats:
         ),
         exchange=_exchange_stats(sched, ctx),
         latency=latency_stats(sched),
+        analysis=dict(getattr(sched, "analysis_findings", {}) or {}),
     )
 
 

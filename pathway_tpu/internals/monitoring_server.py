@@ -98,6 +98,14 @@ def _metrics_text(sched: Any) -> str:
                 f'pathway_tpu_stage_latency_count{{stage="{stage}"}} '
                 f"{d['count']}"
             )
+    # pre-flight static-analyzer finding counts (pathway_tpu/analysis/)
+    findings = getattr(sched, "analysis_findings", {}) or {}
+    if findings:
+        lines.append("# TYPE pathway_tpu_analysis_findings gauge")
+        for sev, n in sorted(findings.items()):
+            lines.append(
+                f'pathway_tpu_analysis_findings{{severity="{sev}"}} {n}'
+            )
     return "\n".join(lines) + "\n# EOF\n"
 
 
@@ -121,6 +129,10 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         "operators": len(sched.graph.nodes),
                         "errors": len(sched.ctx.error_log),
                         "latency": _latency_snapshot(sched),
+                        # pre-flight analyzer verdict for the running graph
+                        "analysis": dict(
+                            getattr(sched, "analysis_findings", {}) or {}
+                        ),
                     }
                 ).encode()
                 ctype = "application/json"

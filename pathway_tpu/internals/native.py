@@ -83,8 +83,10 @@ def load() -> Any:
             return None
         if not os.path.exists(_SRC):
             return None
-        so_path = _compile()
-        if so_path is None:
+        # PATHWAY_NATIVE_SO points at a prebuilt extension (the sanitizer
+        # harness builds an ASan/UBSan-instrumented .so out of tree)
+        so_path = os.environ.get("PATHWAY_NATIVE_SO") or _compile()
+        if so_path is None or not os.path.exists(so_path):
             return None
         try:
             spec = importlib.util.spec_from_file_location("pathway_native", so_path)

@@ -28,10 +28,42 @@ def run(
     autocommit_duration_ms: int | None = 50,
     persistence_config: Any = None,
     runtime_typechecking: bool | None = None,
+    strict: bool | None = None,
     **kwargs: Any,
 ):
-    """Run the whole computation graph (blocking until sources finish)."""
+    """Run the whole computation graph (blocking until sources finish).
+
+    ``strict=True`` (or ``PATHWAY_STRICT=1``) runs the pre-flight static
+    analyzer (``pathway_tpu/analysis/``) and raises
+    :class:`pathway_tpu.AnalysisError` on any error-severity finding —
+    BEFORE the scheduler exists, so no connector thread ever starts.
+    Finding counts are computed either way and surfaced through
+    monitoring (``/status`` → ``analysis``)."""
+    import os
+
     from pathway_tpu.internals import config as cfg
+
+    if strict is None:
+        strict = os.environ.get("PATHWAY_STRICT", "").lower() in (
+            "1",
+            "true",
+            "yes",
+        )
+    analysis_counts: dict[str, int] = {}
+    try:
+        from pathway_tpu.analysis import (
+            SEV_ERROR,
+            AnalysisError,
+            analyze,
+            count_by_severity,
+        )
+
+        diags = analyze(G.engine_graph)
+        analysis_counts = count_by_severity(diags)
+    except ImportError:
+        diags = []
+    if strict and any(d.severity == SEV_ERROR for d in diags):
+        raise AnalysisError(diags)
 
     if persistence_config is None:
         persistence_config = cfg.pathway_config.persistence_config
@@ -46,6 +78,7 @@ def run(
             with_http_server,
             autocommit_duration_ms,
             persistence_config,
+            analysis_counts,
         )
     finally:
         # per-run override, not a process-wide setting
@@ -58,6 +91,7 @@ def _run_inner(
     with_http_server: bool,
     autocommit_duration_ms: int | None,
     persistence_config: Any,
+    analysis_counts: dict[str, int] | None = None,
 ):
     from pathway_tpu.internals import config as cfg
     from pathway_tpu.internals.license import LicenseError, get_license
@@ -92,6 +126,8 @@ def _run_inner(
         G.engine_graph,
         autocommit_ms=autocommit_duration_ms or 50,
     )
+    #: pre-flight analyzer finding counts, read by monitoring//status
+    sched.analysis_findings = dict(analysis_counts or {})
     if with_http_server or cfg.pathway_config.monitoring_http_port:
         from pathway_tpu.internals.monitoring_server import start_http_server
 

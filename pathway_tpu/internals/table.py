@@ -32,6 +32,20 @@ from pathway_tpu.internals.thisclass import ThisMetaclass, left as LEFT, right a
 from pathway_tpu.engine import graph as eg
 
 
+def _referenced_names(exprs: Iterable[ColumnExpression]) -> list[str]:
+    """Input column names an expression list reads — build-time metadata
+    for the static analyzer's dead-column pass (``analysis/passes.py``)."""
+    names: set[str] = set()
+    for e in exprs:
+        try:
+            for r in e._references():
+                names.add(r._name)
+        except Exception:
+            pass
+    names.discard("id")
+    return sorted(names)
+
+
 class _Layout:
     """Maps column references to accessors over engine row tuples.
 
@@ -420,6 +434,14 @@ class Table:
             typecheck_info=(names, [dtypes[n] for n in names]),
             programs=_vm.lower_programs(exprs, layout),
         )
+        node.meta["select"] = {
+            "kind": "select",
+            "names": list(names),
+            "exprs": list(exprs),
+            "layout": layout,
+            "dtypes": [dtypes[n] for n in names],
+        }
+        node.meta["used_cols"] = _referenced_names(exprs)
         # select keeps row keys -> same universe token; new layout family
         return Table(
             node, names, dtypes, name=f"{self._name}.select",
@@ -603,6 +625,8 @@ class Table:
             G.engine_graph, in_node, lambda key, values: c((key, values)),
             program=_vm.lower_program(e, layout),
         )
+        node.meta["filter"] = {"exprs": [e], "layout": layout}
+        node.meta["used_cols"] = _referenced_names([e])
         if in_node is not self._node:
             # predicate needed zipped columns: project back to our layout
             n = len(self._column_names)
@@ -655,6 +679,14 @@ class Table:
             G.engine_graph, in_node, row_fn, name="with_columns",
             programs=_vm.lower_programs(all_exprs, layout),
         )
+        node.meta["select"] = {
+            "kind": "with_columns",  # pass-through columns exempt from PW-D001
+            "names": list(all_names),
+            "exprs": list(all_exprs),
+            "layout": layout,
+            "dtypes": [dtypes[n] for n in all_names],
+        }
+        node.meta["used_cols"] = _referenced_names(all_exprs)
         return Table(
             node, all_names, dtypes, name=f"{self._name}.with_columns",
             layout_token=self._layout_token,
@@ -766,6 +798,11 @@ class Table:
                     f"concat: column mismatch {t._column_names} vs {self._column_names}"
                 )
         node = eg.ConcatNode(G.engine_graph, [t._node for t in tables])
+        node.meta["concat"] = {
+            "columns": {
+                c: [t._dtypes[c] for t in tables] for c in self._column_names
+            }
+        }
         dtypes = {
             c: dt.lub_many(*[t._dtypes[c] for t in tables]) for c in self._column_names
         }
@@ -1038,6 +1075,10 @@ class Table:
             lambda key, values: ic((key, values)),
             acceptor_rows,
         )
+        dedup_refs = [value_e]
+        if instance is not None:
+            dedup_refs.append(self._subst(instance))
+        node.meta["used_cols"] = _referenced_names(dedup_refs)
         return Table(node, self._column_names, self._dtypes, name="deduplicate")
 
     # -- joins ---------------------------------------------------------------
@@ -1316,12 +1357,22 @@ class Table:
 
     # -- output helpers -------------------------------------------------------
     def _capture_node(self) -> eg.CaptureNode:
-        return eg.CaptureNode(G.engine_graph, self._node)
+        node = eg.CaptureNode(G.engine_graph, self._node)
+        node.meta["sink"] = {
+            "names": list(self._column_names),
+            "dtypes": dict(self._dtypes),
+        }
+        return node
 
     def _subscribe(self, on_change=None, on_time_end=None, on_end=None) -> eg.OutputNode:
-        return eg.OutputNode(
+        node = eg.OutputNode(
             G.engine_graph, self._node, on_change, on_time_end, on_end
         )
+        node.meta["sink"] = {
+            "names": list(self._column_names),
+            "dtypes": dict(self._dtypes),
+        }
+        return node
 
 
 def table_from_static_rows(

@@ -327,7 +327,13 @@ def attach_writer(table: Table, writer: Writer, *, name: str = "output") -> None
         writer.flush()
         writer.close()
 
-    eg.OutputNode(G.engine_graph, table._node, on_change, on_time_end, on_end, name=name)
+    node = eg.OutputNode(
+        G.engine_graph, table._node, on_change, on_time_end, on_end, name=name
+    )
+    node.meta["sink"] = {
+        "names": list(cols),
+        "dtypes": dict(table._dtypes),
+    }
 
 
 def format_change_row(row: dict[str, Any], time: int, diff: int) -> dict[str, Any]:

@@ -1,0 +1,253 @@
+#!/usr/bin/env python3
+"""Concurrency-discipline lint for the engine's threaded runtime.
+
+AST-based checks over ``engine/cluster.py`` and ``engine/scheduler.py``
+(and any file passed on the command line):
+
+- **LK001** — condition-variable ``wait()`` without a predicate
+  discipline: a ``cv.wait(...)`` must sit inside a ``while`` loop OR be
+  followed by a re-check of shared state in the same function (the
+  generation-wait idiom ``if self._seq != seen: ...; self._cv.wait(t);
+  return self._seq != seen`` re-checks after the wait).  A bare wait as
+  the final statement misses wakeups and races on spurious returns.
+- **LK002** — inconsistent lock acquisition order: two locks taken via
+  nested ``with`` blocks in both A→B and B→A order anywhere in the
+  linted set is a deadlock waiting for contention.
+- **LK003** — bare ``time.sleep`` in scheduler paths: the event-driven
+  scheduler must park on notified waits (``Event.wait`` /
+  ``WakeupHub.wait``), never on fixed sleeps that put a floor under
+  latency.  Connection-dial retry loops in ``cluster.py`` are exempt
+  (the peer genuinely isn't there yet).
+
+Usage: ``python scripts/check_locks.py [files...]``; exits 1 on
+findings.  Importable — tests feed synthetic sources through
+``check_source``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+
+#: attribute/variable names treated as condition variables
+CV_NAMES = {"_cv", "cv", "cond", "_cond", "condition", "_condition"}
+
+#: receivers whose .wait() is a notified single-waiter primitive, not a
+#: condvar (threading.Event, our WakeupHub generation-wait)
+NON_CV_WAIT = {"_stop", "stop", "hub", "_hub", "event", "_event", "_barrier"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    file: str
+    line: int
+    code: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line}: {self.code} {self.message}"
+
+
+def _recv_name(func: ast.expr) -> str | None:
+    """The receiver identifier of ``recv.meth(...)``: last attribute of
+    the receiver chain, or the bare variable name."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = func.value
+    if isinstance(recv, ast.Attribute):
+        return recv.attr
+    if isinstance(recv, ast.Name):
+        return recv.id
+    return None
+
+
+def _lock_name(expr: ast.expr) -> str | None:
+    """Identifier for a ``with <expr>:`` item that looks like a lock."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return None
+    return name if "lock" in name.lower() else None
+
+
+class _FunctionScanner(ast.NodeVisitor):
+    """Per-function scan for LK001: cv waits that are neither inside a
+    while loop nor followed by further statements (the re-check)."""
+
+    def __init__(self, filename: str, findings: list[Finding]):
+        self.filename = filename
+        self.findings = findings
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scan_function(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _scan_function(self, fn: ast.AST) -> None:
+        waits: list[ast.Call] = []
+        in_while: set[int] = set()
+
+        def walk(node: ast.AST, while_depth: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue  # nested functions scan separately
+                d = while_depth + (1 if isinstance(child, ast.While) else 0)
+                if isinstance(child, ast.Call):
+                    recv = _recv_name(child.func)
+                    if (
+                        isinstance(child.func, ast.Attribute)
+                        and child.func.attr == "wait"
+                        and recv in CV_NAMES
+                    ):
+                        waits.append(child)
+                        if d > 0:
+                            in_while.add(id(child))
+                walk(child, d)
+
+        walk(fn, 0)
+        if not waits:
+            return
+        # a wait outside any while loop needs a post-wait re-check: at
+        # least one statement in the function strictly after the wait
+        last_stmt_line = max(
+            getattr(n, "lineno", 0) for n in ast.walk(fn)
+        )
+        for w in waits:
+            if id(w) in in_while:
+                continue
+            if last_stmt_line > w.lineno:
+                continue  # something (a predicate re-check) follows
+            self.findings.append(
+                Finding(
+                    self.filename,
+                    w.lineno,
+                    "LK001",
+                    "condition-variable wait() outside a while loop with "
+                    "no predicate re-check after it; spurious wakeups and "
+                    "missed notifies will race",
+                )
+            )
+
+
+def _collect_lock_pairs(
+    tree: ast.AST, filename: str
+) -> dict[tuple[str, str], int]:
+    """(outer, inner) -> first line where that nesting order occurs."""
+    pairs: dict[tuple[str, str], int] = {}
+
+    def walk(node: ast.AST, held: list[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            acquired: list[str] = []
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    ln = _lock_name(item.context_expr)
+                    if ln is not None:
+                        for h in held + acquired:
+                            if h != ln:
+                                pairs.setdefault((h, ln), child.lineno)
+                        acquired.append(ln)
+            walk(child, held + acquired)
+
+    walk(tree, [])
+    return pairs
+
+
+def check_source(
+    source: str, filename: str, *, scheduler_path: bool | None = None
+) -> list[Finding]:
+    """Lint one file's source.  ``scheduler_path`` controls LK003
+    (default: filename contains 'scheduler')."""
+    findings: list[Finding] = []
+    tree = ast.parse(source, filename=filename)
+
+    _FunctionScanner(filename, findings).visit(tree)
+
+    if scheduler_path is None:
+        scheduler_path = "scheduler" in os.path.basename(filename)
+    if scheduler_path:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "sleep"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("time", "_time")
+            ):
+                findings.append(
+                    Finding(
+                        filename,
+                        node.lineno,
+                        "LK003",
+                        "bare time.sleep in a scheduler path; park on a "
+                        "notified wait (Event.wait / WakeupHub.wait) "
+                        "instead",
+                    )
+                )
+    return findings
+
+
+def check_lock_order(
+    sources: list[tuple[str, str]]
+) -> list[Finding]:
+    """LK002 across a set of ``(source, filename)`` pairs: the same two
+    locks nested in both orders."""
+    findings: list[Finding] = []
+    all_pairs: dict[tuple[str, str], tuple[str, int]] = {}
+    for source, filename in sources:
+        tree = ast.parse(source, filename=filename)
+        for pair, line in _collect_lock_pairs(tree, filename).items():
+            all_pairs.setdefault(pair, (filename, line))
+    reported: set[frozenset[str]] = set()
+    for (a, b), (fn, line) in sorted(all_pairs.items()):
+        if (b, a) in all_pairs and frozenset((a, b)) not in reported:
+            reported.add(frozenset((a, b)))
+            other_fn, other_line = all_pairs[(b, a)]
+            findings.append(
+                Finding(
+                    fn,
+                    line,
+                    "LK002",
+                    f"locks {a!r} and {b!r} are acquired in both orders "
+                    f"(other order at {other_fn}:{other_line}); pick one "
+                    "global order",
+                )
+            )
+    return findings
+
+
+DEFAULT_TARGETS = (
+    "pathway_tpu/engine/cluster.py",
+    "pathway_tpu/engine/scheduler.py",
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    files = args or [os.path.join(repo_root, t) for t in DEFAULT_TARGETS]
+    sources: list[tuple[str, str]] = []
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            sources.append((fh.read(), f))
+    findings: list[Finding] = []
+    for source, filename in sources:
+        findings.extend(check_source(source, filename))
+    findings.extend(check_lock_order(sources))
+    for fd in findings:
+        print(fd.format())
+    if findings:
+        print(f"{len(findings)} concurrency-discipline finding(s)", file=sys.stderr)
+        return 1
+    print(f"checked {len(files)} file(s): clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Repo-wide Python lint with a pinned, minimal rule set.
+#
+# Only rules that flag definite defects are enabled — this gate must
+# stay green on a healthy tree, so style-opinion rules are out:
+#   F63x — invalid comparisons (is-literal, ==/!= against tuples)
+#   F7xx — misplaced statements (return/yield/break outside scope)
+#   F82x — undefined names
+#
+# ruff is optional tooling: when it is not installed the script reports
+# SKIP and exits 0 so environments without it (including CI base
+# images) are not broken; exit 97 distinguishes the skip for callers
+# that want to require the tool.
+set -uo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+RULES="F63,F7,F82"
+
+RUFF=""
+if command -v ruff >/dev/null 2>&1; then
+    RUFF="ruff"
+elif python -c 'import ruff' >/dev/null 2>&1; then
+    RUFF="python -m ruff"
+fi
+
+if [ -z "$RUFF" ]; then
+    echo "lint_repo: ruff not available, SKIP" >&2
+    if [ "${LINT_REPO_REQUIRE:-0}" = "1" ]; then
+        exit 97
+    fi
+    exit 0
+fi
+
+set -e
+$RUFF check --select "$RULES" --no-cache \
+    "$REPO/pathway_tpu" "$REPO/scripts" "$REPO/tests" "$REPO/bench.py"
+echo "lint_repo: clean ($RULES)" >&2

@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Build native/pathway_native.cpp with AddressSanitizer + UBSan and run
+# the native test suite against the instrumented extension.
+#
+# The python interpreter itself is uninstrumented, so libasan must be
+# LD_PRELOADed and leak detection tuned: CPython's allocators hold
+# arena/interned-object memory for the life of the process, which ASan's
+# leak checker would misreport — the suppression file below keeps only
+# leaks attributable to our extension.
+#
+# Usage: scripts/sanitize_native.sh [pytest args...]
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+SRC="$REPO/native/pathway_native.cpp"
+BUILD="$REPO/native/build"
+OUT="$BUILD/pathway_native_asan.so"
+
+mkdir -p "$BUILD"
+
+INCLUDE="$(python -c 'import sysconfig; print(sysconfig.get_paths()["include"])')"
+
+echo "building $OUT with -fsanitize=address,undefined" >&2
+g++ -O1 -g -fno-omit-frame-pointer \
+    -fsanitize=address,undefined -fno-sanitize-recover=undefined \
+    -shared -fPIC -std=c++17 \
+    -I"$INCLUDE" "$SRC" -o "$OUT"
+
+LIBASAN="$(g++ -print-file-name=libasan.so)"
+if [ ! -e "$LIBASAN" ]; then
+    echo "libasan.so not found; cannot preload into uninstrumented python" >&2
+    exit 1
+fi
+
+SUPP="$BUILD/lsan_suppressions.txt"
+cat > "$SUPP" <<'EOF'
+# CPython keeps interpreter-lifetime allocations (arenas, interned
+# strings, type objects) that LSan cannot see the roots of.
+leak:Py
+leak:_Py
+leak:pymalloc
+leak:libpython
+# numpy's interpreter-lifetime allocator pools (default_malloc,
+# NpyString_new_allocator) — third-party, not ours
+leak:_multiarray_umath
+leak:numpy
+EOF
+
+echo "running tests/test_native.py under ASan+UBSan" >&2
+LD_PRELOAD="$LIBASAN" \
+ASAN_OPTIONS="detect_leaks=1:halt_on_error=1:abort_on_error=1" \
+LSAN_OPTIONS="suppressions=$SUPP" \
+UBSAN_OPTIONS="print_stacktrace=1:halt_on_error=1" \
+PATHWAY_NATIVE_SO="$OUT" \
+JAX_PLATFORMS=cpu \
+python -m pytest "$REPO/tests/test_native.py" -q -p no:cacheprovider "$@"
+
+echo "sanitizer run clean" >&2

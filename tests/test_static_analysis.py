@@ -1,0 +1,248 @@
+"""Tier-1 tests for the pre-flight static analyzer
+(``pathway_tpu/analysis/``): every diagnostic code has a trigger graph
+and a near-miss, plus the strict-mode abort-before-connectors gate."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis import (
+    SEV_ERROR,
+    SEV_WARNING,
+    AnalysisError,
+    analyze,
+)
+from pathway_tpu.internals import dtype as dt
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+def _static_table():
+    class S(pw.Schema):
+        word: str
+        n: int
+
+    return pw.debug.table_from_rows(S, [("a", 1), ("b", 2)])
+
+
+class _Subject(pw.io.python.ConnectorSubject):
+    """Never-started source: graphs here are analyzed, not run."""
+
+    def run(self) -> None:  # pragma: no cover - not executed
+        pass
+
+
+def _streaming_table():
+    class S(pw.Schema):
+        word: str
+        n: int
+
+    return pw.io.python.read(_Subject(), schema=S)
+
+
+# ---------------------------------------------------------------- T001
+
+
+def test_t001_join_key_type_mismatch():
+    class L(pw.Schema):
+        k: int
+        v: int
+
+    class R(pw.Schema):
+        k: str
+        w: int
+
+    left = pw.debug.table_from_rows(L, [(1, 10)])
+    right = pw.debug.table_from_rows(R, [("1", 20)])
+    left.join(right, left.k == right.k).select(pw.this.v, pw.this.w)
+    diags = analyze()
+    t001 = [d for d in diags if d.code == "PW-T001"]
+    assert t001 and t001[0].severity == SEV_ERROR
+
+
+def test_t001_join_key_match_clean():
+    class L(pw.Schema):
+        k: int
+        v: int
+
+    class R(pw.Schema):
+        k: int
+        w: int
+
+    left = pw.debug.table_from_rows(L, [(1, 10)])
+    right = pw.debug.table_from_rows(R, [(1, 20)])
+    left.join(right, left.k == right.k).select(pw.this.v, pw.this.w)
+    assert "PW-T001" not in codes(analyze())
+
+
+def test_t001_declare_type_contradiction():
+    t = _static_table()
+    t.select(s=pw.declare_type(str, pw.this.n + 1))
+    diags = analyze()
+    t001 = [d for d in diags if d.code == "PW-T001"]
+    assert t001 and t001[0].severity == SEV_ERROR
+
+
+def test_t001_declare_type_widening_clean():
+    t = _static_table()
+    # int -> float widening is a legal declaration
+    t.select(f=pw.declare_type(float, pw.this.n + 1))
+    assert "PW-T001" not in codes(analyze())
+
+
+# ---------------------------------------------------------------- P001
+
+
+def test_p001_call_py_on_streaming_column():
+    t = _streaming_table()
+    t.select(u=pw.apply(str.upper, t.word))
+    diags = analyze()
+    p001 = [d for d in diags if d.code == "PW-P001"]
+    assert p001 and p001[0].severity == SEV_WARNING
+
+
+def test_p001_static_call_py_clean():
+    t = _static_table()
+    t.select(u=pw.apply(str.upper, t.word))
+    assert "PW-P001" not in codes(analyze())
+
+
+def test_p001_vectorized_streaming_clean():
+    t = _streaming_table()
+    t.select(m=t.n + 1)  # lowers to pure VM bytecode, no CALL_PY
+    assert "PW-P001" not in codes(analyze())
+
+
+# ---------------------------------------------------------------- S001
+
+
+def test_s001_unwindowed_groupby_over_stream():
+    t = _streaming_table()
+    t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    diags = analyze()
+    s001 = [d for d in diags if d.code == "PW-S001"]
+    assert s001 and s001[0].severity == SEV_WARNING
+
+
+def test_s001_static_groupby_clean():
+    t = _static_table()
+    t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    assert "PW-S001" not in codes(analyze())
+
+
+# ---------------------------------------------------------------- S002
+
+
+def test_s002_deduplicate_over_retracting_input():
+    t = _streaming_table()
+    agg = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    agg.deduplicate(value=agg.c, acceptor=lambda new, old: new > old)
+    diags = analyze()
+    s002 = [d for d in diags if d.code == "PW-S002"]
+    assert s002 and s002[0].severity == SEV_ERROR
+
+
+def test_s002_deduplicate_over_append_only_clean():
+    t = _static_table()
+    t.deduplicate(value=t.n, acceptor=lambda new, old: new > old)
+    assert "PW-S002" not in codes(analyze())
+
+
+# ---------------------------------------------------------------- D001
+
+
+def test_d001_dead_column():
+    t = _static_table()
+    sel = t.select(t.word, dead=t.n + 1)
+    sel.select(t2=pw.this.word)._capture_node()
+    diags = analyze()
+    d001 = [d for d in diags if d.code == "PW-D001"]
+    assert d001 and d001[0].severity == SEV_WARNING
+    assert "dead" in d001[0].message
+
+
+def test_d001_used_column_clean():
+    t = _static_table()
+    sel = t.select(t.word, kept=t.n + 1)
+    sel.select(t2=pw.this.word, k=pw.this.kept)._capture_node()
+    assert "PW-D001" not in codes(analyze())
+
+
+# ---------------------------------------------------------------- N001
+
+
+def test_n001_optional_into_declared_non_optional_sink():
+    t = _static_table()
+    opt = pw.if_else(t.n > 1, t.n, None)  # Optional[int]
+    t.select(v=pw.declare_type(int, opt))._capture_node()
+    diags = analyze()
+    n001 = [d for d in diags if d.code == "PW-N001"]
+    assert n001 and n001[0].severity == SEV_WARNING
+
+
+def test_n001_unwrap_clean():
+    t = _static_table()
+    opt = pw.if_else(t.n > 1, t.n, None)
+    t.select(v=pw.unwrap(opt))._capture_node()
+    assert "PW-N001" not in codes(analyze())
+
+
+# ------------------------------------------------------------ surfaces
+
+
+def test_analyze_returns_sorted_diagnostics():
+    t = _streaming_table()
+    agg = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    agg.deduplicate(value=agg.c, acceptor=lambda new, old: new > old)
+    diags = analyze()
+    sevs = [d.severity for d in diags]
+    assert sevs == sorted(sevs, key=(SEV_ERROR, SEV_WARNING, "info").index)
+    assert all(d.format() for d in diags)
+
+
+def test_strict_mode_aborts_before_connector_starts():
+    started = threading.Event()
+
+    class Tracking(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            started.set()
+
+    class S(pw.Schema):
+        word: str
+
+    t = pw.io.python.read(Tracking(), schema=S)
+    # an error-severity finding: dedup over a retracting input
+    agg = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    agg.deduplicate(value=agg.c, acceptor=lambda new, old: new > old)
+    with pytest.raises(AnalysisError) as ei:
+        pw.run(strict=True)
+    assert any(d.code == "PW-S002" for d in ei.value.diagnostics)
+    assert not started.is_set(), "connector thread ran despite strict abort"
+
+
+def test_strict_env_var(monkeypatch):
+    monkeypatch.setenv("PATHWAY_STRICT", "1")
+
+    t = _streaming_table()
+    agg = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    agg.deduplicate(value=agg.c, acceptor=lambda new, old: new > old)
+    with pytest.raises(AnalysisError):
+        pw.run()
+
+
+def test_non_strict_run_tolerates_warnings():
+    t = _static_table()
+    t.select(t.word, t.n)._capture_node()
+    ctx = pw.run(strict=True)  # clean graph: strict run proceeds
+    assert ctx is not None
+
+
+def test_package_exports():
+    assert pw.analyze is analyze
+    assert pw.Diagnostic is not None
+    assert pw.AnalysisError is AnalysisError
