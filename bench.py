@@ -422,38 +422,65 @@ def _write_wc_input(d: str) -> str:
     return fp
 
 
-def bench_wordcount(extra: dict) -> None:
-    import pathway_tpu as pw
-    from pathway_tpu.internals.parse_graph import G
-
-    G.clear()
-    d = tempfile.mkdtemp(prefix="pw_bench_wc_")
-    fp = _write_wc_input(d)
+def _wc_graph(pw, fp: str):
+    """Wordcount with a select chain and an unread column: real work for
+    the optimizer (dead-column elimination + two select fusions)."""
 
     class S(pw.Schema):
         word: str
 
-    pdir = os.path.join(d, "pstorage")
-    log(f"wordcount: {WC_LINES} JSONL lines, persistence PERSISTING -> {pdir}")
-    t0 = time.perf_counter()
     lines = pw.io.jsonlines.read(fp, schema=S, mode="static")
     counts = lines.groupby(lines.word).reduce(lines.word, c=pw.reducers.count())
-    cap = counts._capture_node()
-    smoke_analyze("wordcount")
-    ctx = pw.run(
-        persistence_config=pw.persistence.Config(
-            backend=pw.persistence.Backend.filesystem(pdir)
+    viewd = counts.select(counts.word, c=counts.c, dead=counts.c * 100 + 1)
+    final = viewd.select(viewd.word, c=viewd.c)
+    return final._capture_node()
+
+
+def bench_wordcount(extra: dict) -> None:
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    d = tempfile.mkdtemp(prefix="pw_bench_wc_")
+    fp = _write_wc_input(d)
+    log(f"wordcount: {WC_LINES} JSONL lines, persistence PERSISTING -> {d}")
+    rps_by_level: dict[int, float] = {}
+    for level in (0, 2):
+        G.clear()
+        pdir = os.path.join(d, f"pstorage_opt{level}")
+        t0 = time.perf_counter()
+        cap = _wc_graph(pw, fp)
+        if level == 2:
+            smoke_analyze("wordcount")
+        ctx = pw.run(
+            optimize=level,
+            persistence_config=pw.persistence.Config(
+                backend=pw.persistence.Backend.filesystem(pdir)
+            ),
         )
-    )
-    dt = time.perf_counter() - t0
-    rps = WC_LINES / dt
-    rows = ctx.state(cap)["rows"]
-    total = sum(v[1] for v in rows.values())
-    assert total == WC_LINES, f"lost rows: {total} != {WC_LINES}"
-    log(f"wordcount: {WC_LINES} rows in {dt:.1f}s -> {rps:.0f} rows/s, {len(rows)} groups")
-    extra["wordcount_rows_per_sec"] = round(rps)
+        dt = time.perf_counter() - t0
+        rps = WC_LINES / dt
+        rows = ctx.state(cap)["rows"]
+        total = sum(v[1] for v in rows.values())
+        assert total == WC_LINES, f"lost rows: {total} != {WC_LINES}"
+        log(
+            f"wordcount[opt{level}]: {WC_LINES} rows in {dt:.1f}s -> "
+            f"{rps:.0f} rows/s, {len(rows)} groups"
+        )
+        rps_by_level[level] = rps
+        extra[f"wordcount_rows_per_sec_opt{level}"] = round(rps)
+    plan = getattr(G, "last_plan", None)
+    extra["wordcount_plan_rewrites"] = dict(plan.counters()) if plan else {}
+    # headline number is the default (optimized) path
+    extra["wordcount_rows_per_sec"] = round(rps_by_level[2])
     extra["wordcount_lines"] = WC_LINES
     extra["wordcount_persistence"] = "PERSISTING"
+    if SMOKE:
+        # the optimizer must never cost throughput; 0.7 absorbs noise on
+        # a seconds-long smoke corpus
+        assert rps_by_level[2] >= rps_by_level[0] * 0.7, (
+            f"optimize=2 ({rps_by_level[2]:.0f} rows/s) regressed vs "
+            f"optimize=0 ({rps_by_level[0]:.0f} rows/s)"
+        )
 
 
 def _run_wc_cluster(n_procs: int, fp: str, d: str) -> tuple[float, float, dict]:

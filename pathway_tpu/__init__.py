@@ -199,7 +199,7 @@ def __getattr__(name: str) -> Any:
         import pathway_tpu.analysis as analysis
 
         return analysis
-    if name in ("analyze", "Diagnostic", "AnalysisError"):
+    if name in ("analyze", "explain", "Diagnostic", "AnalysisError", "ExecutionPlan"):
         from pathway_tpu import analysis
 
         return getattr(analysis, name)
@@ -257,6 +257,8 @@ __all__ = [
     "set_monitoring_config",
     "G",
     "analyze",
+    "explain",
     "Diagnostic",
     "AnalysisError",
+    "ExecutionPlan",
 ]

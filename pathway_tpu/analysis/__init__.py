@@ -40,9 +40,12 @@ from pathway_tpu.analysis.diagnostics import (
 )
 from pathway_tpu.analysis.graph_facts import GraphFacts
 from pathway_tpu.analysis.passes import ALL_PASSES
+from pathway_tpu.analysis.plan import ExecutionPlan
+from pathway_tpu.analysis.rewrite import optimize_graph, resolve_level
 
 __all__ = [
     "analyze",
+    "explain",
     "lint_file",
     "Diagnostic",
     "AnalysisError",
@@ -53,6 +56,9 @@ __all__ = [
     "count_by_severity",
     "format_diagnostics",
     "GraphFacts",
+    "ExecutionPlan",
+    "optimize_graph",
+    "resolve_level",
 ]
 
 
@@ -73,6 +79,20 @@ def analyze(graph: Any = None) -> list[Diagnostic]:
         except Exception:  # a broken pass must not block the run
             continue
     return sort_diagnostics(diags)
+
+
+def explain(graph: Any = None, optimize: int | None = None) -> ExecutionPlan:
+    """Compile (but do not run) the execution plan for a captured graph
+    — default: the global parse graph at the default/env optimization
+    level.  Returns the :class:`ExecutionPlan` audit trail; ``print()``
+    it for the golden-tested textual form."""
+    if graph is None:
+        from pathway_tpu.internals.parse_graph import G
+
+        graph = G.engine_graph
+    engine_graph = getattr(graph, "engine_graph", graph)
+    _, plan = optimize_graph(engine_graph, resolve_level(optimize))
+    return plan
 
 
 def lint_file(path: str) -> list[Diagnostic]:

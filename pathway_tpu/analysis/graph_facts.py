@@ -92,7 +92,16 @@ class GraphFacts:
             if in_streaming:
                 self.streaming.add(n.id)
 
-            windowing = cls in _WINDOW_MARKERS or n.name == "window_assign"
+            windowing = (
+                cls in _WINDOW_MARKERS
+                or n.name == "window_assign"
+                # stdlib/temporal builders annotate their nodes with
+                # meta["temporal"]["bounded"]: windowed/watermark-evicted
+                # constructs (interval/asof joins, behaviors, window
+                # assignment) bound downstream key spaces and must not
+                # fall through analysis as opaque
+                or bool(n.meta.get("temporal", {}).get("bounded"))
+            )
             if isinstance(n, eg.GroupByNode):
                 grouping = n.meta.get("groupby", {}).get("grouping", ())
                 if "_pw_window" in grouping:

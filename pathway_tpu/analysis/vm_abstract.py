@@ -31,30 +31,9 @@ from pathway_tpu.internals.type_interpreter import (
     unary_result_dtype,
 )
 
-#: operand word count per opcode (code is a flat int list)
-_N_OPERANDS = {
-    vm.OP_LOAD_COL: 1,
-    vm.OP_LOAD_KEY: 0,
-    vm.OP_LOAD_CONST: 1,
-    vm.OP_CALL_PY: 1,
-    vm.OP_BIN: 1,
-    vm.OP_NEG: 0,
-    vm.OP_INV: 0,
-    vm.OP_IS_NONE: 0,
-    vm.OP_BRANCH: 2,
-    vm.OP_JUMP: 1,
-    vm.OP_JUMP_NOT_NONE: 1,
-    vm.OP_POP: 0,
-    vm.OP_REQUIRE: 1,
-    vm.OP_UNWRAP: 0,
-    vm.OP_FILL_JUMP: 1,
-    vm.OP_CAST: 1,
-    vm.OP_CONVERT: 2,
-    vm.OP_MAKE_TUPLE: 1,
-    vm.OP_GET: 2,
-    vm.OP_POINTER: 3,
-    vm.OP_METHOD: 3,
-}
+#: operand word count per opcode (code is a flat int list) — shared with
+#: the program-rewriting helpers (concat/renumber) in expr_vm
+_N_OPERANDS = vm.OPERAND_WIDTHS
 
 _CAST_DTYPES = {0: dt.INT, 1: dt.FLOAT, 2: dt.BOOL, 3: dt.STR}
 

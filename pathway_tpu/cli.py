@@ -47,16 +47,23 @@ def spawn(
     return code
 
 
-def lint(program: str, *, werror: bool = False) -> int:
+def lint(program: str, *, werror: bool = False, plan: bool = False) -> int:
     """Build ``program``'s dataflow graph without running it and print
     the pre-flight analyzer's findings (``pathway_tpu/analysis/``).
-    Exit 1 on error-severity diagnostics (or any finding with
+    With ``plan=True`` also print the optimizer's execution plan for the
+    built graph (``pw.explain()`` textual form, at the PATHWAY_OPTIMIZE
+    level).  Exit 1 on error-severity diagnostics (or any finding with
     ``--werror``), 0 on a clean graph."""
     from pathway_tpu.analysis import SEV_ERROR, format_diagnostics, lint_file
 
     diags = lint_file(program)
     if diags:
         print(format_diagnostics(diags))
+    if plan:
+        # lint_file leaves the built graph in place; compile its plan
+        from pathway_tpu.analysis import explain
+
+        print(explain().format())
     errors = sum(1 for d in diags if d.severity == SEV_ERROR)
     warnings = len(diags) - errors
     print(
@@ -93,6 +100,11 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="exit non-zero on warnings too",
     )
+    lp.add_argument(
+        "--plan",
+        action="store_true",
+        help="also print the optimizer's execution plan",
+    )
 
     args = parser.parse_args(argv)
     if args.command == "spawn":
@@ -109,7 +121,7 @@ def main(argv: list[str] | None = None) -> int:
         spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "").split()
         return main(["spawn", *spawn_args])
     if args.command == "lint":
-        return lint(args.program, werror=args.werror)
+        return lint(args.program, werror=args.werror, plan=args.plan)
     return 2
 
 

@@ -344,6 +344,31 @@ class FilterNode(Node):
         #: VM bytecode capsule for the predicate (internals/expr_vm.py)
         self.program = program
 
+    @classmethod
+    def detached(
+        cls,
+        input: Node,
+        pred: Callable[[Pointer, tuple], Any],
+        *,
+        node_id: int,
+        name: str = "filter",
+        program: Any = None,
+    ) -> "FilterNode":
+        """Build a filter without registering it in any graph — the plan
+        rewriter (analysis/rewrite.py) inserts these into its execution
+        view with an id it allocates itself, leaving the captured graph's
+        id space untouched."""
+        n = object.__new__(cls)
+        n.graph = input.graph
+        n.inputs = [input]
+        n.name = name
+        n.id = node_id
+        n.trace = input.trace
+        n.meta = {}
+        n.pred = pred
+        n.program = program
+        return n
+
     def process(self, ctx, time, inbatches):
         pred = self.pred
         native = _native.load()
@@ -638,6 +663,29 @@ class GroupByNode(Node):
             # stable_shard would (one C pass instead of per-row closures)
             route.positional = self.fast_spec[0]
         return [route]
+
+    def specialize_append_only(self) -> list[str]:
+        """Swap every reducer that has a non-retracting variant
+        (reducers.append_only_variant); returns the swapped reducers'
+        names.  Sound only when the input stream is proven append-only —
+        the caller (analysis/rewrite.py) owns that proof.  Builds a
+        fresh reducer_args list so a cloned node never mutates the
+        original's.  fast_spec stays valid: variants keep native_code 2,
+        the partial format the swapped-in merge_partial folds."""
+        from pathway_tpu.engine.reducers import append_only_variant
+
+        swapped: list[str] = []
+        new_args = []
+        for impl, arg_fn in self.reducer_args:
+            variant = append_only_variant(impl)
+            if variant is None:
+                new_args.append((impl, arg_fn))
+            else:
+                swapped.append(impl.name)
+                new_args.append((variant, arg_fn))
+        if swapped:
+            self.reducer_args = new_args
+        return swapped
 
     def make_state(self):
         # group_hash -> {gvals, accs: [...], count, last_out: tuple|None}

@@ -403,6 +403,128 @@ class StatefulReducer(ReducerImpl):
         return self.fold(rows)
 
 
+class _AppendOnlyExtreme(ReducerImpl):
+    """O(1) running-extreme accumulator for inputs the analyzer proved
+    append-only (``graph_facts.append_only``): no retraction can ever
+    arrive, so the multiset bookkeeping of :class:`_MultisetReducer`
+    is dead weight.  Negative diffs are ignored — the optimizer only
+    installs these when the proof holds, and the proof is the contract.
+
+    ``native_code`` stays 2: the native partial format (``{h: (delta,
+    args)}``) is folded directly, so a swapped reducer keeps the
+    groupby's ``fast_spec`` valid.
+    """
+
+    native_code = 2
+
+    def _better(self, a: Any, b: Any) -> bool:
+        raise NotImplementedError
+
+    def return_dtype(self, arg_dtypes):
+        return arg_dtypes[0] if arg_dtypes else dt.ANY
+
+    def make_acc(self):
+        return [None]
+
+    def update(self, acc, args, diff):
+        if diff <= 0:
+            return
+        v = args[0]
+        if v is None or v is api.ERROR:
+            return
+        if acc[0] is None or self._better(v, acc[0]):
+            acc[0] = v
+
+    def merge_partial(self, acc, partial):
+        for _, (delta, args) in partial.items():
+            if delta <= 0:
+                continue
+            v = args[0]
+            if v is None or v is api.ERROR:
+                continue
+            if acc[0] is None or self._better(v, acc[0]):
+                acc[0] = v
+
+    def extract(self, acc):
+        return acc[0]
+
+
+class AppendOnlyMinReducer(_AppendOnlyExtreme):
+    name = "min"
+
+    def _better(self, a, b):
+        return a < b
+
+
+class AppendOnlyMaxReducer(_AppendOnlyExtreme):
+    name = "max"
+
+    def _better(self, a, b):
+        return a > b
+
+
+class _AppendOnlyArgExtreme(_AppendOnlyExtreme):
+    """Append-only argmin/argmax: acc holds the best ``(value, key)``
+    pair; comparison is lexicographic, matching ``ArgMinReducer._pick``'s
+    ``key=lambda p: (p[0], p[1])`` tie-breaking exactly."""
+
+    n_args = 2
+
+    def return_dtype(self, arg_dtypes):
+        return dt.POINTER
+
+    def update(self, acc, args, diff):
+        if diff <= 0 or args[0] is None or args[0] is api.ERROR:
+            return
+        pair = (args[0], args[1])
+        if acc[0] is None or self._better(pair, acc[0]):
+            acc[0] = pair
+
+    def merge_partial(self, acc, partial):
+        for _, (delta, args) in partial.items():
+            if delta <= 0 or args[0] is None or args[0] is api.ERROR:
+                continue
+            pair = (args[0], args[1])
+            if acc[0] is None or self._better(pair, acc[0]):
+                acc[0] = pair
+
+    def extract(self, acc):
+        return None if acc[0] is None else acc[0][1]
+
+
+class AppendOnlyArgMinReducer(_AppendOnlyArgExtreme):
+    name = "argmin"
+
+    def _better(self, a, b):
+        return a < b
+
+
+class AppendOnlyArgMaxReducer(_AppendOnlyArgExtreme):
+    name = "argmax"
+
+    def _better(self, a, b):
+        return a > b
+
+
+#: exact-type table: MaxReducer subclasses MinReducer, so lookup must be
+#: by ``type(impl)``, never isinstance.  Deliberately absent: Unique
+#: (needs the distinct count), Any (its pick is defined over the *current*
+#: multiset ordering), the tuple family (extraction needs all elements).
+_APPEND_ONLY_VARIANTS: dict[type, Callable[[], ReducerImpl]] = {
+    MinReducer: AppendOnlyMinReducer,
+    MaxReducer: AppendOnlyMaxReducer,
+    ArgMinReducer: AppendOnlyArgMinReducer,
+    ArgMaxReducer: AppendOnlyArgMaxReducer,
+}
+
+
+def append_only_variant(impl: ReducerImpl) -> "ReducerImpl | None":
+    """Non-retracting drop-in for ``impl``, or None when the reducer has
+    no append-only specialization (or is already one)."""
+    cls = _APPEND_ONLY_VARIANTS.get(type(impl))
+    return cls() if cls is not None else None
+
+
 def make_reducer(name: str, **kwargs: Any) -> ReducerImpl:
     table: dict[str, Callable[[], ReducerImpl]] = {
         "count": CountReducer,

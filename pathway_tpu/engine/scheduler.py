@@ -179,6 +179,11 @@ class Scheduler:
         #: snapshot_connector_stats()/snapshot_operator_probes() without
         #: deadlocking; lock order is always cb_lock -> prober_lock.
         self._prober_cb_lock = threading.Lock()
+        #: optimizer audit trail (analysis/plan.ExecutionPlan) and its
+        #: per-pass rewrite counters — set by internals.run before the
+        #: run starts, read by /status + /metrics; None/{} when optimize=0
+        self.execution_plan: Any = None
+        self.plan_counters: dict[str, int] = {}
 
     # ------------------------------------------------------------------
     def snapshot_connector_stats(self) -> dict[str, dict]:

@@ -133,6 +133,182 @@ BIN_IDS = {
 
 _CAST_IDS = {dt.INT: 0, dt.FLOAT: 1, dt.BOOL: 2, dt.STR: 3}
 
+# ---------------------------------------------------------------------------
+# program shape tables — the single source of truth for code rewriting
+# (fusion splices in analysis/rewrite.py, abstract interpretation in
+# analysis/vm_abstract.py).  Code is a flat int list; every opcode has a
+# fixed operand count, and each operand slot is exactly one of: a plain
+# immediate, an absolute jump target, an index into the const pool, or an
+# index into the pyfunc pool.
+
+#: operand word count per opcode
+OPERAND_WIDTHS = {
+    OP_LOAD_COL: 1,
+    OP_LOAD_KEY: 0,
+    OP_LOAD_CONST: 1,
+    OP_CALL_PY: 1,
+    OP_BIN: 1,
+    OP_NEG: 0,
+    OP_INV: 0,
+    OP_IS_NONE: 0,
+    OP_BRANCH: 2,
+    OP_JUMP: 1,
+    OP_JUMP_NOT_NONE: 1,
+    OP_POP: 0,
+    OP_REQUIRE: 1,
+    OP_UNWRAP: 0,
+    OP_FILL_JUMP: 1,
+    OP_CAST: 1,
+    OP_CONVERT: 2,
+    OP_MAKE_TUPLE: 1,
+    OP_GET: 2,
+    OP_POINTER: 3,
+    OP_METHOD: 3,
+}
+
+#: operand slots holding absolute jump targets (may equal len(code) = END)
+_JUMP_SLOTS = {
+    OP_BRANCH: (0, 1),
+    OP_JUMP: (0,),
+    OP_JUMP_NOT_NONE: (0,),
+    OP_REQUIRE: (0,),
+    OP_FILL_JUMP: (0,),
+    OP_GET: (1,),
+}
+
+#: operand slots indexing the const pool
+_CONST_SLOTS = {OP_LOAD_CONST: (0,), OP_POINTER: (2,)}
+
+#: operand slots indexing the pyfunc pool
+_PYFUNC_SLOTS = {OP_CALL_PY: (0,)}
+
+
+def iter_program(code: list[int]):
+    """Yield ``(pc, op, operands)`` walking a flat code list.  Raises
+    ``ValueError`` on an unknown opcode — rewriting a program it cannot
+    fully parse would corrupt it."""
+    pc = 0
+    n = len(code)
+    while pc < n:
+        op = code[pc]
+        width = OPERAND_WIDTHS.get(op)
+        if width is None:
+            raise ValueError(f"unknown opcode {op} at pc {pc}")
+        yield pc, op, code[pc + 1 : pc + 1 + width]
+        pc += 1 + width
+
+
+def renumber_columns(code: list[int], mapping: Any) -> list[int]:
+    """Return a copy of ``code`` with every ``OP_LOAD_COL`` operand
+    remapped through ``mapping`` (a dict or callable).  The register
+    renumbering primitive behind filter pushdown: a predicate compiled
+    against a join's output frame (left cols ``0..ln-1``, right cols
+    ``ln..ln+rn-1``) is retargeted at one side's input frame by shifting
+    its column registers.  Raises ``KeyError`` when a register has no
+    mapping — the caller must have proven the program only touches the
+    columns being remapped."""
+    out = list(code)
+    get = mapping.__getitem__ if hasattr(mapping, "__getitem__") else mapping
+    for pc, op, ops in iter_program(code):
+        if op == OP_LOAD_COL:
+            out[pc + 1] = get(ops[0])
+    return out
+
+
+def concat_programs(
+    down: tuple[list[int], list[Any], list[Any]],
+    columns: dict[int, tuple[list[int], list[Any], list[Any]]],
+) -> tuple[list[int], list[Any], list[Any]]:
+    """Fuse two adjacent row programs into one: inline an upstream
+    select's per-column programs into a downstream program at each
+    ``OP_LOAD_COL`` site.
+
+    ``down`` and each ``columns[pos]`` are raw ``(code, consts,
+    pyfuncs)`` triples (see :func:`lower_raw`).  The result evaluates
+    the downstream program against the *upstream's input* frame: where
+    the downstream loaded column ``pos`` of the intermediate frame, it
+    now computes that column's defining program in place.  Upstream
+    jump targets shift by their splice offset; downstream jump targets
+    are remapped through a pc map built in the same walk (inlined code
+    changes all downstream offsets); const/pyfunc indices renumber into
+    the merged pools.  ``OP_LOAD_KEY`` needs no fixup — selects preserve
+    row keys, so both frames share the key.
+
+    Raises ``KeyError`` if the downstream loads a column with no
+    supplied program, ``ValueError`` on unparseable code."""
+    dcode, dconsts, dpy = down
+    out: list[int] = []
+    consts: list[Any] = []
+    pyfuncs: list[Any] = []
+    offsets: dict[Any, tuple[int, int]] = {}
+
+    def _pool(key: Any, c: list[Any], p: list[Any]) -> tuple[int, int]:
+        if key not in offsets:
+            offsets[key] = (len(consts), len(pyfuncs))
+            consts.extend(c)
+            pyfuncs.extend(p)
+        return offsets[key]
+
+    pc_map: dict[int, int] = {}
+    jump_fixes: list[tuple[int, int]] = []  # (out slot, old down target)
+    for pc, op, ops in iter_program(dcode):
+        pc_map[pc] = len(out)
+        if op == OP_LOAD_COL:
+            ucode, uconsts, upy = columns[ops[0]]
+            coff, poff = _pool(("col", ops[0]), uconsts, upy)
+            base = len(out)
+            piece = list(ucode)
+            for upc, uop, uops in iter_program(ucode):
+                for s in _JUMP_SLOTS.get(uop, ()):
+                    piece[upc + 1 + s] = base + uops[s]
+                for s in _CONST_SLOTS.get(uop, ()):
+                    piece[upc + 1 + s] = coff + uops[s]
+                for s in _PYFUNC_SLOTS.get(uop, ()):
+                    piece[upc + 1 + s] = poff + uops[s]
+            out.extend(piece)
+            continue
+        coff, poff = _pool("down", dconsts, dpy)
+        start = len(out)
+        out.append(op)
+        out.extend(ops)
+        for s in _JUMP_SLOTS.get(op, ()):
+            jump_fixes.append((start + 1 + s, ops[s]))
+        for s in _CONST_SLOTS.get(op, ()):
+            out[start + 1 + s] = coff + ops[s]
+        for s in _PYFUNC_SLOTS.get(op, ()):
+            out[start + 1 + s] = poff + ops[s]
+    pc_map[len(dcode)] = len(out)
+    for slot, old_t in jump_fixes:
+        out[slot] = pc_map[old_t]
+    return out, consts, pyfuncs
+
+
+def lower_raw(e: "ex.ColumnExpression", layout: Any) -> "_Asm | None":
+    """Lower one expression to an open-coded :class:`_Asm` (raw
+    ``code``/``consts``/``pyfuncs`` lists) for the rewriter to splice,
+    without compiling a capsule.  None when lowering fails."""
+    asm = _Asm(layout)
+    try:
+        _lower(e, asm)
+    except Exception:  # lowering must never break the rewriter
+        return None
+    return asm
+
+
+def compile_triple(
+    triple: tuple[list[int], list[Any], list[Any]]
+) -> Any | None:
+    """Compile a raw ``(code, consts, pyfuncs)`` triple to a VM program
+    capsule, or None when the native module is absent or rejects it."""
+    native = _native.load()
+    if native is None:
+        return None
+    code, consts, pyfuncs = triple
+    try:
+        return native.vm_compile(list(code), tuple(consts), tuple(pyfuncs))
+    except Exception:
+        return None
+
 
 class _Asm:
     def __init__(self, layout: Any):

@@ -268,6 +268,17 @@ class JoinResult:
         fnode = eg.FilterNode(
             G.engine_graph, self._node, lambda key, values: c((key, values))
         )
+        fnode.meta["filter"] = {"exprs": [e], "layout": layout}
+        # frame marker: the predicate is over the raw join output frame
+        # (lv + rv + (lk, rk)), which is what lets the optimizer push it
+        # below the join without substitution
+        fnode.meta["join_filter"] = {
+            "left_ncols": len(self._left._column_names),
+            "right_ncols": len(self._right._column_names),
+        }
+        fnode.meta["used_cols"] = sorted(
+            {r._name for r in e._references() if r._name != "id"}
+        )
         return JoinResult(
             self._left, self._right, [], self._kind, self._assign_id, _node=fnode
         )

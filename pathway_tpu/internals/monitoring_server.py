@@ -106,6 +106,19 @@ def _metrics_text(sched: Any) -> str:
             lines.append(
                 f'pathway_tpu_analysis_findings{{severity="{sev}"}} {n}'
             )
+    # plan-compiler rewrite counters (analysis/rewrite.py), one gauge
+    # per applied pass, plus the effective optimization level
+    plan_counters = getattr(sched, "plan_counters", {}) or {}
+    if plan_counters:
+        lines.append("# TYPE pathway_tpu_plan_rewrites gauge")
+        for pass_name, n in sorted(plan_counters.items()):
+            lines.append(
+                f'pathway_tpu_plan_rewrites{{pass="{pass_name}"}} {n}'
+            )
+    plan = getattr(sched, "execution_plan", None)
+    if plan is not None:
+        lines.append("# TYPE pathway_tpu_plan_level gauge")
+        lines.append(f"pathway_tpu_plan_level {plan.level}")
     return "\n".join(lines) + "\n# EOF\n"
 
 
@@ -133,6 +146,17 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         "analysis": dict(
                             getattr(sched, "analysis_findings", {}) or {}
                         ),
+                        # plan-compiler rewrite counters + level
+                        "plan": {
+                            "level": getattr(
+                                getattr(sched, "execution_plan", None),
+                                "level",
+                                0,
+                            ),
+                            "rewrites": dict(
+                                getattr(sched, "plan_counters", {}) or {}
+                            ),
+                        },
                     }
                 ).encode()
                 ctype = "application/json"

@@ -29,6 +29,7 @@ def run(
     persistence_config: Any = None,
     runtime_typechecking: bool | None = None,
     strict: bool | None = None,
+    optimize: int | None = None,
     **kwargs: Any,
 ):
     """Run the whole computation graph (blocking until sources finish).
@@ -38,7 +39,13 @@ def run(
     :class:`pathway_tpu.AnalysisError` on any error-severity finding —
     BEFORE the scheduler exists, so no connector thread ever starts.
     Finding counts are computed either way and surfaced through
-    monitoring (``/status`` → ``analysis``)."""
+    monitoring (``/status`` → ``analysis``).
+
+    ``optimize`` sets the plan-compiler level (0 = off, 1 = const-fold +
+    dead-column elimination + select/filter fusion, 2 = + append-only
+    specialization + join pushdowns); default comes from
+    ``PATHWAY_OPTIMIZE``, else 2.  The applied plan is available as
+    ``pw.explain()`` / ``G.last_plan`` and on ``/status`` → ``plan``."""
     import os
 
     from pathway_tpu.internals import config as cfg
@@ -65,6 +72,20 @@ def run(
     if strict and any(d.severity == SEV_ERROR for d in diags):
         raise AnalysisError(diags)
 
+    # plan compiler: rewrite a cloned execution view of the captured
+    # graph; the captured graph itself stays pristine (re-runs, explain)
+    exec_graph = G.engine_graph
+    plan = None
+    try:
+        from pathway_tpu.analysis.rewrite import optimize_graph, resolve_level
+
+        exec_graph, plan = optimize_graph(
+            G.engine_graph, resolve_level(optimize)
+        )
+    except ImportError:
+        pass
+    G.last_plan = plan
+
     if persistence_config is None:
         persistence_config = cfg.pathway_config.persistence_config
     pc = cfg.pathway_config
@@ -79,6 +100,8 @@ def run(
             autocommit_duration_ms,
             persistence_config,
             analysis_counts,
+            exec_graph=exec_graph,
+            plan=plan,
         )
     finally:
         # per-run override, not a process-wide setting
@@ -92,6 +115,8 @@ def _run_inner(
     autocommit_duration_ms: int | None,
     persistence_config: Any,
     analysis_counts: dict[str, int] | None = None,
+    exec_graph: Any = None,
+    plan: Any = None,
 ):
     from pathway_tpu.internals import config as cfg
     from pathway_tpu.internals.license import LicenseError, get_license
@@ -123,11 +148,14 @@ def _run_inner(
             threads * processes,
         )
     sched = Scheduler(
-        G.engine_graph,
+        exec_graph if exec_graph is not None else G.engine_graph,
         autocommit_ms=autocommit_duration_ms or 50,
     )
     #: pre-flight analyzer finding counts, read by monitoring//status
     sched.analysis_findings = dict(analysis_counts or {})
+    #: optimizer audit trail + rewrite counters (monitoring//status)
+    sched.execution_plan = plan
+    sched.plan_counters = plan.counters() if plan is not None else {}
     if with_http_server or cfg.pathway_config.monitoring_http_port:
         from pathway_tpu.internals.monitoring_server import start_http_server
 

@@ -59,6 +59,14 @@ def _asof(
         kind=kind,
         as_of_now=as_of_now,
     )
+    # analyzer annotation: asof keeps one match per left row under a
+    # watermark discipline — time-bounded state (PW-S001 near-miss)
+    node.meta["temporal"] = {
+        "kind": "asof_join",
+        "direction": direction.value if isinstance(direction, Direction) else direction,
+        "bounded": True,
+        "as_of_now": as_of_now,
+    }
     return JoinResult(self, other, [], how, _node=node)
 
 
@@ -120,6 +128,9 @@ def asof_now_join(
         right_ncols=len(other._column_names),
         kind="left" if how == JoinKind.LEFT else "inner",
     )
+    # analyzer annotation: matches once at arrival epoch, no revision —
+    # the left side is never buffered (PW-S001 near-miss)
+    node.meta["temporal"] = {"kind": "asof_now_join", "bounded": True}
     return JoinResult(self, other, [], how, _node=node)
 
 

@@ -94,6 +94,17 @@ def interval_join(
         right_ncols=len(other._column_names),
         kind=how,
     )
+    # analyzer annotation (graph_facts): finite interval bounds make this
+    # a time-windowed construct — state is watermark-evicted, so it does
+    # not accumulate unboundedly the way a plain join over a live source
+    # does (PW-S001 near-miss)
+    node.meta["temporal"] = {
+        "kind": "interval_join",
+        "how": how,
+        "bounded": True,
+        "lower": interval.lower_bound,
+        "upper": interval.upper_bound,
+    }
     return JoinResult(self, other, [], JoinKind[how.upper()], _node=node)
 
 

@@ -265,6 +265,9 @@ def windowby(
             lambda k, v: ic((k, v)),
             window,
         )
+        # analyzer annotation (graph_facts): session assignment is a
+        # windowing construct — bounds downstream stateful key spaces
+        node.meta["temporal"] = {"kind": "session_window", "bounded": True}
         assigned = Table(
             node,
             table._column_names + ["_pw_window"],
@@ -280,6 +283,11 @@ def windowby(
             return values + (tuple(win.assign(t, inst)),)
 
         rnode = eg.RowwiseNode(G.engine_graph, table._node, assign_row, name="window_assign")
+        rnode.meta["temporal"] = {
+            "kind": "window_assign",
+            "window": type(win).__name__,
+            "bounded": True,
+        }
         multi = Table(
             rnode,
             table._column_names + ["_pw_windows"],
@@ -325,6 +333,11 @@ def _apply_behavior(
             expiry_fn=exp_fn,
             keep_results=True,
         )
+        node.meta["temporal"] = {
+            "kind": "behavior",
+            "behavior": "exactly_once",
+            "bounded": True,
+        }
         return Table(
             node, assigned._column_names, assigned._dtypes, name="exactly_once"
         )
@@ -348,6 +361,12 @@ def _apply_behavior(
         expiry_fn=exp_fn,
         keep_results=behavior.keep_results,
     )
+    node.meta["temporal"] = {
+        "kind": "behavior",
+        "behavior": "common",
+        "bounded": True,
+        "keep_results": behavior.keep_results,
+    }
     return Table(node, assigned._column_names, assigned._dtypes, name="behavior")
 
 
@@ -413,6 +432,7 @@ def _intervals_over_windowby(table, tc, ic, window: IntervalsOverWindow, behavio
             return consolidate(out)
 
     node = ProbeAssignNode(G.engine_graph, table._node, at_table._node)
+    node.meta["temporal"] = {"kind": "intervals_over", "bounded": True}
     assigned = Table(
         node,
         table._column_names + ["_pw_window"],

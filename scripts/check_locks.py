@@ -18,6 +18,10 @@ AST-based checks over ``engine/cluster.py`` and ``engine/scheduler.py``
   ``WakeupHub.wait``), never on fixed sleeps that put a floor under
   latency.  Connection-dial retry loops in ``cluster.py`` are exempt
   (the peer genuinely isn't there yet).
+- **LK004** — ``cv.notify()`` / ``cv.notify_all()`` without a lexically
+  enclosing ``with`` over the condvar or a lock: ``threading.Condition``
+  raises RuntimeError; a hand-rolled condvar silently races the waiter's
+  predicate check (the classic lost-wakeup window).
 
 Usage: ``python scripts/check_locks.py [files...]``; exits 1 on
 findings.  Importable — tests feed synthetic sources through
@@ -136,6 +140,65 @@ class _FunctionScanner(ast.NodeVisitor):
             )
 
 
+#: condvar methods that require the condvar's lock to be held
+_NOTIFY_METHODS = {"notify", "notify_all"}
+
+
+def _check_notify_discipline(
+    tree: ast.AST, filename: str, findings: list[Finding]
+) -> None:
+    """LK004: ``cv.notify()`` / ``cv.notify_all()`` outside any lexically
+    enclosing ``with`` over the condvar (or a lock).  ``threading.
+    Condition`` raises RuntimeError at runtime; a hand-rolled condvar
+    silently races the waiter's predicate check instead."""
+
+    def _held_name(expr: ast.expr) -> str | None:
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        else:
+            return None
+        if name in CV_NAMES or "lock" in name.lower():
+            return name
+        return None
+
+    def walk(node: ast.AST, held: frozenset[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            inner = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                names = {
+                    n
+                    for n in (
+                        _held_name(item.context_expr) for item in child.items
+                    )
+                    if n is not None
+                }
+                if names:
+                    inner = held | names
+            if (
+                isinstance(child, ast.Call)
+                and isinstance(child.func, ast.Attribute)
+                and child.func.attr in _NOTIFY_METHODS
+                and _recv_name(child.func) in CV_NAMES
+                and not held
+            ):
+                findings.append(
+                    Finding(
+                        filename,
+                        child.lineno,
+                        "LK004",
+                        f"{child.func.attr}() on a condition variable "
+                        "without holding its lock (no enclosing `with` "
+                        "over the condvar or a lock); the wakeup races "
+                        "the waiter's predicate check",
+                    )
+                )
+            walk(child, inner)
+
+    walk(tree, frozenset())
+
+
 def _collect_lock_pairs(
     tree: ast.AST, filename: str
 ) -> dict[tuple[str, str], int]:
@@ -168,6 +231,7 @@ def check_source(
     tree = ast.parse(source, filename=filename)
 
     _FunctionScanner(filename, findings).visit(tree)
+    _check_notify_discipline(tree, filename, findings)
 
     if scheduler_path is None:
         scheduler_path = "scheduler" in os.path.basename(filename)

@@ -18,6 +18,16 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--regen-plans",
+        action="store_true",
+        default=False,
+        help="rewrite tests/plans/*.txt golden execution plans from the "
+        "current optimizer instead of comparing against them",
+    )
+
+
 def pytest_configure(config):
     # tier-1 runs `-m 'not slow'`: deterministic chaos/fault-injection
     # tests stay in tier-1 (marker `chaos`), long randomized drills are

@@ -104,6 +104,44 @@ def test_lk003_sleep_in_cluster_allowed(cl):
     assert cl.check_source(src, "cluster.py") == []
 
 
+def test_lk004_notify_without_lock_flagged(cl):
+    src = (
+        "class S:\n"
+        "    def kick(self):\n"
+        "        self._cv.notify_all()\n"
+    )
+    findings = cl.check_source(src, "x.py")
+    assert [f.code for f in findings] == ["LK004"]
+
+
+def test_lk004_notify_under_cv_clean(cl):
+    src = (
+        "class S:\n"
+        "    def kick(self):\n"
+        "        with self._cv:\n"
+        "            self._seq += 1\n"
+        "            self._cv.notify_all()\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk004_notify_under_associated_lock_clean(cl):
+    # condvar built over an explicit lock: holding the lock suffices
+    src = (
+        "class S:\n"
+        "    def kick(self):\n"
+        "        with self._state_lock:\n"
+        "            self.cond.notify()\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk004_non_cv_notify_ignored(cl):
+    # WakeupHub / Event style single-waiter primitives are not condvars
+    src = "def kick(hub):\n    hub.notify()\n"
+    assert cl.check_source(src, "x.py") == []
+
+
 def test_engine_files_clean():
     """The shipped cluster/scheduler must satisfy the discipline; this
     is the gate that keeps future edits honest."""

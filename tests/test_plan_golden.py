@@ -1,0 +1,111 @@
+"""Golden-plan harness: the optimizer's textual plan is a stable,
+reviewable artifact.
+
+Each builder constructs a deterministic graph (fresh-graph fixture
+guarantees stable node ids), runs the full level-2 pipeline, and
+compares ``plan.format()`` byte-for-byte against the committed file in
+``tests/plans/``.  An intentional optimizer change regenerates them:
+
+    python -m pytest tests/test_plan_golden.py --regen-plans
+
+then commit the updated ``tests/plans/*.txt`` alongside the change.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis.rewrite import optimize_graph
+from pathway_tpu.engine.graph import CaptureNode
+from pathway_tpu.internals.parse_graph import G
+
+PLANS_DIR = pathlib.Path(__file__).parent / "plans"
+
+
+class _W(pw.Schema):
+    word: str
+
+
+class _E(pw.Schema):
+    k: str
+    a: int
+    b: int
+
+
+def _build_wordcount():
+    # the acceptance graph: dead column + two-select chain over a groupby
+    words = pw.debug.table_from_rows(_W, [("a",), ("b",), ("a",)])
+    counts = words.groupby(words.word).reduce(words.word, n=pw.reducers.count())
+    mid = counts.select(counts.word, n=counts.n, dead=counts.n * 100 + 1)
+    return mid.select(mid.word, out=mid.n + 6)
+
+
+def _build_join_pushdown():
+    # selects feeding a join (projection pushdown), a post-join filter on
+    # a left column (filter pushdown), and a fusable second filter
+    t = pw.debug.table_from_rows(_E, [("a", 1, 2), ("b", 5, 1)])
+    s = pw.debug.table_from_rows(_E, [("a", 3, 4), ("b", 7, 0)])
+    lt = t.select(t.k, a=t.a + 0, b=t.b + 0)
+    rt = s.select(s.k, a=s.a + 0, b=s.b + 0)
+    j = lt.join(rt, lt.k == rt.k).select(
+        k=pw.left.k, la=pw.left.a, ra=pw.right.a
+    )
+    f1 = j.filter(j.la > 2)
+    return f1.filter(f1.ra > 0)
+
+
+def _build_append_only_groupby():
+    # inner join of append-only inputs keeps append-only-ness, so the
+    # min/max reducers specialize to non-retracting variants
+    t = pw.debug.table_from_rows(_E, [("a", 1, 2), ("a", 5, 1)])
+    s = pw.debug.table_from_rows(_E, [("a", 3, 4)])
+    j = t.join(s, t.k == s.k).select(k=pw.left.k, a=pw.left.a)
+    return j.groupby(j.k).reduce(
+        j.k,
+        lo=pw.reducers.min(j.a),
+        hi=pw.reducers.max(j.a),
+        n=pw.reducers.count(),
+    )
+
+
+GRAPHS = {
+    "wordcount": _build_wordcount,
+    "join_pushdown": _build_join_pushdown,
+    "append_only_groupby": _build_append_only_groupby,
+}
+
+
+def _plan_text(build) -> str:
+    G.clear()
+    table = build()
+    CaptureNode(G.engine_graph, table._node)
+    _exec_graph, plan = optimize_graph(G.engine_graph, 2)
+    return plan.format() + "\n"
+
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_plan_golden(request, name):
+    text = _plan_text(GRAPHS[name])
+    golden = PLANS_DIR / f"{name}.txt"
+    if request.config.getoption("--regen-plans"):
+        PLANS_DIR.mkdir(exist_ok=True)
+        golden.write_text(text)
+        pytest.skip(f"regenerated {golden.name}")
+    assert golden.exists(), (
+        f"missing golden plan {golden}; run "
+        "`python -m pytest tests/test_plan_golden.py --regen-plans`"
+    )
+    assert text == golden.read_text(), (
+        f"execution plan for {name!r} changed; if intentional, regenerate "
+        "with --regen-plans and commit the diff:\n" + text
+    )
+
+
+def test_plan_format_has_rewrites():
+    """The committed plans must actually exercise the optimizer — an
+    all-'(no rewrites)' set of goldens would test nothing."""
+    text = _plan_text(GRAPHS["wordcount"])
+    assert "dead_column_elim" in text and "select_fusion" in text

@@ -135,6 +135,63 @@ def test_s001_static_groupby_clean():
     assert "PW-S001" not in codes(analyze())
 
 
+def _streaming_events():
+    class S(pw.Schema):
+        k: str
+        t: int
+        v: int
+
+    return pw.io.python.read(_Subject(), schema=S)
+
+
+def test_s001_interval_join_bounds_downstream_state():
+    """A finite-interval temporal join is watermark-evicted: stateful
+    consumers downstream of it must not be reported as unbounded."""
+    from pathway_tpu.stdlib import temporal
+
+    a = _streaming_events()
+    b = _streaming_events()
+    j = temporal.interval_join(
+        a, b, a.t, b.t, temporal.interval(-1, 1), pw.left.k == pw.right.k
+    ).select(k=pw.left.k, v=pw.left.v)
+    j.groupby(j.k).reduce(j.k, c=pw.reducers.count())
+    assert "PW-S001" not in codes(analyze())
+
+
+def test_s001_asof_join_bounds_downstream_state():
+    from pathway_tpu.stdlib import temporal
+
+    a = _streaming_events()
+    b = _streaming_events()
+    j = temporal.asof_join(
+        a, b, a.t, b.t, pw.left.k == pw.right.k
+    ).select(k=pw.left.k, v=pw.left.v)
+    j.groupby(j.k).reduce(j.k, c=pw.reducers.count())
+    assert "PW-S001" not in codes(analyze())
+
+
+def test_s001_asof_now_join_bounds_downstream_state():
+    from pathway_tpu.stdlib import temporal
+
+    a = _streaming_events()
+    b = _streaming_events()
+    j = temporal.asof_now_join(a, b, pw.left.k == pw.right.k).select(
+        k=pw.left.k, v=pw.left.v
+    )
+    j.groupby(j.k).reduce(j.k, c=pw.reducers.count())
+    assert "PW-S001" not in codes(analyze())
+
+
+def test_s001_plain_join_still_fires_downstream():
+    """Positive control for the temporal near-misses: the same shape with
+    an unwindowed join keeps the diagnostic."""
+    a = _streaming_events()
+    b = _streaming_events()
+    a.join(b, a.k == b.k).select(k=pw.left.k, v=pw.right.v)
+    diags = analyze()
+    assert "PW-S001" in codes(diags)
+
+
 # ---------------------------------------------------------------- S002
 
 
