@@ -780,6 +780,127 @@ def bench_streaming_latency(extra: dict) -> None:
             )
 
 
+def bench_checkpoint_overhead(extra: dict) -> None:
+    """What epoch-aligned coordinated checkpointing charges the hot
+    path: the same OPERATOR_PERSISTING wordcount run with periodic async
+    checkpoints firing every ~50ms vs an interval too long to ever fire
+    (both still take the final sync snapshot, so the delta is exactly
+    the periodic pickle+enqueue cost the writer thread is meant to
+    hide).  Best-of-3 per config to shave scheduler noise."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.testing.chaos import ClusterDrill
+
+    # fixed corpus even in smoke: a 5% bound needs a run long enough
+    # that scheduler jitter (a few ms) can't masquerade as overhead
+    n_lines = 100_000 if SMOKE else min(WC_LINES, 200_000)
+    d = tempfile.mkdtemp(prefix="pw_bench_ckpt_")
+    fp = os.path.join(d, "lines.jsonl")
+    rng = np.random.default_rng(2)
+    with open(fp, "w") as f:
+        for w in rng.integers(0, WC_WORDS, size=n_lines):
+            f.write('{"word": "w%d"}\n' % w)
+    # cap epoch size so the run cuts many epochs — checkpoints ride
+    # epoch boundaries, one giant epoch would measure nothing
+    saved_rows = os.environ.get("PATHWAY_EPOCH_MAX_ROWS")
+    saved_interval = os.environ.pop("PATHWAY_CHECKPOINT_INTERVAL", None)
+    os.environ["PATHWAY_EPOCH_MAX_ROWS"] = str(max(n_lines // 32, 64))
+
+    def run_once(interval_s: float, tag: str, rep: int) -> float:
+        G.clear()
+        pdir = os.path.join(d, f"pstorage_{tag}_{rep}")
+        out_fp = os.path.join(d, f"out_{tag}_{rep}.jsonl")
+
+        # a real file sink, NOT _capture_node(): the debug capture keeps
+        # the full update stream in operator state, so checkpointing it
+        # would pickle O(corpus) bytes per snapshot and measure the
+        # bench harness, not the engine
+        class S(pw.Schema):
+            word: str
+
+        lines = pw.io.jsonlines.read(fp, schema=S, mode="static")
+        counts = lines.groupby(lines.word).reduce(
+            lines.word, n=pw.reducers.count()
+        )
+        pw.io.jsonlines.write(counts, out_fp)
+        pconf = pw.persistence.Config(
+            backend=pw.persistence.Backend.filesystem(pdir),
+            persistence_mode=pw.persistence.PersistenceMode.OPERATOR_PERSISTING,
+            checkpoint_interval=interval_s,
+        )
+        t0 = time.perf_counter()
+        pw.run(autocommit_duration_ms=20, persistence_config=pconf)
+        dt = time.perf_counter() - t0
+        final = json.loads(ClusterDrill.canonical_output(out_fp))
+        total = sum(final.values())
+        assert total == n_lines, f"lost rows: {total} != {n_lines}"
+        return dt
+
+    try:
+        log(f"checkpoint overhead: {n_lines} lines, OPERATOR_PERSISTING")
+        run_once(3600.0, "warm", 0)  # discarded: imports + page cache
+        # interleave configs: on a busy 1-core host, phase drift between
+        # two back-to-back batches dwarfs the effect being measured
+        base_times, ckpt_times = [], []
+        for rep in range(3):
+            base_times.append(run_once(3600.0, "off", rep))
+            ckpt_times.append(run_once(0.05, "on", rep))
+        base, ckpt = min(base_times), min(ckpt_times)
+    finally:
+        if saved_rows is None:
+            os.environ.pop("PATHWAY_EPOCH_MAX_ROWS", None)
+        else:
+            os.environ["PATHWAY_EPOCH_MAX_ROWS"] = saved_rows
+        if saved_interval is not None:
+            os.environ["PATHWAY_CHECKPOINT_INTERVAL"] = saved_interval
+    overhead = (ckpt - base) / base * 100.0
+    extra["wordcount_checkpoint_overhead_pct"] = round(overhead, 2)
+    extra["wordcount_checkpoint_base_seconds"] = round(base, 3)
+    extra["wordcount_checkpoint_on_seconds"] = round(ckpt, 3)
+    log(
+        f"checkpoint overhead: off {base:.2f}s -> on {ckpt:.2f}s "
+        f"= {overhead:+.1f}%"
+    )
+    if SMOKE and overhead > 5.0:
+        raise RuntimeError(
+            f"checkpoint overhead {overhead:.1f}% exceeds the 5% smoke "
+            "bound — async checkpointing is blocking the hot path"
+        )
+
+
+def bench_cluster_recovery(extra: dict) -> None:
+    """Kill-a-worker drill on a 2-process cluster: the seeded chaos
+    harness kills one rank mid-run, the ClusterSupervisor restarts the
+    generation, workers roll back to the last consistent checkpoint,
+    and the recovered sink output must byte-match the fault-free run.
+    Records detection+respawn wall time as ``cluster_recovery_seconds``."""
+    from pathway_tpu.testing.chaos import ClusterDrill
+
+    d = tempfile.mkdtemp(prefix="pw_bench_recover_")
+    drill = ClusterDrill(d, seed=7, processes=2, rows=400, kill_epoch=4)
+    log(
+        f"cluster recovery drill: 2 processes, kill rank "
+        f"{drill.kill_rank} at epoch {drill.kill_epoch}"
+    )
+    report = drill.run()
+    rec = report["recovery_seconds"]
+    extra["cluster_recovery_seconds"] = round(rec[0], 3) if rec else None
+    extra["cluster_recovery_restarts"] = report["restarts"]
+    extra["cluster_recovery_identical_output"] = report["identical"]
+    log(
+        f"cluster recovery: {report['restarts']} restart(s), "
+        f"recovery {rec[0]:.3f}s, output identical={report['identical']}"
+        if rec
+        else f"cluster recovery: no restart observed ({report})"
+    )
+    if not report["identical"]:
+        raise RuntimeError(
+            "recovered sink output diverged from the fault-free run"
+        )
+    if not report["restarts"]:
+        raise RuntimeError(f"chaos kill never fired: {report}")
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -815,6 +936,8 @@ def main() -> None:
         (bench_select, "select"),
         (bench_strdt, "strdt"),
         (bench_streaming_latency, "streaming_latency"),
+        (bench_checkpoint_overhead, "checkpoint_overhead"),
+        (bench_cluster_recovery, "cluster_recovery"),
     ]
     if not SMOKE:
         sections += [

@@ -35,13 +35,23 @@ native binary codec (``pack_updates_into``/``unpack_updates``) appended
 straight into a reusable transmission buffer; without the native module
 they fall back to pickled plain tuples.
 
-A worker failure surfaces as a broken socket on every peer, failing the
-whole run — the reference behaves the same (a worker panic aborts the
-cluster, ``dataflow.rs:5533-5536``); recovery is restart-from-persistence.
+A worker failure is detected in bounded time rather than discovered by an
+infinite ``recv``: every sender emits an empty heartbeat transmission when
+its link has been idle for ``PATHWAY_CLUSTER_HEARTBEAT_S`` (riding the
+existing framing — ``body_len=4, n_msgs=0`` decodes to zero deposits), and
+every reader runs its socket with a finite timeout so it can check a
+per-peer liveness deadline (``PATHWAY_CLUSTER_LIVENESS_TIMEOUT_S``).  A
+peer that goes silent past the deadline — or whose socket dies — fails the
+whole local mesh: ``_fail`` closes every socket so the failure propagates
+to all peers as EOFs within one io tick, and notifies the WakeupHub so
+parked workers observe it immediately.  The reference behaves the same (a
+worker panic aborts the cluster, ``dataflow.rs:5533-5536``); recovery is
+restart-from-persistence (see ``internals/resilience.ClusterSupervisor``).
 """
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
@@ -54,6 +64,26 @@ from pathway_tpu.internals import keys as K
 from pathway_tpu.internals import native as _native_mod
 
 __all__ = ["Cluster", "WakeupHub", "stable_shard"]
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+#: idle-link heartbeat period (seconds); each heartbeat is an empty
+#: transmission that refreshes the peer's liveness clock
+DEFAULT_HEARTBEAT_S = 1.0
+#: a peer silent for this long is declared dead (seconds); must comfortably
+#: exceed the heartbeat period so a single delayed frame never false-alarms
+DEFAULT_LIVENESS_TIMEOUT_S = 10.0
+
+#: a heartbeat is an EMPTY transmission: body_len=4, n_msgs=0.  The
+#: receiver's existing decoder sees zero messages and deposits nothing —
+#: the bytes themselves are the signal.
+_HEARTBEAT = struct.pack("<QI", 4, 0)
 
 
 class WakeupHub:
@@ -127,7 +157,9 @@ class _PeerSender(threading.Thread):
         self.links = links
         self._q: deque = deque()
         self._cv = threading.Condition()
-        self._stop = False
+        # NB: not "_stop" — that shadows threading.Thread._stop(),
+        # which join() calls internally on CPython 3.10
+        self._stopped = False
         self._buf = bytearray()
 
     def enqueue(self, slot: Any, kind: int, payload: Any) -> None:
@@ -137,38 +169,66 @@ class _PeerSender(threading.Thread):
 
     def stop(self) -> None:
         with self._cv:
-            self._stop = True
+            self._stopped = True
             self._cv.notify()
 
     def run(self) -> None:
         links = self.links
+        heartbeat_s = links.heartbeat_s
         try:
             while True:
+                idle = False
                 with self._cv:
-                    while not self._q and not self._stop:
-                        self._cv.wait()
-                    if not self._q:
+                    while not self._q and not self._stopped:
+                        if not self._cv.wait(heartbeat_s):
+                            idle = True
+                            break
+                    if self._q:
+                        idle = False
+                    elif self._stopped:
                         return  # stopped and drained
                     items = list(self._q)
                     self._q.clear()
+                if idle:
+                    # link idle past the heartbeat period: ship an empty
+                    # transmission so the peer's liveness clock advances
+                    self._transmit(_HEARTBEAT, 0)
+                    continue
                 # thread_time, not perf_counter: wall time in a helper
                 # thread mostly measures GIL waits while the workers run;
                 # this thread's own CPU is the compute it displaces
                 t0 = _time.thread_time()
                 body = self._encode(items)
                 t1 = _time.thread_time()
-                self.sock.sendall(body)
-                t2 = _time.thread_time()
-                st = links.stats
                 with links.stats_lock:
-                    st["transmissions"] += 1
-                    st["frames_sent"] += len(items)
-                    st["frames_coalesced"] += len(items) - 1
-                    st["bytes_sent"] += len(body)
-                    st["pack_ms"] += (t1 - t0) * 1e3
-                    st["send_ms"] += (t2 - t1) * 1e3
+                    links.stats["pack_ms"] += (t1 - t0) * 1e3
+                self._transmit(body, len(items))
         except Exception as e:  # socket OR encode failure: fail loudly
             links._fail(f"send link to process {self.peer} lost: {e!r}")
+
+    def _transmit(self, body: bytes | bytearray, n_frames: int) -> None:
+        """Ship one already-encoded transmission (``n_frames == 0`` marks a
+        heartbeat).  The single egress point for this link — fault
+        injection (``testing/chaos``) patches here to delay or drop frames,
+        and a dropped frame mutes heartbeats too, so a muted peer becomes
+        *detectably* dead instead of silently lossy."""
+        links = self.links
+        t0 = _time.thread_time()
+        self.sock.sendall(body)
+        t1 = _time.thread_time()
+        with links.stats_lock:
+            st = links.stats
+            if n_frames:
+                # heartbeats are deliberately NOT "transmissions": that
+                # stat means coalesced *data* sendalls, and its invariant
+                # frames_sent >= transmissions must survive idle links
+                st["transmissions"] += 1
+                st["frames_sent"] += n_frames
+                st["frames_coalesced"] += n_frames - 1
+            else:
+                st["heartbeats_sent"] += 1
+            st["bytes_sent"] += len(body)
+            st["send_ms"] += (t1 - t0) * 1e3
 
     # ------------------------------------------------------------------
     def _encode(self, items: list) -> bytearray:
@@ -243,12 +303,32 @@ class _ProcessLinks:
         n_processes: int,
         first_port: int,
         hub: "WakeupHub | None" = None,
+        heartbeat_s: float | None = None,
+        liveness_timeout_s: float | None = None,
     ):
         self.process_id = process_id
         self.n_processes = n_processes
         self._hub = hub
+        self.heartbeat_s = (
+            heartbeat_s
+            if heartbeat_s is not None
+            else _env_float("PATHWAY_CLUSTER_HEARTBEAT_S", DEFAULT_HEARTBEAT_S)
+        )
+        self.liveness_timeout_s = (
+            liveness_timeout_s
+            if liveness_timeout_s is not None
+            else _env_float(
+                "PATHWAY_CLUSTER_LIVENESS_TIMEOUT_S", DEFAULT_LIVENESS_TIMEOUT_S
+            )
+        )
+        #: finite socket timeout for the reader loops — short enough that
+        #: a reader re-checks its peer's liveness deadline several times
+        #: per timeout window, long enough to stay off the hot path
+        self._io_tick_s = max(0.01, min(1.0, self.liveness_timeout_s / 4.0))
         self._socks: dict[int, socket.socket] = {}
         self._senders: dict[int, _PeerSender] = {}
+        self._readers: list[threading.Thread] = []
+        self._last_seen: dict[int, float] = {}
         self._inbox: dict[Any, dict[int, Any]] = {}
         self._cv = threading.Condition()
         self._failed: str | None = None
@@ -256,6 +336,7 @@ class _ProcessLinks:
             "transmissions": 0,
             "frames_sent": 0,
             "frames_coalesced": 0,
+            "heartbeats_sent": 0,
             "bytes_sent": 0,
             "bytes_recv": 0,
             "pack_ms": 0.0,
@@ -283,13 +364,20 @@ class _ProcessLinks:
                 f"process {process_id}: cluster mesh incomplete "
                 f"({len(self._socks)}/{n_processes - 1} peers)"
             )
+        now = _time.monotonic()
         for peer, sock in self._socks.items():
+            self._last_seen[peer] = now
             sender = _PeerSender(peer, sock, self)
             self._senders[peer] = sender
             sender.start()
-            threading.Thread(
-                target=self._read_loop, args=(peer, sock), daemon=True
-            ).start()
+            reader = threading.Thread(
+                target=self._read_loop,
+                args=(peer, sock),
+                daemon=True,
+                name=f"pw-cluster-recv-{peer}",
+            )
+            self._readers.append(reader)
+            reader.start()
 
     def _dial(self, peer: int, first_port: int) -> socket.socket:
         deadline = _time.monotonic() + self._CONNECT_TIMEOUT_S
@@ -317,6 +405,7 @@ class _ProcessLinks:
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(self._CONNECT_TIMEOUT_S)  # bound the handshake
             peer = struct.unpack("<I", self._recv_exact(sock, 4))[0]
             self._socks[peer] = sock
 
@@ -330,21 +419,42 @@ class _ProcessLinks:
             buf += chunk
         return buf
 
-    @staticmethod
-    def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    def _recv_live(self, peer: int, sock: socket.socket, view: memoryview) -> None:
+        """Exact read that tolerates the finite socket timeout: partial
+        progress is kept across timeouts, and each timeout re-checks the
+        peer's liveness deadline — a peer silent past it (no data, no
+        heartbeats) is declared dead in bounded time."""
         got = 0
         n = len(view)
         while got < n:
-            r = sock.recv_into(view[got:])
+            try:
+                r = sock.recv_into(view[got:])
+            except socket.timeout:
+                silent_s = _time.monotonic() - self._last_seen[peer]
+                if silent_s > self.liveness_timeout_s:
+                    raise ConnectionError(
+                        f"peer process {peer} silent for {silent_s:.1f}s "
+                        f"(liveness timeout {self.liveness_timeout_s:.1f}s)"
+                    ) from None
+                continue
             if not r:
                 raise ConnectionError("peer closed")
             got += r
+            self._last_seen[peer] = _time.monotonic()
 
     def _fail(self, msg: str) -> None:
         with self._cv:
             if self._failed is None:
                 self._failed = msg
             self._cv.notify_all()
+        # turn a one-sided failure into a whole-mesh one: closing our
+        # sockets EOFs every peer's reader within one io tick, so the
+        # cluster fails together instead of timing out link by link
+        for sock in self._socks.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
         if self._hub is not None:
             self._hub.notify()
 
@@ -354,20 +464,24 @@ class _ProcessLinks:
         header_view = memoryview(header)
         body = bytearray(1 << 16)  # grows to the largest transmission seen
         try:
-            sock.settimeout(None)
+            # finite timeout: the reader must wake to check the liveness
+            # deadline even when the peer sends nothing at all
+            sock.settimeout(self._io_tick_s)
             while True:
-                self._recv_exact_into(sock, header_view)
+                self._recv_live(peer, sock, header_view)
                 (body_len,) = struct.unpack_from("<Q", header, 0)
                 if body_len > len(body):
                     body = bytearray(body_len)
                 mv = memoryview(body)[:body_len]
-                self._recv_exact_into(sock, mv)
+                self._recv_live(peer, sock, mv)
                 t0 = _time.thread_time()  # CPU displaced, not GIL waits
                 deposits = self._decode(mv, native)
                 dt = (_time.thread_time() - t0) * 1e3
                 with self.stats_lock:
                     self.stats["bytes_recv"] += 8 + body_len
                     self.stats["unpack_ms"] += dt
+                if not deposits:
+                    continue  # heartbeat: the bytes already did their job
                 with self._cv:
                     box = self._inbox
                     for slot, payload in deposits:
@@ -452,10 +566,11 @@ class _ProcessLinks:
     def recv_from_all(self, slot: Any) -> dict[int, Any]:
         """Block until every peer delivered a payload for ``slot``.
 
-        A pure notified wait: the reader threads ``notify_all`` on every
-        deposit and ``_fail`` notifies on link loss, so no poll interval
-        is needed — the old ``wait(timeout=1.0)`` quantized the exchange
-        tail to the poll grid whenever a wakeup was missed."""
+        A notified wait: the reader threads ``notify_all`` on every
+        deposit and ``_fail`` notifies on link loss.  The wait timeout is
+        defense-in-depth only (failure detection lives in the readers'
+        liveness deadlines); on the steady-state path a deposit notify
+        always arrives first, so nothing is quantized to the timeout."""
         with self._cv:
             while True:
                 if self._failed is not None:
@@ -463,11 +578,17 @@ class _ProcessLinks:
                 got = self._inbox.get(slot)
                 if got is not None and len(got) == self.n_processes - 1:
                     return self._inbox.pop(slot)
-                self._cv.wait()
+                self._cv.wait(1.0)
 
     def close(self) -> None:
+        """Bounded teardown: ask the senders to drain, give them a short
+        grace, then close the sockets (which breaks any sender stuck in
+        ``sendall`` and any reader parked in ``recv``) and re-join — no
+        unbounded join anywhere, so teardown cannot hang."""
         for sender in self._senders.values():
             sender.stop()
+        for sender in self._senders.values():
+            sender.join(0.5)
         for sock in self._socks.values():
             try:
                 sock.close()
@@ -477,6 +598,10 @@ class _ProcessLinks:
             self._listener.close()
         except OSError:
             pass
+        for sender in self._senders.values():
+            sender.join(1.5)
+        for reader in self._readers:
+            reader.join(1.5)
 
 
 class Cluster:
@@ -495,6 +620,8 @@ class Cluster:
         processes: int = 1,
         process_id: int = 0,
         first_port: int = 10000,
+        heartbeat_s: float | None = None,
+        liveness_timeout_s: float | None = None,
     ):
         self.threads = threads
         self.processes = processes
@@ -508,7 +635,14 @@ class Cluster:
         #: waits are recorded here when present
         self.latency: Any = None
         self._links = (
-            _ProcessLinks(process_id, processes, first_port, hub=self.wakeup)
+            _ProcessLinks(
+                process_id,
+                processes,
+                first_port,
+                hub=self.wakeup,
+                heartbeat_s=heartbeat_s,
+                liveness_timeout_s=liveness_timeout_s,
+            )
             if processes > 1
             else None
         )

@@ -162,6 +162,12 @@ class Node:
     def on_end(self, ctx: RunContext) -> None:
         pass
 
+    def on_restore(self, ctx: RunContext) -> None:
+        """Called once after this node's state was restored from an
+        operator snapshot, before any epoch runs.  Sinks reposition their
+        outputs to the checkpointed watermark here so replayed epochs
+        cannot double-emit; most operators need nothing."""
+
     def __repr__(self) -> str:
         return f"<{self.name}#{self.id}>"
 
@@ -1480,11 +1486,15 @@ class OutputNode(Node):
         on_time_end: Callable[[int], None] | None = None,
         on_end: Callable[[], None] | None = None,
         name: str = "subscribe",
+        writer: Any = None,
     ):
         super().__init__(graph, [input], name)
         self._on_change = on_change
         self._on_time_end = on_time_end
         self._on_end = on_end
+        #: the file writer behind this sink, when there is one — enables
+        #: checkpointed sink-dedup watermarks (see on_restore)
+        self._writer = writer
 
     def exchange_routes(self):
         return [cl.route_to_zero]
@@ -1506,10 +1516,26 @@ class OutputNode(Node):
         # drives the output lifecycle (single-writer semantics)
         if ctx.worker_id == 0 and self._on_time_end is not None:
             self._on_time_end(time)
+            if self._writer is not None:
+                # sink dedup watermark: the byte offset of everything
+                # emitted through this epoch, checkpointed with the
+                # operator state — on_restore truncates the file back to
+                # it, so replayed epochs never double-emit
+                wm = getattr(self._writer, "watermark", None)
+                if wm is not None:
+                    ctx.state(self)["sink_watermark"] = wm()
 
     def on_end(self, ctx):
         if ctx.worker_id == 0 and self._on_end is not None:
             self._on_end()
+
+    def on_restore(self, ctx):
+        if ctx.worker_id != 0 or self._writer is None:
+            return
+        resume = getattr(self._writer, "resume_at", None)
+        watermark = ctx.state(self).get("sink_watermark")
+        if resume is not None and watermark is not None:
+            resume(watermark)
 
 
 class ExportNode(Node):

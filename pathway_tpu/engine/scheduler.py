@@ -24,7 +24,7 @@ import os as _os
 import queue
 import threading
 import time as _time
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Any, Callable
 
 from pathway_tpu.engine.cluster import Cluster
@@ -184,6 +184,11 @@ class Scheduler:
         #: run starts, read by /status + /metrics; None/{} when optimize=0
         self.execution_plan: Any = None
         self.plan_counters: dict[str, int] = {}
+        #: restart generation of this process when running under the
+        #: cluster supervisor (internals.resilience.ClusterSupervisor sets
+        #: PATHWAY_WORKER_RESTARTS; internals.run copies it here) — feeds
+        #: the pathway_tpu_worker_restarts_total gauge
+        self.worker_restarts = 0
 
     # ------------------------------------------------------------------
     def snapshot_connector_stats(self) -> dict[str, dict]:
@@ -241,12 +246,25 @@ class Scheduler:
             q.put(None)
 
     def _snapshot_interval(self) -> float:
-        """Snapshot rate limit in ms — ONE policy for single-worker and
-        cluster paths (they must snapshot at the same cadence)."""
-        return max(
-            getattr(self.persistence.config, "snapshot_interval_ms", 0),
-            self.autocommit_ms,
-        )
+        """Checkpoint cadence in ms — ONE policy for single-worker and
+        cluster paths (they must snapshot at the same cadence).
+        Precedence: ``PATHWAY_CHECKPOINT_INTERVAL`` env (seconds), then
+        ``Config(checkpoint_interval=)`` (seconds), then the legacy
+        ``snapshot_interval_ms``; always floored by the autocommit
+        interval (checkpoints ride epoch cuts, which happen no more often
+        than that)."""
+        cfg = self.persistence.config
+        interval_ms = float(getattr(cfg, "snapshot_interval_ms", 0) or 0)
+        ci = getattr(cfg, "checkpoint_interval", None)
+        env = _os.environ.get("PATHWAY_CHECKPOINT_INTERVAL")
+        if env:
+            try:
+                ci = float(env)
+            except ValueError:
+                pass
+        if ci is not None:
+            interval_ms = float(ci) * 1000.0
+        return max(interval_ms, self.autocommit_ms)
 
     def _maybe_snapshot(
         self,
@@ -256,15 +274,17 @@ class Scheduler:
         wrappers: dict[int, Any],
         ctx: RunContext | None = None,
     ) -> None:
-        """Operator snapshot, rate-limited by snapshot_interval_ms.  The
-        input logs are force-committed FIRST so the snapshot's consumed
-        counts always lie within each log's committed prefix."""
+        """Operator snapshot, rate-limited by the checkpoint interval.
+        Periodic checkpoints are asynchronous: state pickles here at the
+        epoch boundary, disk writes happen off the hot path."""
         interval = self._snapshot_interval()
         now = _time.monotonic()
         if (now - self._last_snapshot_at.get(worker, 0.0)) * 1000.0 < interval:
             return
         self._last_snapshot_at[worker] = now
-        self._final_snapshot(worker, epoch, consumed, wrappers, ctx=ctx)
+        self._final_snapshot(
+            worker, epoch, consumed, wrappers, ctx=ctx, asynchronous=True
+        )
 
     def _final_snapshot(
         self,
@@ -273,22 +293,58 @@ class Scheduler:
         consumed: dict[int, int],
         wrappers: dict[int, Any],
         ctx: RunContext | None = None,
+        asynchronous: bool = False,
     ) -> None:
-        """Unconditional snapshot: force-commit the input logs (so consumed
-        counts lie within each log's committed prefix), then persist the
-        worker's node states.  Called after the finalizing epoch on clean
-        shutdown, so buffered windows flushed by finalize never re-flush
-        on resume."""
+        """Operator snapshot: force-commit the input logs (so the
+        snapshot's consumed counts lie within each log's committed
+        prefix), then persist the worker's node states.
+
+        ``asynchronous=True`` (periodic checkpoints): the state pickles on
+        THIS thread at the epoch boundary, but the log commits and the
+        blob write run on the persistence writer thread — the hot path
+        never blocks on disk.  Commit-before-blob ordering is preserved on
+        the writer, so a visible snapshot is always consistent with the
+        log.  The synchronous path (final snapshot after the finalizing
+        flush epoch) drains the async queue FIRST, so the final blob —
+        whose state must never re-flush buffered windows on resume — can
+        never be overwritten by a stale queued checkpoint."""
         if self.persistence is None or not self.persistence.operator_mode:
             return
+        ctx = ctx or self.ctx
+        if asynchronous:
+            save_async = getattr(
+                self.persistence, "save_operator_snapshot_async", None
+            )
+            if save_async is not None:
+                commit_fns = tuple(
+                    fc
+                    for wr in wrappers.values()
+                    if (fc := getattr(wr, "force_log_commit", None)) is not None
+                )
+                save_async(worker, epoch, consumed, ctx.states, commit_fns)
+                return
+        flush = getattr(self.persistence, "flush_checkpoints", None)
+        if flush is not None:
+            flush()
         for w in wrappers.values():
             fc = getattr(w, "force_log_commit", None)
             if fc is not None:
                 fc()
-        ctx = ctx or self.ctx
         self.persistence.save_operator_snapshot(
             worker, epoch, consumed, ctx.states
         )
+
+    def _restore_nodes(self, ctx: RunContext) -> None:
+        """Post-restore hook pass: after operator state is restored from a
+        snapshot, every node gets ``on_restore(ctx)`` — sinks use it to
+        reposition their output files to the checkpointed watermark so
+        replayed epochs cannot double-emit.  A failing hook is contained
+        like any operator error (degraded output beats a dead run)."""
+        for node in self.graph.nodes:
+            try:
+                node.on_restore(ctx)
+            except Exception as e:
+                ctx.log_error(node, f"{node.name}#{node.id} on_restore: {e!r}")
 
     def active_closure(self, root_ids: set[int]) -> set[int]:
         """Node ids reachable from ``root_ids`` or from always-tick nodes —
@@ -527,6 +583,7 @@ class Scheduler:
         if snap is not None:
             self.ctx.states = snap["states"]
             t = snap["epoch"] + TIME_STEP
+            self._restore_nodes(self.ctx)
         elif static_inject:
             # static rows re-inject only when no snapshot holds them already
             self.run_epoch(t, static_inject)
@@ -650,6 +707,9 @@ class Scheduler:
         autocommit_s = self.autocommit_ms / 1000.0
         commit_requested = False
         rows_buffered = 0
+        #: remainder of a batch item split at the epoch row budget; it
+        #: re-enters the drain ahead of the queue, preserving source order
+        carry: deque = deque()
         #: monotonic instants of the oldest / newest buffered arrival
         first_arrival: float | None = None
         last_arrival = 0.0
@@ -673,13 +733,16 @@ class Scheduler:
             else:
                 timeout = autocommit_s
             item = None
-            try:
-                if timeout > 0.0:
-                    item = q.get(timeout=timeout)
-                else:
-                    item = q.get_nowait()
-            except queue.Empty:
-                pass
+            if carry:
+                item = carry.popleft()  # remainder of a budget-split batch
+            else:
+                try:
+                    if timeout > 0.0:
+                        item = q.get(timeout=timeout)
+                    else:
+                        item = q.get_nowait()
+                except queue.Empty:
+                    pass
             # Greedy drain: pull everything already queued into the buffers
             # in one pass, so epoch size tracks the actual backlog instead
             # of one queue item per loop iteration (an epoch that takes
@@ -698,8 +761,18 @@ class Scheduler:
                     buffers[nid].append(Update(key, values, 1))
                     rows_buffered += 1
                 elif kind == "batch":
-                    buffers[nid].extend(key)
-                    rows_buffered += len(key)
+                    room = self._epoch_max_rows - rows_buffered
+                    if 0 < room < len(key):
+                        # budget-split: the remainder re-enters the drain
+                        # first next pass, preserving per-source order
+                        buffers[nid].extend(key[:room])
+                        rows_buffered += room
+                        carry.appendleft(
+                            (nid, "batch", key[room:], values, enq_ns)
+                        )
+                    else:
+                        buffers[nid].extend(key)
+                        rows_buffered += len(key)
                 elif kind == "remove":
                     buffers[nid].append(Update(key, values, -1))
                     rows_buffered += 1
@@ -715,8 +788,11 @@ class Scheduler:
                         if origin_ns is None or enq_ns < origin_ns:
                             origin_ns = enq_ns
                 drained += 1
-                if drained >= 8192:
-                    break  # bounded pass: cut/stop checks must run
+                if drained >= 8192 or rows_buffered >= self._epoch_max_rows:
+                    # bounded pass: cut/stop checks must run — the row
+                    # budget caps the epoch even when the producer lands
+                    # a whole static file in one drain
+                    break
                 try:
                     item = q.get_nowait()
                 except queue.Empty:
@@ -767,7 +843,7 @@ class Scheduler:
                     and self.persistence.operator_mode
                 ):
                     self._maybe_snapshot(0, t - TIME_STEP, consumed, wrappers)
-            if not open_subjects and not any(buffers.values()):
+            if not open_subjects and not any(buffers.values()) and not carry:
                 # order matters: loopback workers enqueue their result BEFORE
                 # decrementing pending, so pending==0 guarantees every result
                 # is already visible to the q.empty() check after it
@@ -820,9 +896,27 @@ class Scheduler:
         ]
         for w in workers:
             w.start()
-        work(0)
-        for w in workers:
-            w.join()
+        try:
+            work(0)
+            # bounded joins: a sibling stuck in a collective or a socket
+            # call is freed by cluster.close() below — never hang forever
+            deadline = _time.monotonic() + 10.0
+            for w in workers:
+                w.join(max(0.0, deadline - _time.monotonic()))
+            if any(w.is_alive() for w in workers):
+                cluster.close()  # abort barriers, break sockets
+                for w in workers:
+                    w.join(2.0)
+        except KeyboardInterrupt:
+            # ^C: clean teardown instead of a hang — stop the run, break
+            # every collective and socket wait, give workers a short
+            # grace, then re-raise to the caller
+            self._stop.set()
+            cluster.close()
+            for w in workers:
+                w.join(2.0)
+            self._active_cluster = None
+            raise
         self._active_cluster = None
         if errors:
             raise errors[0]
@@ -919,6 +1013,8 @@ class Scheduler:
         commit_requested = False
         autocommit_s = self.autocommit_ms / 1000.0
         rows_buffered = 0
+        #: remainder of a batch item split at the epoch row budget
+        carry: deque = deque()
         first_arrival: float | None = None
         last_arrival = 0.0
         origin_ns: int | None = None
@@ -937,10 +1033,13 @@ class Scheduler:
             data_drained = False
             drain_ns = now_ns()
             while drained < 8192:
-                try:
-                    item = q.get_nowait()
-                except queue.Empty:
-                    break
+                if carry:
+                    item = carry.popleft()  # budget-split batch remainder
+                else:
+                    try:
+                        item = q.get_nowait()
+                    except queue.Empty:
+                        break
                 if item is None:
                     continue  # wake sentinel from stop()
                 nid, kind, key, values, enq_ns = item
@@ -949,8 +1048,16 @@ class Scheduler:
                     buffers[nid].append(Update(key, values, 1))
                     rows_buffered += 1
                 elif kind == "batch":
-                    buffers[nid].extend(key)
-                    rows_buffered += len(key)
+                    room = self._epoch_max_rows - rows_buffered
+                    if 0 < room < len(key):
+                        buffers[nid].extend(key[:room])
+                        rows_buffered += room
+                        carry.appendleft(
+                            (nid, "batch", key[room:], values, enq_ns)
+                        )
+                    else:
+                        buffers[nid].extend(key)
+                        rows_buffered += len(key)
                 elif kind == "remove":
                     buffers[nid].append(Update(key, values, -1))
                     rows_buffered += 1
@@ -965,6 +1072,10 @@ class Scheduler:
                         lat.record("ingest", drain_ns - enq_ns)
                         if origin_ns is None or enq_ns < origin_ns:
                             origin_ns = enq_ns
+                if rows_buffered >= self._epoch_max_rows:
+                    # row budget reached: stop draining so the epoch cuts
+                    # even when a static file lands in one burst
+                    break
 
             aux_pending = sum(
                 getattr(n.subject, "pending_count", lambda: 0)() for n in my_aux
@@ -998,7 +1109,7 @@ class Scheduler:
             )
             snap_elapsed_ms = (now - self._last_snapshot_at.get(w, 0.0)) * 1000.0
             status = (
-                any(buffers.values()) or not q.empty(),
+                any(buffers.values()) or bool(carry) or not q.empty(),
                 len(open_subjects),
                 aux_pending,
                 commit_requested,
@@ -1080,10 +1191,14 @@ class Scheduler:
                 ):
                     if snapshot_due >= self._snapshot_interval():
                         # every worker reaches the same verdict (gathered
-                        # max), so all snapshot this same cut epoch
+                        # max), so all checkpoint this same cut epoch — a
+                        # globally-consistent coordinated checkpoint.
+                        # Async: state pickles here, disk I/O rides the
+                        # persistence writer thread off the epoch loop.
                         self._last_snapshot_at[w] = _time.monotonic()
                         self._final_snapshot(
-                            w, t - TIME_STEP, consumed, wrappers, ctx=ctx
+                            w, t - TIME_STEP, consumed, wrappers, ctx=ctx,
+                            asynchronous=True,
                         )
             elif stop or (source_done and not any_data):
                 break
@@ -1095,7 +1210,7 @@ class Scheduler:
                 # buffered the wait is bounded by the remaining settle /
                 # autocommit-hold window; idle it is bounded by the
                 # autocommit interval as a defensive heartbeat only.
-                if q.empty():
+                if q.empty() and not carry:
                     now = _time.monotonic()
                     if first_arrival is not None:
                         deadline = min(
@@ -1167,6 +1282,7 @@ class Scheduler:
             ctx.consumed = consumed  # type: ignore[attr-defined]
             if snap is not None:
                 ctx.states = snap["states"]
+                self._restore_nodes(ctx)
             for node, _subject in my_inputs:
                 events = self.persistence.replay_events(node, worker=w)
                 data = [e for e in events if e[0] != "commit"]

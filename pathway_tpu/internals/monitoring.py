@@ -189,6 +189,10 @@ class ProberStats:
     #: ({"error": n, "warning": n, "info": n}) — what this deployed
     #: graph was warned about before it started
     analysis: dict[str, int] = field(default_factory=dict)
+    #: coordinated-checkpoint snapshot ({epoch, age_seconds, bytes,
+    #: count, wall_at}; empty when persistence is off) plus the cluster
+    #: supervisor's restart generation under "worker_restarts"
+    checkpoint: dict[str, Any] = field(default_factory=dict)
 
 
 def collect_stats(sched: Any) -> ProberStats:
@@ -225,7 +229,24 @@ def collect_stats(sched: Any) -> ProberStats:
         exchange=_exchange_stats(sched, ctx),
         latency=latency_stats(sched),
         analysis=dict(getattr(sched, "analysis_findings", {}) or {}),
+        checkpoint=checkpoint_stats(sched),
     )
+
+
+def checkpoint_stats(sched: Any) -> dict[str, Any]:
+    """Coordinated-checkpoint health snapshot: last checkpointed epoch,
+    its age, size, and the supervisor restart generation.  Empty dict
+    when persistence is not attached (nothing to report)."""
+    hooks = getattr(sched, "persistence", None)
+    snap_fn = getattr(hooks, "checkpoint_snapshot", None)
+    if snap_fn is None:
+        return {}
+    try:
+        snap = dict(snap_fn())
+    except Exception:
+        return {}
+    snap["worker_restarts"] = int(getattr(sched, "worker_restarts", 0) or 0)
+    return snap
 
 
 def latency_stats(sched: Any) -> dict[str, Any]:

@@ -119,6 +119,24 @@ def _metrics_text(sched: Any) -> str:
     if plan is not None:
         lines.append("# TYPE pathway_tpu_plan_level gauge")
         lines.append(f"pathway_tpu_plan_level {plan.level}")
+    # coordinated-checkpoint health (fault-tolerance observability): a
+    # growing age with bytes stuck means checkpoints stopped landing —
+    # the alert that matters before a worker ever dies
+    ckpt = _checkpoint_snapshot(sched)
+    if ckpt:
+        age = ckpt.get("age_seconds")
+        lines.append("# TYPE pathway_tpu_checkpoint_age_seconds gauge")
+        lines.append(
+            f"pathway_tpu_checkpoint_age_seconds "
+            f"{age if age is not None else -1:.3f}"
+        )
+        lines.append("# TYPE pathway_tpu_checkpoint_bytes gauge")
+        lines.append(f"pathway_tpu_checkpoint_bytes {ckpt.get('bytes', 0)}")
+    lines.append("# TYPE pathway_tpu_worker_restarts_total counter")
+    lines.append(
+        f"pathway_tpu_worker_restarts_total "
+        f"{int(getattr(sched, 'worker_restarts', 0) or 0)}"
+    )
     return "\n".join(lines) + "\n# EOF\n"
 
 
@@ -126,6 +144,12 @@ def _latency_snapshot(sched: Any) -> dict[str, Any]:
     from pathway_tpu.internals.monitoring import latency_stats
 
     return latency_stats(sched)
+
+
+def _checkpoint_snapshot(sched: Any) -> dict[str, Any]:
+    from pathway_tpu.internals.monitoring import checkpoint_stats
+
+    return checkpoint_stats(sched)
 
 
 def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
@@ -157,6 +181,10 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                                 getattr(sched, "plan_counters", {}) or {}
                             ),
                         },
+                        # coordinated-checkpoint health: last checkpoint
+                        # epoch, its age/size, and the supervisor restart
+                        # generation ({} when persistence is off)
+                        "checkpoint": _checkpoint_snapshot(sched),
                     }
                 ).encode()
                 ctype = "application/json"

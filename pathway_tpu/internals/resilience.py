@@ -25,9 +25,11 @@ behaviour: one failure, logged, stream closed (``DEFAULT_POLICY``).
 from __future__ import annotations
 
 import logging
+import os
+import subprocess
 import threading
 import time as _time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from pathway_tpu.internals.udfs import ExponentialBackoffRetryStrategy
@@ -35,6 +37,8 @@ from pathway_tpu.internals.udfs import ExponentialBackoffRetryStrategy
 __all__ = [
     "BreakerState",
     "CircuitBreaker",
+    "ClusterRunReport",
+    "ClusterSupervisor",
     "ConnectorRecoveryPolicy",
     "ConnectorSupervisor",
     "DEFAULT_POLICY",
@@ -503,3 +507,248 @@ class ConnectorSupervisor:
         # "drop": historical behaviour — loud log, stream closes, the run
         # continues on whatever was delivered
         _logger.error("%s; dropping the source (on_failure='drop')", msg)
+
+
+# --------------------------------------------------------------------------
+# cluster-level supervision
+# --------------------------------------------------------------------------
+
+
+def _probe_port_range(n: int, start: int = 11000) -> int:
+    """Find a contiguous range of ``n`` free TCP ports on 127.0.0.1.
+
+    A fresh range per cluster generation keeps a respawned mesh away from
+    TIME_WAIT sockets and half-dead listeners left by the generation it
+    replaces.
+    """
+    import socket as _socket
+
+    base = start + (os.getpid() % 500) * 16
+    step = max(n, 1)
+    for offset in range(0, 4000, step):
+        cand = base + offset
+        socks: list[Any] = []
+        try:
+            for i in range(n):
+                s = _socket.socket()
+                s.bind(("127.0.0.1", cand + i))
+                socks.append(s)
+            return cand
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no free range of {n} ports found near {base}")
+
+
+@dataclass
+class ClusterRunReport:
+    """Outcome of a supervised cluster run.
+
+    ``recovery_seconds`` has one entry per restart: wall time from the moment
+    a worker failure was observed to the moment every replacement process of
+    the next generation was spawned (the cluster's downtime window).
+    """
+
+    returncode: int
+    restarts: int
+    recovery_seconds: list[float] = field(default_factory=list)
+    total_seconds: float = 0.0
+    failures: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+class ClusterSupervisor:
+    """Restart a multi-process cluster run after worker death.
+
+    The supervisor owns the whole mesh: it spawns one OS process per
+    ``PATHWAY_PROCESS_ID`` with the standard env contract, watches their
+    exit codes, and on any nonzero exit tears down the survivors and
+    respawns *all* of them.  Restart-all (rather than restart-one) is the
+    correct granularity here because a surviving worker cannot rejoin a
+    half-dead mesh: peers fail their sockets as soon as one side dies, and
+    epoch consensus needs every rank present.  Rollback to the last
+    globally-consistent checkpoint is not the supervisor's job — the
+    workers' own ``("snap_presence",)`` allgather refuses any checkpoint
+    epoch that is missing on some rank or skewed across ranks, so a
+    respawned cluster converges on the newest epoch that every worker
+    persisted (or replays from scratch when there is none), and file sinks
+    truncate back to their checkpointed watermark before appending.
+
+    Restart budget and backoff pacing reuse ``ConnectorRecoveryPolicy``
+    so cluster supervision tunes exactly like connector supervision.
+    """
+
+    def __init__(
+        self,
+        argv: list[str],
+        n_processes: int,
+        *,
+        threads: int = 1,
+        env: dict[str, str] | None = None,
+        policy: ConnectorRecoveryPolicy | None = None,
+        log_dir: str | None = None,
+        cwd: str | None = None,
+        first_port_factory: Callable[[int], int] | None = None,
+        grace_s: float = 5.0,
+        poll_interval_s: float = 0.02,
+    ) -> None:
+        if n_processes < 1:
+            raise ValueError("n_processes must be >= 1")
+        self.argv = list(argv)
+        self.n_processes = n_processes
+        self.threads = threads
+        self.extra_env = dict(env or {})
+        self.policy = policy or ConnectorRecoveryPolicy(
+            max_restarts=3, initial_delay_ms=50, max_delay_ms=2_000, jitter_ms=0
+        )
+        self.log_dir = log_dir
+        self.cwd = cwd
+        self._first_port_factory = first_port_factory or _probe_port_range
+        self.grace_s = grace_s
+        self.poll_interval_s = poll_interval_s
+        self._stop_event = threading.Event()
+
+    def stop(self) -> None:
+        """Ask a running :meth:`run` to tear everything down and return."""
+        self._stop_event.set()
+
+    # -- process plumbing ---------------------------------------------------
+
+    def _spawn_generation(
+        self, generation: int, first_port: int
+    ) -> list[tuple[subprocess.Popen[bytes], Any]]:
+        procs: list[tuple[subprocess.Popen[bytes], Any]] = []
+        for pid_ in range(self.n_processes):
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            env.update(
+                {
+                    "PATHWAY_THREADS": str(self.threads),
+                    "PATHWAY_PROCESSES": str(self.n_processes),
+                    "PATHWAY_PROCESS_ID": str(pid_),
+                    "PATHWAY_FIRST_PORT": str(first_port),
+                    # surfaces as pathway_tpu_worker_restarts_total
+                    "PATHWAY_WORKER_RESTARTS": str(generation),
+                }
+            )
+            log_f: Any = subprocess.DEVNULL
+            if self.log_dir is not None:
+                log_f = open(
+                    os.path.join(self.log_dir, f"gen{generation}_p{pid_}.log"), "wb"
+                )
+            proc = subprocess.Popen(
+                self.argv,
+                env=env,
+                cwd=self.cwd,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+            )
+            procs.append((proc, log_f))
+        return procs
+
+    def _terminate(self, procs: list[tuple[subprocess.Popen[bytes], Any]]) -> None:
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = _time.monotonic() + self.grace_s
+        for proc, _ in procs:
+            if proc.poll() is None:
+                try:
+                    proc.wait(max(0.0, deadline - _time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(5.0)
+        for _, log_f in procs:
+            if log_f is not subprocess.DEVNULL:
+                log_f.close()
+
+    @staticmethod
+    def _close_logs(procs: list[tuple[subprocess.Popen[bytes], Any]]) -> None:
+        for _, log_f in procs:
+            if log_f is not subprocess.DEVNULL:
+                log_f.close()
+
+    # -- main loop ----------------------------------------------------------
+
+    def run(self, timeout: float | None = None) -> ClusterRunReport:
+        """Run the cluster to completion, restarting on worker death."""
+        from pathway_tpu.internals.telemetry import get_telemetry
+
+        telemetry = get_telemetry()
+        backoff = self.policy.backoff_strategy()
+        t0 = _time.monotonic()
+        generation = 0
+        recovery_seconds: list[float] = []
+        failures: list[str] = []
+        failed_at: float | None = None
+
+        def report(rc: int) -> ClusterRunReport:
+            return ClusterRunReport(
+                returncode=rc,
+                restarts=generation,
+                recovery_seconds=recovery_seconds,
+                total_seconds=_time.monotonic() - t0,
+                failures=failures,
+            )
+
+        while True:
+            first_port = self._first_port_factory(self.n_processes)
+            procs = self._spawn_generation(generation, first_port)
+            if failed_at is not None:
+                recovery_seconds.append(_time.monotonic() - failed_at)
+                failed_at = None
+            failed_rc: int | None = None
+            while True:
+                if self._stop_event.is_set():
+                    self._terminate(procs)
+                    failures.append(f"generation {generation}: stopped by supervisor")
+                    return report(-1)
+                if timeout is not None and _time.monotonic() - t0 > timeout:
+                    self._terminate(procs)
+                    failures.append(f"generation {generation}: supervisor timeout")
+                    return report(124)
+                codes = [proc.poll() for proc, _ in procs]
+                bad = [
+                    (i, c) for i, c in enumerate(codes) if c is not None and c != 0
+                ]
+                if bad:
+                    failed_rc = bad[0][1]
+                    failures.append(
+                        f"generation {generation}: worker process "
+                        f"{bad[0][0]} exited {failed_rc}"
+                    )
+                    break
+                if all(c == 0 for c in codes):
+                    self._close_logs(procs)
+                    return report(0)
+                self._stop_event.wait(self.poll_interval_s)
+
+            # one worker died: the run is lost — tear down the survivors,
+            # pace by the policy's backoff, and respawn the whole mesh
+            failed_at = _time.monotonic()
+            telemetry.counter("cluster.worker_failures")
+            _logger.warning("%s; tearing down survivors", failures[-1])
+            self._terminate(procs)
+            if generation >= self.policy.max_restarts:
+                _logger.error(
+                    "cluster gave up after %d restart(s); last failure: %s",
+                    generation,
+                    failures[-1],
+                )
+                return report(failed_rc if failed_rc is not None else 1)
+            delay = backoff.next_delay(generation)
+            if self._stop_event.wait(delay):
+                failures.append(f"generation {generation}: stopped during backoff")
+                return report(-1)
+            telemetry.counter("cluster.restarts")
+            generation += 1
+            _logger.warning(
+                "respawning cluster (generation %d of at most %d)",
+                generation,
+                self.policy.max_restarts,
+            )

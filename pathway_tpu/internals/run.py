@@ -118,6 +118,8 @@ def _run_inner(
     exec_graph: Any = None,
     plan: Any = None,
 ):
+    import os
+
     from pathway_tpu.internals import config as cfg
     from pathway_tpu.internals.license import LicenseError, get_license
 
@@ -153,6 +155,12 @@ def _run_inner(
     )
     #: pre-flight analyzer finding counts, read by monitoring//status
     sched.analysis_findings = dict(analysis_counts or {})
+    # a ClusterSupervisor stamps its respawn generation into the env so the
+    # worker can surface it as pathway_tpu_worker_restarts_total
+    try:
+        sched.worker_restarts = int(os.environ.get("PATHWAY_WORKER_RESTARTS", "0"))
+    except ValueError:
+        sched.worker_restarts = 0
     #: optimizer audit trail + rewrite counters (monitoring//status)
     sched.execution_plan = plan
     sched.plan_counters = plan.counters() if plan is not None else {}

@@ -11,6 +11,7 @@ stream and formats rows out.  The engine side is
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time as _time
 from typing import Any, Callable, Iterable
@@ -298,10 +299,14 @@ class LazyFileWriter(Writer):
     def __init__(self, path: str):
         self._path = path
         self._f: Any = None
+        self._resumed = False
 
     def _file(self):
         if self._f is None:
-            self._f = open(self._path, "w", newline=self._open_newline)
+            # after a checkpoint resume the committed prefix up to the
+            # watermark must survive — append instead of truncating
+            mode = "a" if self._resumed else "w"
+            self._f = open(self._path, mode, newline=self._open_newline)
         return self._f
 
     def flush(self) -> None:
@@ -310,6 +315,38 @@ class LazyFileWriter(Writer):
 
     def close(self) -> None:
         self._file().close()
+
+    def watermark(self) -> int:
+        """Byte offset of everything emitted so far (the sink-dedup
+        watermark checkpointed with the operator state).  Flushes first so
+        the offset covers the epoch just closed; measured with getsize —
+        byte-exact, unlike text-mode ``tell()`` cookies."""
+        if self._f is not None:
+            self._f.flush()
+            return os.path.getsize(self._path)
+        if self._resumed and os.path.exists(self._path):
+            return os.path.getsize(self._path)
+        return 0
+
+    def resume_at(self, offset: int) -> bool:
+        """Roll the output file back to a checkpointed watermark: truncate
+        to ``offset`` bytes and flip subsequent opens to append, so the
+        recovered file is exactly the checkpointed prefix plus the
+        replayed tail (duplicate emissions from replayed epochs are
+        suppressed by construction).  False when the file is gone or
+        shorter than the watermark — the sink then rewrites from scratch,
+        which is still correct (full replay reproduces every row)."""
+        if self._f is not None:
+            return False  # already emitting: too late to roll back
+        try:
+            if os.path.getsize(self._path) < offset:
+                return False
+            with open(self._path, "r+b") as f:
+                f.truncate(offset)
+            self._resumed = True
+            return True
+        except OSError:
+            return False
 
 
 def attach_writer(table: Table, writer: Writer, *, name: str = "output") -> None:
@@ -328,7 +365,13 @@ def attach_writer(table: Table, writer: Writer, *, name: str = "output") -> None
         writer.close()
 
     node = eg.OutputNode(
-        G.engine_graph, table._node, on_change, on_time_end, on_end, name=name
+        G.engine_graph,
+        table._node,
+        on_change,
+        on_time_end,
+        on_end,
+        name=name,
+        writer=writer,  # enables checkpointed sink-dedup watermarks
     )
     node.meta["sink"] = {
         "names": list(cols),

@@ -449,12 +449,17 @@ class Config:
         backend: Backend,
         *,
         snapshot_interval_ms: int = 0,
+        checkpoint_interval: float | None = None,
         persistence_mode: PersistenceMode = PersistenceMode.PERSISTING,
         continue_after_replay: bool = True,
         replay_speedup: float = 1.0,
     ):
         self.backend = backend
         self.snapshot_interval_ms = snapshot_interval_ms
+        #: coordinated-checkpoint period in SECONDS (the cluster-facing
+        #: knob; ``snapshot_interval_ms`` is the legacy ms spelling).  Env
+        #: ``PATHWAY_CHECKPOINT_INTERVAL`` overrides either.
+        self.checkpoint_interval = checkpoint_interval
         self.persistence_mode = persistence_mode
         self.continue_after_replay = continue_after_replay
         #: REALTIME_REPLAY speed factor: recorded inter-commit gaps are
@@ -600,6 +605,26 @@ class PersistenceHooks:
         self.operator_mode = (
             config.persistence_mode == PersistenceMode.OPERATOR_PERSISTING
         )
+        # -- async checkpoint writer (coordinated cluster checkpoints) --
+        # Periodic snapshots pickle on the WORKER thread (the state must
+        # be captured at the epoch boundary) but hit disk on this writer,
+        # so the hot path never blocks on fsync.  The queue coalesces to
+        # the latest snapshot per worker: under backpressure intermediate
+        # checkpoints are superseded, never queued up.
+        self._ckpt_cv = threading.Condition()
+        self._ckpt_queue: dict[int, tuple[int, bytes, tuple]] = {}
+        self._ckpt_inflight = 0
+        self._ckpt_thread: threading.Thread | None = None
+        self._ckpt_stats_lock = threading.Lock()
+        #: last successfully persisted checkpoint (any worker of this
+        #: process), for /status and /metrics
+        self.checkpoint_stats: dict[str, Any] = {
+            "epoch": None,
+            "bytes": 0,
+            "count": 0,
+            "wall_at": None,
+            "mono_at": None,
+        }
 
     def persisted(self, node: Any) -> bool:
         """Whether this source participates in persistence at all."""
@@ -630,7 +655,100 @@ class PersistenceHooks:
             )
             return False
         self.impl.put_blob(f"opsnap_w{worker}", blob)
+        self._note_checkpoint(epoch, len(blob))
         return True
+
+    def save_operator_snapshot_async(
+        self,
+        worker: int,
+        epoch: int,
+        consumed: dict[int, int],
+        states: dict[int, Any],
+        commit_fns: tuple = (),
+    ) -> bool:
+        """Asynchronous variant for periodic coordinated checkpoints:
+        pickling happens here on the caller (state consistency at the
+        epoch boundary), the durable writes happen on the writer thread.
+        ``commit_fns`` are the inputs' ``force_log_commit`` closures; the
+        writer runs them BEFORE the blob lands, so a visible snapshot's
+        consumed counts always lie within the committed log prefix (any
+        events the worker records after this enqueue are past the
+        snapshot's counts — a later commit covering them is harmless).
+        Returns False only when the state is unpicklable."""
+        try:
+            blob = pickle.dumps(
+                {"epoch": epoch, "consumed": dict(consumed), "states": states},
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+        except Exception as e:
+            _logger.warning(
+                "operator snapshot skipped (unpicklable state): %r", e
+            )
+            return False
+        with self._ckpt_cv:
+            if self._ckpt_thread is None:
+                self._ckpt_thread = threading.Thread(
+                    target=self._ckpt_loop,
+                    daemon=True,
+                    name="pw-checkpoint-writer",
+                )
+                self._ckpt_thread.start()
+            self._ckpt_queue[worker] = (epoch, blob, tuple(commit_fns))
+            self._ckpt_cv.notify()
+        return True
+
+    def _ckpt_loop(self) -> None:
+        while True:
+            with self._ckpt_cv:
+                while not self._ckpt_queue:
+                    self._ckpt_cv.wait(1.0)
+                worker = next(iter(self._ckpt_queue))
+                epoch, blob, commit_fns = self._ckpt_queue.pop(worker)
+                self._ckpt_inflight += 1
+            try:
+                for fn in commit_fns:  # log commits land before the blob
+                    fn()
+                self.impl.put_blob(f"opsnap_w{worker}", blob)
+                self._note_checkpoint(epoch, len(blob))
+            except Exception as e:  # a failed checkpoint only delays recovery
+                _logger.warning("async checkpoint failed: %r", e)
+            finally:
+                with self._ckpt_cv:
+                    self._ckpt_inflight -= 1
+                    self._ckpt_cv.notify_all()
+
+    def flush_checkpoints(self, timeout: float = 10.0) -> bool:
+        """Drain the async checkpoint queue (called before a final
+        synchronous snapshot and at run teardown).  True iff everything
+        queued has been persisted within ``timeout``."""
+        deadline = _time.monotonic() + timeout
+        with self._ckpt_cv:
+            while self._ckpt_queue or self._ckpt_inflight:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._ckpt_cv.wait(min(remaining, 0.5))
+        return True
+
+    def _note_checkpoint(self, epoch: int, nbytes: int) -> None:
+        with self._ckpt_stats_lock:
+            st = self.checkpoint_stats
+            st["epoch"] = epoch
+            st["bytes"] = nbytes
+            st["count"] += 1
+            st["wall_at"] = _time.time()
+            st["mono_at"] = _time.monotonic()
+
+    def checkpoint_snapshot(self) -> dict[str, Any]:
+        """Monitoring view of the last checkpoint: epoch, size, count and
+        age in seconds (None until the first checkpoint lands)."""
+        with self._ckpt_stats_lock:
+            st = dict(self.checkpoint_stats)
+        mono_at = st.pop("mono_at")
+        st["age_seconds"] = (
+            round(_time.monotonic() - mono_at, 3) if mono_at is not None else None
+        )
+        return st
 
     def load_operator_snapshot(self, worker: int) -> dict | None:
         blob = self.impl.get_blob(f"opsnap_w{worker}")

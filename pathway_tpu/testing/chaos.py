@@ -19,6 +19,20 @@ layers must survive):
   snapshot is persisted, then the process "dies" before the run
   continues (resume must not double-apply).
 
+Cluster fault primitives (drive ``tests/test_cluster_recovery.py``):
+
+- :meth:`chaos.kill_worker` — a chosen worker rank dies at the start of
+  its Nth epoch (``ChaosError`` or a hard ``os._exit`` — the latter is
+  what a real SIGKILL looks like to the rest of the mesh).
+- :meth:`chaos.delay_exchange_frames` / :meth:`chaos.drop_exchange_frames`
+  — latency or loss injected at the peer link's single egress point
+  (``_PeerSender._transmit``); dropping mutes heartbeats too, so a muted
+  peer becomes *detectably* dead.
+- :class:`ClusterDrill` — seedable end-to-end drill: run a wordcount
+  cluster fault-free, re-run it with a worker killed at a random epoch
+  under :class:`~pathway_tpu.internals.resilience.ClusterSupervisor`,
+  and assert the recovered output is byte-identical.
+
 Usage::
 
     from pathway_tpu.testing import chaos
@@ -32,12 +46,13 @@ Usage::
 from __future__ import annotations
 
 import functools
+import os
 import random
 import threading
 import time as _time
 from typing import Any, Callable, Iterable
 
-__all__ = ["ChaosError", "chaos", "flaky_once"]
+__all__ = ["ChaosError", "ClusterDrill", "chaos", "flaky_once"]
 
 
 class ChaosError(RuntimeError):
@@ -187,24 +202,384 @@ class chaos:
         self._patch(backend_impl, "append", wrapper)
 
     def crash_between_snapshot_and_commit(self, hooks: Any, on_nth: int = 1) -> None:
-        """``PersistenceHooks.save_operator_snapshot`` persists the
-        snapshot blob, then raises — the crash window between an operator
-        snapshot landing on disk and the run carrying on.  Resume from
-        that snapshot must replay only the committed tail (no loss, no
-        double-apply)."""
-        orig = hooks.save_operator_snapshot
-        key = self._counter_key(hooks, "save_operator_snapshot")
+        """An operator snapshot persists, then the process "dies" before
+        the run carries on — the crash window between a checkpoint landing
+        on disk and the epoch loop continuing.  Resume from that snapshot
+        must replay only the committed tail (no loss, no double-apply).
 
-        def wrapper(*args: Any, **kwargs: Any) -> Any:
-            count = self._bump(key)
-            result = orig(*args, **kwargs)
+        Counts the synchronous (``save_operator_snapshot``) and
+        asynchronous (``save_operator_snapshot_async``, used by periodic
+        checkpoints) paths on ONE shared counter; on the async path the
+        queued blob is flushed to disk before the injected death so the
+        crash window is identical in both cases."""
+        shared = {"count": 0}
+        shared_lock = threading.Lock()
+
+        def _next() -> int:
+            with shared_lock:
+                shared["count"] += 1
+                return shared["count"]
+
+        orig_sync = hooks.save_operator_snapshot
+        key_sync = self._counter_key(hooks, "save_operator_snapshot")
+
+        def wrapper_sync(*args: Any, **kwargs: Any) -> Any:
+            self._bump(key_sync)
+            count = _next()
+            result = orig_sync(*args, **kwargs)
             if count == on_nth:
                 raise ChaosError(
                     f"injected crash after operator snapshot #{count}"
                 )
             return result
 
-        self._patch(hooks, "save_operator_snapshot", wrapper)
+        self._patch(hooks, "save_operator_snapshot", wrapper_sync)
+
+        orig_async = getattr(hooks, "save_operator_snapshot_async", None)
+        if orig_async is None:
+            return
+        key_async = self._counter_key(hooks, "save_operator_snapshot_async")
+
+        def wrapper_async(*args: Any, **kwargs: Any) -> Any:
+            self._bump(key_async)
+            count = _next()
+            result = orig_async(*args, **kwargs)
+            if count == on_nth:
+                flush = getattr(hooks, "flush_checkpoints", None)
+                if flush is not None:
+                    flush()  # the snapshot must be ON DISK when we "die"
+                raise ChaosError(
+                    f"injected crash after operator snapshot #{count}"
+                )
+            return result
+
+        self._patch(hooks, "save_operator_snapshot_async", wrapper_async)
+
+    # -- cluster faults -------------------------------------------------
+    def kill_worker(
+        self,
+        rank: int,
+        at_epoch: int,
+        hard: bool = False,
+        generation: int = 0,
+        exit_code: int = 70,
+    ) -> None:
+        """Worker ``rank`` dies at the start of its ``at_epoch``-th epoch
+        (1-based; earlier epochs complete and may have checkpointed).
+
+        ``hard=True`` calls ``os._exit(exit_code)`` — no unwinding, no
+        atexit, exactly what SIGKILL looks like to the peer mesh and the
+        supervisor; otherwise a :class:`ChaosError` unwinds the worker
+        (covers the fatal-operator-error path).  ``generation`` arms the
+        fault only in that supervisor respawn generation (matched against
+        ``PATHWAY_WORKER_RESTARTS``), so a restarted cluster does not
+        re-kill itself forever."""
+        from pathway_tpu.engine.scheduler import Scheduler
+
+        if int(os.environ.get("PATHWAY_WORKER_RESTARTS", "0")) != generation:
+            return  # a later generation: the fault already fired and is spent
+        orig = Scheduler.run_epoch
+        key = self._counter_key(Scheduler, "run_epoch")
+        epochs_by_rank: dict[int, int] = {}
+        rank_lock = threading.Lock()
+
+        @functools.wraps(orig)
+        def wrapper(sched: Any, time: int, inject: Any, **kwargs: Any) -> Any:
+            self._bump(key)
+            ctx = kwargs.get("ctx") or sched.ctx
+            my_rank = getattr(ctx, "worker_id", 0)
+            with rank_lock:
+                epochs_by_rank[my_rank] = epochs_by_rank.get(my_rank, 0) + 1
+                count = epochs_by_rank[my_rank]
+            if my_rank == rank and count == at_epoch:
+                if hard:
+                    os._exit(exit_code)
+                raise ChaosError(
+                    f"injected worker death: rank {rank} at epoch #{count}"
+                )
+            return orig(sched, time, inject, **kwargs)
+
+        self._patch(Scheduler, "run_epoch", wrapper)
+
+    def delay_exchange_frames(
+        self,
+        delay_s: float = 0.05,
+        jitter_s: float = 0.0,
+        limit: int | None = None,
+        process_id: int | None = None,
+    ) -> None:
+        """Sleep before every outbound cluster transmission (data frames
+        AND heartbeats) — a slow or congested link.  ``process_id``
+        restricts the fault to links owned by one process; ``limit``
+        bounds how many transmissions are delayed."""
+        from pathway_tpu.engine.cluster import _PeerSender
+
+        orig = _PeerSender._transmit
+        key = self._counter_key(_PeerSender, "_transmit")
+
+        @functools.wraps(orig)
+        def wrapper(sender: Any, body: Any, n_frames: int) -> Any:
+            count = self._bump(key)
+            mine = (
+                process_id is None
+                or getattr(sender.links, "process_id", None) == process_id
+            )
+            if mine and (limit is None or count <= limit):
+                _time.sleep(delay_s + self.rng.uniform(0.0, jitter_s))
+            return orig(sender, body, n_frames)
+
+        self._patch(_PeerSender, "_transmit", wrapper)
+
+    def drop_exchange_frames(
+        self,
+        after: int = 0,
+        process_id: int | None = None,
+        peer: int | None = None,
+    ) -> None:
+        """Silently drop every outbound transmission past the first
+        ``after`` — a one-way partition.  Dropping happens at the link's
+        single egress point, so heartbeats are muted along with data: the
+        muted process turns *detectably* dead (liveness timeout) rather
+        than silently lossy.  ``process_id``/``peer`` scope the fault to
+        one process's links or one destination."""
+        from pathway_tpu.engine.cluster import _PeerSender
+
+        orig = _PeerSender._transmit
+        key = self._counter_key(_PeerSender, "_transmit")
+
+        @functools.wraps(orig)
+        def wrapper(sender: Any, body: Any, n_frames: int) -> Any:
+            count = self._bump(key)
+            mine = (
+                process_id is None
+                or getattr(sender.links, "process_id", None) == process_id
+            ) and (peer is None or sender.peer == peer)
+            if mine and count > after:
+                return None  # swallowed by the injected partition
+            return orig(sender, body, n_frames)
+
+        self._patch(_PeerSender, "_transmit", wrapper)
+
+
+_DRILL_PROGRAM = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+_kill_rank = int(os.environ.get("CHAOS_KILL_RANK", "-1"))
+if _kill_rank >= 0:
+    from pathway_tpu.testing.chaos import chaos as _chaos
+
+    _c = _chaos(seed=int(os.environ.get("CHAOS_SEED", "0")))
+    _c.__enter__()  # never restored: this process dies or exits
+    _c.kill_worker(_kill_rank, int(os.environ["CHAOS_KILL_EPOCH"]), hard=True)
+
+
+class S(pw.Schema):
+    word: str
+
+
+t = pw.io.jsonlines.read({input!r}, schema=S, mode="static")
+counts = t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+pw.io.jsonlines.write(counts, {output!r})
+pconf = Config.simple_config(
+    Backend.filesystem({persist!r}),
+    persistence_mode=PersistenceMode("operator_persisting"),
+)
+pw.run(
+    autocommit_duration_ms=20,
+    persistence_config=pconf,
+    monitoring_level="none",
+)
+"""
+
+
+class ClusterDrill:
+    """Seedable end-to-end cluster fault drill.
+
+    Runs one wordcount pipeline twice over the same generated corpus: a
+    fault-free baseline, then a drill where a seeded-random worker is
+    hard-killed (``os._exit``) at a seeded-random epoch while the cluster
+    runs under :class:`~pathway_tpu.internals.resilience.ClusterSupervisor`
+    with coordinated checkpointing enabled.  The drill passes when the
+    recovered sink output is *byte-identical* to the fault-free run after
+    canonicalization — the diff log is consolidated to final counts and
+    serialized deterministically, because the raw log's row batching is
+    timing-dependent even between two fault-free runs (what the
+    consistency guarantee covers is the *content*, not the arbitrary
+    interleaving).
+
+    Small epochs (``PATHWAY_EPOCH_MAX_ROWS``) and a short checkpoint
+    interval make static input produce many epochs and several
+    checkpoints before the kill, so recovery genuinely exercises
+    rollback + replay + sink-watermark truncation rather than a trivial
+    from-scratch rerun.
+    """
+
+    def __init__(
+        self,
+        workdir: Any,
+        *,
+        seed: int = 0,
+        processes: int = 2,
+        threads: int = 1,
+        rows: int = 400,
+        vocab: int = 7,
+        kill_rank: int | None = None,
+        kill_epoch: int | None = None,
+        checkpoint_interval_s: float = 0.05,
+        epoch_max_rows: int | None = None,
+        heartbeat_s: float = 0.2,
+        liveness_timeout_s: float = 2.0,
+        max_restarts: int = 3,
+        timeout_s: float = 180.0,
+    ) -> None:
+        self.workdir = str(workdir)
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.processes = processes
+        self.threads = threads
+        self.rows = rows
+        self.vocab = vocab
+        n_ranks = processes * threads
+        self.kill_rank = (
+            kill_rank if kill_rank is not None else self.rng.randrange(n_ranks)
+        )
+        self.kill_epoch = (
+            kill_epoch if kill_epoch is not None else self.rng.randrange(3, 7)
+        )
+        self.checkpoint_interval_s = checkpoint_interval_s
+        # default epoch cap scales with the worker count: the corpus is
+        # partitioned across ranks, and every rank must cut enough data
+        # epochs (~10) that any kill_epoch drawn above can actually fire
+        self.epoch_max_rows = (
+            epoch_max_rows
+            if epoch_max_rows is not None
+            else max(1, rows // (n_ranks * 10))
+        )
+        self.heartbeat_s = heartbeat_s
+        self.liveness_timeout_s = liveness_timeout_s
+        self.max_restarts = max_restarts
+        self.timeout_s = timeout_s
+
+    # -- pieces ---------------------------------------------------------
+    def _write_corpus(self) -> str:
+        path = os.path.join(self.workdir, "corpus.jsonl")
+        import json
+
+        with open(path, "w") as f:
+            for _ in range(self.rows):
+                w = f"w{self.rng.randrange(self.vocab)}"
+                f.write(json.dumps({"word": w}) + "\n")
+        return path
+
+    def _write_program(self, tag: str, input_path: str) -> tuple[str, str]:
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        out = os.path.join(self.workdir, f"{tag}_out.jsonl")
+        persist = os.path.join(self.workdir, f"{tag}_snap")
+        prog = os.path.join(self.workdir, f"{tag}_prog.py")
+        with open(prog, "w") as f:
+            f.write(
+                _DRILL_PROGRAM.format(
+                    repo=repo, input=input_path, output=out, persist=persist
+                )
+            )
+        return prog, out
+
+    def _run_supervised(self, prog: str, extra_env: dict[str, str]) -> Any:
+        import sys
+
+        from pathway_tpu.internals.resilience import (
+            ClusterSupervisor,
+            ConnectorRecoveryPolicy,
+        )
+
+        env = {
+            "PATHWAY_CHECKPOINT_INTERVAL": str(self.checkpoint_interval_s),
+            "PATHWAY_EPOCH_MAX_ROWS": str(self.epoch_max_rows),
+            "PATHWAY_CLUSTER_HEARTBEAT_S": str(self.heartbeat_s),
+            "PATHWAY_CLUSTER_LIVENESS_TIMEOUT_S": str(self.liveness_timeout_s),
+            **extra_env,
+        }
+        sup = ClusterSupervisor(
+            [sys.executable, prog],
+            self.processes,
+            threads=self.threads,
+            env=env,
+            policy=ConnectorRecoveryPolicy(
+                max_restarts=self.max_restarts,
+                initial_delay_ms=10,
+                jitter_ms=0,
+                seed=self.seed,
+            ),
+            log_dir=self.workdir,
+        )
+        return sup.run(timeout=self.timeout_s)
+
+    @staticmethod
+    def canonical_output(path: str) -> bytes:
+        """Consolidate a jsonlines diff log to its final state and
+        serialize deterministically (sorted keys) for byte comparison."""
+        import json
+
+        state: dict = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    row = json.loads(line)
+                    key = row["word"]
+                    if row["diff"] > 0:
+                        state[key] = row["n"]
+                    elif state.get(key) == row["n"]:
+                        del state[key]
+        return json.dumps(state, sort_keys=True).encode()
+
+    # -- the drill ------------------------------------------------------
+    def run(self) -> dict[str, Any]:
+        corpus = self._write_corpus()
+
+        prog, baseline_out = self._write_program("baseline", corpus)
+        t0 = _time.monotonic()
+        base_report = self._run_supervised(prog, {})
+        baseline_seconds = _time.monotonic() - t0
+        if base_report.returncode != 0:
+            raise ChaosError(
+                f"baseline cluster run failed: {base_report.failures}"
+            )
+
+        prog, drill_out = self._write_program("drill", corpus)
+        t0 = _time.monotonic()
+        drill_report = self._run_supervised(
+            prog,
+            {
+                "CHAOS_KILL_RANK": str(self.kill_rank),
+                "CHAOS_KILL_EPOCH": str(self.kill_epoch),
+                "CHAOS_SEED": str(self.seed),
+            },
+        )
+        faulted_seconds = _time.monotonic() - t0
+
+        baseline = self.canonical_output(baseline_out)
+        recovered = self.canonical_output(drill_out)
+        return {
+            "ok": drill_report.returncode == 0 and baseline == recovered,
+            "identical": baseline == recovered,
+            "returncode": drill_report.returncode,
+            "kill_rank": self.kill_rank,
+            "kill_epoch": self.kill_epoch,
+            "restarts": drill_report.restarts,
+            "recovery_seconds": list(drill_report.recovery_seconds),
+            "baseline_seconds": baseline_seconds,
+            "faulted_seconds": faulted_seconds,
+            "baseline_output": baseline.decode(),
+            "recovered_output": recovered.decode(),
+            "failures": list(drill_report.failures),
+        }
 
 
 def flaky_once(

@@ -22,6 +22,14 @@ AST-based checks over ``engine/cluster.py`` and ``engine/scheduler.py``
   enclosing ``with`` over the condvar or a lock: ``threading.Condition``
   raises RuntimeError; a hand-rolled condvar silently races the waiter's
   predicate check (the classic lost-wakeup window).
+- **LK005** — unbounded blocking in cluster paths: a dead peer must be
+  *detected*, never waited on forever.  In files whose name contains
+  ``cluster`` (override with ``cluster_path=``) this flags
+  ``settimeout(None)`` (re-arms an infinite socket), condvar ``wait()``
+  calls with no timeout argument, and ``recv``/``recv_into`` inside a
+  class that never arms a finite ``settimeout`` — each is an infinite
+  wait that turns a peer crash into a hang instead of a bounded-time
+  liveness failure.
 
 Usage: ``python scripts/check_locks.py [files...]``; exits 1 on
 findings.  Importable — tests feed synthetic sources through
@@ -222,16 +230,114 @@ def _collect_lock_pairs(
     return pairs
 
 
+def _check_liveness_discipline(
+    tree: ast.AST, filename: str, findings: list[Finding]
+) -> None:
+    """LK005 (cluster paths only): no unbounded blocking primitive may
+    wait on a peer — ``settimeout(None)``, a condvar ``wait()`` without a
+    timeout, or ``recv``/``recv_into`` in a class that never arms a
+    finite socket timeout all turn a dead peer into an infinite hang."""
+
+    def _is_none(expr: ast.expr) -> bool:
+        return isinstance(expr, ast.Constant) and expr.value is None
+
+    def _scan_scope(scope: ast.AST, scope_name: str) -> None:
+        has_finite_settimeout = False
+        recvs: list[ast.Call] = []
+        for node in ast.walk(scope):
+            if node is not scope and isinstance(node, ast.ClassDef):
+                continue  # nested classes scan as their own scope
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            meth = node.func.attr
+            if meth == "settimeout":
+                if node.args and _is_none(node.args[0]):
+                    findings.append(
+                        Finding(
+                            filename,
+                            node.lineno,
+                            "LK005",
+                            "settimeout(None) re-arms an infinite socket "
+                            "in a cluster path; a dead peer then hangs "
+                            "recv forever instead of tripping the "
+                            "liveness deadline",
+                        )
+                    )
+                else:
+                    has_finite_settimeout = True
+            elif (
+                meth == "wait"
+                and _recv_name(node.func) in CV_NAMES
+                and not node.args
+                and not node.keywords
+            ):
+                findings.append(
+                    Finding(
+                        filename,
+                        node.lineno,
+                        "LK005",
+                        "condvar wait() without a timeout in a cluster "
+                        "path; the notifier may be a peer that just "
+                        "died — bound the wait or register with the "
+                        "WakeupHub",
+                    )
+                )
+            elif meth in ("recv", "recv_into"):
+                recvs.append(node)
+        if recvs and not has_finite_settimeout:
+            for node in recvs:
+                findings.append(
+                    Finding(
+                        filename,
+                        node.lineno,
+                        "LK005",
+                        f"{node.func.attr}() in {scope_name} with no "  # type: ignore[union-attr]
+                        "finite settimeout anywhere in the class; a "
+                        "silent peer blocks this thread forever",
+                    )
+                )
+
+    # each class is its own liveness scope (a class that arms a finite
+    # timeout once may recv anywhere); module-level code is one scope
+    classes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+    for cls in classes:
+        _scan_scope(cls, f"class {cls.name}")
+    _scan_scope(tree, "module scope") if not classes else None
+    if classes:
+        # module-level statements outside any class still need the scan;
+        # build a shallow pseudo-scope excluding class bodies
+        module_nodes = [
+            n
+            for n in ast.iter_child_nodes(tree)
+            if not isinstance(n, ast.ClassDef)
+        ]
+        pseudo = ast.Module(body=module_nodes, type_ignores=[])
+        _scan_scope(pseudo, "module scope")
+
+
 def check_source(
-    source: str, filename: str, *, scheduler_path: bool | None = None
+    source: str,
+    filename: str,
+    *,
+    scheduler_path: bool | None = None,
+    cluster_path: bool | None = None,
 ) -> list[Finding]:
     """Lint one file's source.  ``scheduler_path`` controls LK003
-    (default: filename contains 'scheduler')."""
+    (default: filename contains 'scheduler'); ``cluster_path`` controls
+    LK005 (default: filename contains 'cluster')."""
     findings: list[Finding] = []
     tree = ast.parse(source, filename=filename)
 
     _FunctionScanner(filename, findings).visit(tree)
     _check_notify_discipline(tree, filename, findings)
+
+    if cluster_path is None:
+        cluster_path = "cluster" in os.path.basename(filename)
+    if cluster_path:
+        _check_liveness_discipline(tree, filename, findings)
 
     if scheduler_path is None:
         scheduler_path = "scheduler" in os.path.basename(filename)

@@ -142,6 +142,75 @@ def test_lk004_non_cv_notify_ignored(cl):
     assert cl.check_source(src, "x.py") == []
 
 
+def test_lk005_settimeout_none_flagged(cl):
+    src = (
+        "class R:\n"
+        "    def arm(self):\n"
+        "        self.sock.settimeout(None)\n"
+    )
+    findings = cl.check_source(src, "cluster.py")
+    assert [f.code for f in findings] == ["LK005"]
+
+
+def test_lk005_recv_without_timeout_flagged(cl):
+    src = (
+        "class R:\n"
+        "    def read(self):\n"
+        "        return self.sock.recv(4096)\n"
+    )
+    findings = cl.check_source(src, "cluster.py")
+    assert [f.code for f in findings] == ["LK005"]
+
+
+def test_lk005_recv_under_finite_timeout_clean(cl):
+    # the liveness idiom: a finite settimeout anywhere in the class
+    # bounds every recv; timeouts feed the per-peer liveness deadline
+    src = (
+        "class R:\n"
+        "    def start(self):\n"
+        "        self.sock.settimeout(0.5)\n"
+        "    def read(self):\n"
+        "        return self.sock.recv_into(self.view)\n"
+    )
+    assert cl.check_source(src, "cluster.py") == []
+
+
+def test_lk005_untimed_cv_wait_flagged(cl):
+    # inside a while loop LK001 is satisfied, but in a cluster path the
+    # wait still needs a timeout — the notifier may be a dead peer
+    src = (
+        "class R:\n"
+        "    def pump(self):\n"
+        "        with self._cv:\n"
+        "            while not self._q:\n"
+        "                self._cv.wait()\n"
+    )
+    findings = cl.check_source(src, "cluster.py")
+    assert [f.code for f in findings] == ["LK005"]
+
+
+def test_lk005_timed_cv_wait_clean(cl):
+    src = (
+        "class R:\n"
+        "    def pump(self):\n"
+        "        with self._cv:\n"
+        "            while not self._q:\n"
+        "                self._cv.wait(1.0)\n"
+    )
+    assert cl.check_source(src, "cluster.py") == []
+
+
+def test_lk005_not_applied_outside_cluster_paths(cl):
+    # single-worker scheduler code may block indefinitely on local
+    # producers; LK005 is a cluster-path rule only
+    src = (
+        "class R:\n"
+        "    def read(self):\n"
+        "        return self.sock.recv(4096)\n"
+    )
+    assert cl.check_source(src, "scheduler.py") == []
+
+
 def test_engine_files_clean():
     """The shipped cluster/scheduler must satisfy the discipline; this
     is the gate that keeps future edits honest."""

@@ -1,0 +1,206 @@
+"""Cluster fault tolerance (ISSUE 8): a seeded kill-a-worker drill must
+recover automatically to sink output byte-identical to the fault-free
+run; a dead peer must be *detected* within the liveness timeout instead
+of hanging a ``recv`` forever; and link teardown must complete in
+bounded time even with peers mid-conversation.
+
+The drills go through ``testing.chaos.ClusterDrill`` — the same harness
+``bench.py`` uses for the committed recovery numbers — so the test and
+the benchmark can never drift apart on what "recovered" means.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.testing.chaos import ClusterDrill, chaos
+
+_port_counter = [13000 + (os.getpid() % 500) * 16]
+
+
+def next_port(n: int = 4) -> int:
+    """A base port with `n` consecutive bindable ports (probed, so stray
+    listeners from an earlier killed run can't collide)."""
+    import socket
+
+    while True:
+        base = _port_counter[0]
+        _port_counter[0] += n
+        if _port_counter[0] > 60000:
+            _port_counter[0] = 13000
+        try:
+            socks = []
+            for i in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + i))
+                socks.append(s)
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+        return base
+
+
+# ---------------------------------------------------------------------------
+# recovery drills
+
+
+def _run_drill(tmp_path, processes: int, seed: int) -> dict:
+    drill = ClusterDrill(str(tmp_path), seed=seed, processes=processes)
+    report = drill.run()
+    assert report["restarts"] >= 1, (
+        f"chaos kill (rank {report['kill_rank']} at epoch "
+        f"{report['kill_epoch']}) never triggered a restart: {report}"
+    )
+    assert report["ok"], f"cluster did not recover: {report['failures']}"
+    assert report["identical"], (
+        f"recovered sink output diverged from the fault-free run after "
+        f"killing rank {report['kill_rank']} at epoch {report['kill_epoch']}:"
+        f"\n fault-free: {report['baseline_output']!r}"
+        f"\n recovered:  {report['recovered_output']!r}"
+    )
+    assert report["recovery_seconds"], "no recovery time recorded"
+    return report
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [3, 11])
+def test_kill_random_worker_2proc_output_identical(tmp_path, seed):
+    """Property drill: kill a seeded-random rank at a seeded-random epoch
+    on a 2-process cluster; the supervisor restarts the generation, the
+    workers roll back to the last consistent checkpoint, and the final
+    sink output must byte-match a fault-free run."""
+    _run_drill(tmp_path, processes=2, seed=seed)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_kill_random_worker_4proc_output_identical(tmp_path):
+    """The same property at 4 workers — more ranks to kill, more peers
+    whose sockets die mid-conversation, same byte-identical bar."""
+    _run_drill(tmp_path, processes=4, seed=5)
+
+
+# ---------------------------------------------------------------------------
+# failure detection latency
+
+
+def _link_pair(first_port: int, heartbeat_s: float, liveness_timeout_s: float):
+    """Both ends of a 2-process TCP mesh, built in one process.  End 0
+    blocks in its constructor waiting for end 1 to dial, so it goes on a
+    thread."""
+    from pathway_tpu.engine.cluster import _ProcessLinks
+
+    out: dict[int, _ProcessLinks] = {}
+
+    def build0() -> None:
+        out[0] = _ProcessLinks(
+            0,
+            2,
+            first_port,
+            heartbeat_s=heartbeat_s,
+            liveness_timeout_s=liveness_timeout_s,
+        )
+
+    t = threading.Thread(target=build0, daemon=True)
+    t.start()
+    out[1] = _ProcessLinks(
+        1,
+        2,
+        first_port,
+        heartbeat_s=heartbeat_s,
+        liveness_timeout_s=liveness_timeout_s,
+    )
+    t.join(10.0)
+    assert 0 in out, "mesh never completed"
+    return out[0], out[1]
+
+
+@pytest.mark.chaos
+def test_muted_peer_detected_within_liveness_timeout():
+    """Drop every transmission (heartbeats included) out of process 1;
+    process 0 must declare the peer dead within the liveness timeout plus
+    one io tick — not hang in ``recv`` forever.  The detector then closes
+    its own sockets, so the muted side observes the EOF and fails too
+    (socket-death detection, the fast path)."""
+    liveness = 1.0
+    links0, links1 = _link_pair(
+        next_port(2), heartbeat_s=0.2, liveness_timeout_s=liveness
+    )
+    try:
+        with chaos(seed=1) as c:
+            c.drop_exchange_frames(after=0, process_id=1)
+            t0 = time.monotonic()
+            deadline = t0 + liveness + 3.0
+            while links0._failed is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            detect_s = time.monotonic() - t0
+            assert links0._failed is not None, (
+                f"muted peer not detected after {detect_s:.1f}s"
+            )
+            assert "silent" in links0._failed or "lost" in links0._failed
+            # bounded detection: liveness timeout + io tick + slack
+            assert detect_s < liveness + 2.0, f"detection took {detect_s:.1f}s"
+            # the failure must surface to a worker parked on the mailbox
+            with pytest.raises(RuntimeError, match="cluster failure"):
+                links0.recv_from_all(("never", 0))
+            # ... and propagate to the muted side via socket death
+            eof_deadline = time.monotonic() + 5.0
+            while links1._failed is None and time.monotonic() < eof_deadline:
+                time.sleep(0.02)
+            assert links1._failed is not None, "peer EOF never detected"
+    finally:
+        links0.close()
+        links1.close()
+
+
+@pytest.mark.chaos
+def test_idle_links_stay_alive_on_heartbeats():
+    """The inverse guard: two healthy but completely idle links exchange
+    only heartbeats and must NOT false-alarm past the liveness window."""
+    liveness = 0.8
+    links0, links1 = _link_pair(
+        next_port(2), heartbeat_s=0.1, liveness_timeout_s=liveness
+    )
+    try:
+        time.sleep(liveness * 2.5)
+        assert links0._failed is None, links0._failed
+        assert links1._failed is None, links1._failed
+        with links0.stats_lock:
+            sent = links0.stats["heartbeats_sent"]
+        assert sent >= 1, "idle link never heartbeat"
+    finally:
+        links0.close()
+        links1.close()
+
+
+# ---------------------------------------------------------------------------
+# bounded teardown
+
+
+@pytest.mark.chaos
+def test_close_is_bounded_with_live_peer():
+    """``close()`` must return in bounded time — bounded sender joins,
+    socket close to break parked reads, bounded re-join — even while the
+    peer is still up and mid-heartbeat."""
+    links0, links1 = _link_pair(
+        next_port(2), heartbeat_s=0.1, liveness_timeout_s=5.0
+    )
+    links0.send_async(1, ("slot", 0), {"x": 1})  # traffic in flight
+    t0 = time.monotonic()
+    links0.close()
+    links1.close()
+    dt = time.monotonic() - t0
+    assert dt < 8.0, f"teardown took {dt:.1f}s"
+    for links in (links0, links1):
+        for sender in links._senders.values():
+            assert not sender.is_alive(), "sender thread survived close()"
+        for reader in links._readers:
+            reader.join(2.0)
+            assert not reader.is_alive(), "reader thread survived close()"
