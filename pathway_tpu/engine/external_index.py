@@ -198,6 +198,32 @@ class ExternalIndexNode(Node):
         return out
 
     # ------------------------------------------------------------------
+    # persistence: the adapter's index is large out-of-band state — fold
+    # a serialized copy into the operator snapshot so a restarted worker
+    # restores it at the checkpointed epoch and replays only the tail
+    # instead of re-embedding/re-inserting the whole corpus
+
+    def snapshot_state(self, ctx):
+        if getattr(ctx, "worker_id", 0) != 0:
+            return None  # route_all_to_zero: worker 0 owns the index
+        sd = getattr(self.adapter, "state_dict", None)
+        if sd is None:
+            return None
+        st = ctx.state(self)
+        return {**st, "__index__": sd()}
+
+    def on_restore(self, ctx):
+        st = ctx.states.get(self.id)
+        if not isinstance(st, dict):
+            return
+        index_state = st.pop("__index__", None)
+        if index_state is None:
+            return
+        load = getattr(self.adapter, "load_state_dict", None)
+        if load is not None:
+            load(index_state)
+
+    # ------------------------------------------------------------------
     def process(self, ctx, time, inbatches):
         st = ctx.state(self)
         self._ctx = ctx

@@ -168,6 +168,15 @@ class Node:
         outputs to the checkpointed watermark here so replayed epochs
         cannot double-emit; most operators need nothing."""
 
+    def snapshot_state(self, ctx: RunContext) -> Any:
+        """Extra state to checkpoint IN PLACE of ``ctx.states[self.id]``,
+        or None to snapshot the plain operator state.  Operators holding
+        large out-of-band state (an external index) fold a serialized
+        copy into the snapshot here, keyed to the same connector offsets
+        as everything else; :meth:`on_restore` unfolds it.  Must return
+        picklable data (numpy, not jax arrays)."""
+        return None
+
     def __repr__(self) -> str:
         return f"<{self.name}#{self.id}>"
 

@@ -311,6 +311,7 @@ class Scheduler:
         if self.persistence is None or not self.persistence.operator_mode:
             return
         ctx = ctx or self.ctx
+        states = self._enriched_states(ctx)
         if asynchronous:
             save_async = getattr(
                 self.persistence, "save_operator_snapshot_async", None
@@ -321,7 +322,7 @@ class Scheduler:
                     for wr in wrappers.values()
                     if (fc := getattr(wr, "force_log_commit", None)) is not None
                 )
-                save_async(worker, epoch, consumed, ctx.states, commit_fns)
+                save_async(worker, epoch, consumed, states, commit_fns)
                 return
         flush = getattr(self.persistence, "flush_checkpoints", None)
         if flush is not None:
@@ -331,8 +332,29 @@ class Scheduler:
             if fc is not None:
                 fc()
         self.persistence.save_operator_snapshot(
-            worker, epoch, consumed, ctx.states
+            worker, epoch, consumed, states
         )
+
+    def _enriched_states(self, ctx: RunContext) -> dict[int, Any]:
+        """Operator states to checkpoint: ``ctx.states`` overlaid with
+        every node's :meth:`~pathway_tpu.engine.graph.Node.snapshot_state`
+        contribution (external-index serialization rides the same blob,
+        keyed to the same connector offsets).  A failing hook degrades to
+        the plain state for that node — rebuild-on-replay beats a dead
+        checkpoint."""
+        states = ctx.states
+        extras: dict[int, Any] = {}
+        for node in self.graph.nodes:
+            try:
+                extra = node.snapshot_state(ctx)
+            except Exception as e:  # noqa: BLE001
+                ctx.log_error(node, f"{node.name}#{node.id} snapshot_state: {e!r}")
+                continue
+            if extra is not None:
+                extras[node.id] = extra
+        if not extras:
+            return states
+        return {**states, **extras}
 
     def _restore_nodes(self, ctx: RunContext) -> None:
         """Post-restore hook pass: after operator state is restored from a

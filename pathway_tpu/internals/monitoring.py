@@ -15,6 +15,7 @@ __all__ = [
     "ProberStats",
     "STAGES",
     "collect_stats",
+    "index_stats",
     "start_dashboard",
 ]
 
@@ -247,6 +248,26 @@ def checkpoint_stats(sched: Any) -> dict[str, Any]:
         return {}
     snap["worker_restarts"] = int(getattr(sched, "worker_restarts", 0) or 0)
     return snap
+
+
+def index_stats(sched: Any) -> dict[str, Any]:
+    """Live external-index maintenance snapshot, one entry per index
+    operator: delta segment size, tombstones, merges, main-segment size
+    (see ``stdlib/indexing/segments.py``).  Empty dict when the graph
+    has no index operators (or their adapters predate ``stats()``)."""
+    graph = getattr(sched, "graph", None)
+    if graph is None:
+        return {}
+    out: dict[str, Any] = {}
+    for node in getattr(graph, "nodes", []):
+        stats_fn = getattr(getattr(node, "adapter", None), "stats", None)
+        if stats_fn is None:
+            continue
+        try:
+            out[f"{node.name}#{node.id}"] = dict(stats_fn())
+        except Exception:
+            continue
+    return out
 
 
 def latency_stats(sched: Any) -> dict[str, Any]:

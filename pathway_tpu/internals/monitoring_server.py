@@ -132,6 +132,33 @@ def _metrics_text(sched: Any) -> str:
         )
         lines.append("# TYPE pathway_tpu_checkpoint_bytes gauge")
         lines.append(f"pathway_tpu_checkpoint_bytes {ckpt.get('bytes', 0)}")
+    # live index maintenance (delta segment / tombstones / merges per
+    # external-index operator; see stdlib/indexing/segments.py) — the
+    # gauges that show churn outrunning the background merge
+    idx = _index_snapshot(sched)
+    if idx:
+        lines.append("# TYPE pathway_tpu_index_size gauge")
+        lines.append("# TYPE pathway_tpu_index_delta_size gauge")
+        lines.append("# TYPE pathway_tpu_index_tombstones gauge")
+        lines.append("# TYPE pathway_tpu_index_merges_total counter")
+        for name, s in sorted(idx.items()):
+            label = name.replace('"', "'")
+            lines.append(
+                f'pathway_tpu_index_size{{index="{label}"}} '
+                f"{s.get('size', 0)}"
+            )
+            lines.append(
+                f'pathway_tpu_index_delta_size{{index="{label}"}} '
+                f"{s.get('delta_size', 0)}"
+            )
+            lines.append(
+                f'pathway_tpu_index_tombstones{{index="{label}"}} '
+                f"{s.get('tombstones', 0)}"
+            )
+            lines.append(
+                f'pathway_tpu_index_merges_total{{index="{label}"}} '
+                f"{s.get('merges_total', 0)}"
+            )
     lines.append("# TYPE pathway_tpu_worker_restarts_total counter")
     lines.append(
         f"pathway_tpu_worker_restarts_total "
@@ -150,6 +177,12 @@ def _checkpoint_snapshot(sched: Any) -> dict[str, Any]:
     from pathway_tpu.internals.monitoring import checkpoint_stats
 
     return checkpoint_stats(sched)
+
+
+def _index_snapshot(sched: Any) -> dict[str, Any]:
+    from pathway_tpu.internals.monitoring import index_stats
+
+    return index_stats(sched)
 
 
 def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
@@ -185,6 +218,9 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         # epoch, its age/size, and the supervisor restart
                         # generation ({} when persistence is off)
                         "checkpoint": _checkpoint_snapshot(sched),
+                        # live index maintenance per index operator:
+                        # delta/tombstones/merges (segments.py)
+                        "index": _index_snapshot(sched),
                     }
                 ).encode()
                 ctype = "application/json"

@@ -35,6 +35,7 @@ from typing import Any, Callable
 from pathway_tpu.internals.udfs import ExponentialBackoffRetryStrategy
 
 __all__ = [
+    "BackgroundMaintenance",
     "BreakerState",
     "CircuitBreaker",
     "ClusterRunReport",
@@ -752,3 +753,77 @@ class ClusterSupervisor:
                 generation,
                 self.policy.max_restarts,
             )
+
+
+class BackgroundMaintenance:
+    """Single-flight guarded worker for background index maintenance.
+
+    The segmented index (``stdlib/indexing/segments.py``) hands its merge
+    jobs here so compaction runs off the query path.  One job is in
+    flight at a time (merges are not reentrant); a failing job is retried
+    on the same schedule connectors use
+    (:class:`~pathway_tpu.internals.udfs.ExponentialBackoffRetryStrategy`)
+    and gives up after ``max_retries``, counting the failure in telemetry
+    so /metrics shows maintenance that silently stopped making progress.
+    """
+
+    def __init__(
+        self,
+        name: str = "index-maintenance",
+        *,
+        max_retries: int = 2,
+        initial_delay_ms: int = 50,
+        max_delay_ms: int = 2000,
+    ):
+        self.name = name
+        self._backoff = ExponentialBackoffRetryStrategy(
+            max_retries=max_retries,
+            initial_delay=initial_delay_ms,
+            jitter_ms=0,
+            max_delay_ms=max_delay_ms,
+        )
+        self._max_retries = max_retries
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._closed = False
+
+    @property
+    def busy(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def submit(self, job: Callable[[], None]) -> bool:
+        """Run ``job`` on the maintenance thread; ``False`` if one is
+        already in flight (the caller re-submits on its next trigger)."""
+        with self._lock:
+            if self._closed or self.busy:
+                return False
+            self._thread = threading.Thread(
+                target=self._run, args=(job,), daemon=True, name=self.name
+            )
+            self._thread.start()
+            return True
+
+    def _run(self, job: Callable[[], None]) -> None:
+        from pathway_tpu.internals.telemetry import get_telemetry
+
+        for attempt in range(self._max_retries + 1):
+            try:
+                job()
+                return
+            except Exception:  # noqa: BLE001
+                get_telemetry().counter("index.merge_failures")
+                _logger.exception("%s job failed (attempt %d)", self.name, attempt)
+                if attempt >= self._max_retries or self._closed:
+                    return
+                _time.sleep(self._backoff.next_delay(attempt))
+
+    def drain(self, timeout: float | None = 10.0) -> None:
+        """Wait for the in-flight job (checkpoint/shutdown barrier)."""
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
+    def close(self, timeout: float | None = 5.0) -> None:
+        self._closed = True
+        self.drain(timeout)

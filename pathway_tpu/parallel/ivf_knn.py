@@ -83,6 +83,9 @@ class IvfKnnIndex:
     Keys are arbitrary hashable host objects; the device sees (cell, slot).
     """
 
+    # segment merges mutate the cell slabs in place (remove+upsert)
+    merge_strategy = "inplace"
+
     def __init__(
         self,
         dim: int,
@@ -124,6 +127,14 @@ class IvfKnnIndex:
     # ------------------------------------------------------------------
     def __len__(self) -> int:
         return len(self._slot_of) + len(self._pending)
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._slot_of or any(k == key for k, _v in self._pending)
+
+    def keys(self) -> list:
+        seen = list(self._slot_of)
+        seen.extend(k for k, _v in self._pending if k not in self._slot_of)
+        return seen
 
     @property
     def trained(self) -> bool:

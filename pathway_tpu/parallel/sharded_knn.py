@@ -44,6 +44,9 @@ class ShardedKnnIndex:
     Keys are arbitrary hashable host objects; the device only sees slots.
     """
 
+    # segment merges mutate the slab in place (remove+upsert scatters)
+    merge_strategy = "inplace"
+
     def __init__(
         self,
         dim: int,
@@ -82,6 +85,11 @@ class ShardedKnnIndex:
         # so collect() never resolves a reused slot to the wrong key
         self._inflight = 0
         self._quarantine: list[int] = []
+        # buffer generation: bumped on every realloc (_grow) so a handle
+        # dispatched against the old arrays is recognizably pre-grow —
+        # its captured buffers stay alive (no donation while in flight)
+        # and its slot->key decode is grow-stable
+        self._version = 0
 
     # ------------------------------------------------------------------
     def _round_capacity(self, cap: int) -> int:
@@ -96,6 +104,9 @@ class ShardedKnnIndex:
 
     def __len__(self) -> int:
         return len(self._slot_of)
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._slot_of
 
     @property
     def keys(self) -> list:
@@ -115,6 +126,34 @@ class ShardedKnnIndex:
     @functools.partial(jax.jit, donate_argnums=(0,))
     def _scatter_clear(valid, slots):
         return valid.at[slots].set(0.0, mode="drop")
+
+    # non-donating twins: used whenever a dispatch handle is in flight —
+    # donating would hand the searched buffers' memory to the scatter
+    # output while the async search may still read them (satellite fix:
+    # growth/updates under concurrent dispatch)
+    @staticmethod
+    @jax.jit
+    def _scatter_set_safe(vectors, valid, slots, vals):
+        vectors = vectors.at[slots].set(vals, mode="drop")
+        valid = valid.at[slots].set(1.0, mode="drop")
+        return vectors, valid
+
+    @staticmethod
+    @jax.jit
+    def _scatter_clear_safe(valid, slots):
+        return valid.at[slots].set(0.0, mode="drop")
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnums=(4,))
+    def _scatter_set_device_safe(vectors, valid, slots, vals, normalize):
+        vals = vals.astype(jnp.float32)
+        if normalize:
+            n = jnp.linalg.norm(vals, axis=1, keepdims=True)
+            vals = vals / jnp.maximum(n, 1e-30)
+        vals = vals.astype(vectors.dtype)
+        vectors = vectors.at[slots].set(vals, mode="drop")
+        valid = valid.at[slots].set(1.0, mode="drop")
+        return vectors, valid
 
     @staticmethod
     @functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4,))
@@ -186,7 +225,8 @@ class ShardedKnnIndex:
             vectors = vectors / norms
         vals = vectors.astype(np.dtype(self.dtype), copy=False)
         vals = pad_rows(vals, b)
-        self._vectors, self._valid = self._scatter_set(
+        scatter = self._scatter_set if self._inflight == 0 else self._scatter_set_safe
+        self._vectors, self._valid = scatter(
             self._vectors, self._valid, jnp.asarray(slots), jnp.asarray(vals)
         )
 
@@ -212,7 +252,12 @@ class ShardedKnnIndex:
         if n > b:
             raise ValueError(f"{n} keys but only {b} vector rows")
         slots = self._assign_slots(keys, pad_to=b)
-        self._vectors, self._valid = self._scatter_set_device(
+        scatter = (
+            self._scatter_set_device
+            if self._inflight == 0
+            else self._scatter_set_device_safe
+        )
+        self._vectors, self._valid = scatter(
             self._vectors,
             self._valid,
             jnp.asarray(slots),
@@ -234,7 +279,8 @@ class ShardedKnnIndex:
         if not slots:
             return
         arr = pad_rows(np.asarray(slots, np.int32), bucket_size(len(slots)), fill=self.capacity)
-        self._valid = self._scatter_clear(self._valid, jnp.asarray(arr))
+        clear = self._scatter_clear if self._inflight == 0 else self._scatter_clear_safe
+        self._valid = clear(self._valid, jnp.asarray(arr))
 
     def _grow(self) -> None:
         """2x capacity realloc (host roundtrip; rare and amortized)."""
@@ -244,6 +290,10 @@ class ShardedKnnIndex:
         host_vec[: self.capacity] = np.asarray(self._vectors)
         host_valid[: self.capacity] = np.asarray(self._valid)
         self.capacity = new_cap
+        # in-flight handles keep referencing the pre-grow buffers (their
+        # computations captured them); bump the generation so they are
+        # identifiable and never confused with the new slab
+        self._version += 1
         self._vectors = (
             jax.device_put(host_vec, self._vec_sharding)
             if self._vec_sharding is not None
@@ -325,7 +375,7 @@ class ShardedKnnIndex:
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         nq = queries.shape[0]
         if nq == 0 or not self._slot_of:
-            return (None, nq, k)
+            return (None, nq, k, self._version)
         k_eff = min(k, self.capacity)
         qb = pad_rows(queries, bucket_size(nq, min_bucket=1))
         out = self._search_jit(k_eff)(jnp.asarray(qb), self._vectors, self._valid)
@@ -339,11 +389,17 @@ class ShardedKnnIndex:
             if copy_async is not None:
                 copy_async()
         self._inflight += 1
-        return (out, nq, k)
+        return (out, nq, k, self._version)
 
     def collect(self, handle) -> list[list[tuple[Any, float]]]:
-        """Resolve a :meth:`dispatch` handle to [[(key, score), ...], ...]."""
-        out, nq, k = handle
+        """Resolve a :meth:`dispatch` handle to [[(key, score), ...], ...].
+
+        Valid across a ``_grow``: the handle's computation captured the
+        dispatch-time buffers (generation recorded in the handle), slot
+        numbering is grow-stable, and freed slots stay quarantined while
+        any handle is outstanding — so a pre-grow handle decodes to
+        exactly the keys that were live when it was dispatched."""
+        out, nq, k, _version = handle
         if out is None:
             return [[] for _ in range(nq)]
         self._inflight = max(0, self._inflight - 1)

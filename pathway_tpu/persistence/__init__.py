@@ -624,6 +624,10 @@ class PersistenceHooks:
             "count": 0,
             "wall_at": None,
             "mono_at": None,
+            # serialization cost on the WORKER thread (epoch boundary):
+            # with external-index state riding the snapshot this is the
+            # part of the checkpoint the hot path actually pays for
+            "pickle_seconds": 0.0,
         }
 
     def persisted(self, node: Any) -> bool:
@@ -644,6 +648,7 @@ class PersistenceHooks:
         states}`` for one worker.  Returns False (and disables nothing)
         when a state is unpicklable — recovery then falls back to full
         input replay for correctness."""
+        t0 = _time.monotonic()
         try:
             blob = pickle.dumps(
                 {"epoch": epoch, "consumed": dict(consumed), "states": states},
@@ -654,8 +659,9 @@ class PersistenceHooks:
                 "operator snapshot skipped (unpicklable state): %r", e
             )
             return False
+        pickle_s = _time.monotonic() - t0
         self.impl.put_blob(f"opsnap_w{worker}", blob)
-        self._note_checkpoint(epoch, len(blob))
+        self._note_checkpoint(epoch, len(blob), pickle_s)
         return True
 
     def save_operator_snapshot_async(
@@ -675,6 +681,7 @@ class PersistenceHooks:
         events the worker records after this enqueue are past the
         snapshot's counts — a later commit covering them is harmless).
         Returns False only when the state is unpicklable."""
+        t0 = _time.monotonic()
         try:
             blob = pickle.dumps(
                 {"epoch": epoch, "consumed": dict(consumed), "states": states},
@@ -685,6 +692,7 @@ class PersistenceHooks:
                 "operator snapshot skipped (unpicklable state): %r", e
             )
             return False
+        self._last_pickle_s = _time.monotonic() - t0
         with self._ckpt_cv:
             if self._ckpt_thread is None:
                 self._ckpt_thread = threading.Thread(
@@ -709,7 +717,9 @@ class PersistenceHooks:
                 for fn in commit_fns:  # log commits land before the blob
                     fn()
                 self.impl.put_blob(f"opsnap_w{worker}", blob)
-                self._note_checkpoint(epoch, len(blob))
+                self._note_checkpoint(
+                    epoch, len(blob), getattr(self, "_last_pickle_s", 0.0)
+                )
             except Exception as e:  # a failed checkpoint only delays recovery
                 _logger.warning("async checkpoint failed: %r", e)
             finally:
@@ -730,7 +740,9 @@ class PersistenceHooks:
                 self._ckpt_cv.wait(min(remaining, 0.5))
         return True
 
-    def _note_checkpoint(self, epoch: int, nbytes: int) -> None:
+    def _note_checkpoint(
+        self, epoch: int, nbytes: int, pickle_s: float = 0.0
+    ) -> None:
         with self._ckpt_stats_lock:
             st = self.checkpoint_stats
             st["epoch"] = epoch
@@ -738,6 +750,7 @@ class PersistenceHooks:
             st["count"] += 1
             st["wall_at"] = _time.time()
             st["mono_at"] = _time.monotonic()
+            st["pickle_seconds"] = round(pickle_s, 6)
 
     def checkpoint_snapshot(self) -> dict[str, Any]:
         """Monitoring view of the last checkpoint: epoch, size, count and

@@ -27,6 +27,7 @@ from pathway_tpu.stdlib.indexing.data_index import (
     UsearchKnnFactory,
 )
 from pathway_tpu.stdlib.indexing.filters import compile_filter
+from pathway_tpu.stdlib.indexing.segments import SegmentedIndex
 from pathway_tpu.stdlib.indexing.sorting import retrieve_prev_next_values
 from pathway_tpu.stdlib.indexing.vector_document_index import (
     VectorDocumentIndex,
@@ -54,6 +55,7 @@ __all__ = [
     "KnnAdapter",
     "BM25Adapter",
     "HybridAdapter",
+    "SegmentedIndex",
     "compile_filter",
     "retrieve_prev_next_values",
     "VectorDocumentIndex",

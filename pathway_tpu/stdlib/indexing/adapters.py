@@ -29,8 +29,26 @@ __all__ = [
 _OVERFETCH = 4
 
 
+def _segmented(main, delta_cap, tombstone_fraction, auto_merge):
+    from pathway_tpu.stdlib.indexing.segments import SegmentedIndex
+
+    return SegmentedIndex(
+        main,
+        delta_cap=delta_cap,
+        tombstone_fraction=tombstone_fraction,
+        auto_merge=auto_merge,
+    )
+
+
 class KnnAdapter:
-    """(key, vector) index over :class:`ShardedKnnIndex` + host metadata."""
+    """(key, vector) index over :class:`ShardedKnnIndex` + host metadata.
+
+    The concrete index is fronted by a
+    :class:`~pathway_tpu.stdlib.indexing.segments.SegmentedIndex`: live
+    upserts/deletes land in a delta segment + tombstone set and a
+    background merge compacts them into the sealed main segment
+    (``delta_cap``/``tombstone_fraction``/``auto_merge`` knobs, env
+    defaults ``PATHWAY_INDEX_*``)."""
 
     def __init__(
         self,
@@ -40,17 +58,25 @@ class KnnAdapter:
         capacity: int = 1024,
         mesh: Any = None,
         dtype: Any = None,
+        delta_cap: int | None = None,
+        tombstone_fraction: float | None = None,
+        auto_merge: bool | None = None,
     ):
         import jax.numpy as jnp
 
         from pathway_tpu.parallel import ShardedKnnIndex
 
-        self.index = ShardedKnnIndex(
-            dim,
-            metric=metric,
-            capacity=capacity,
-            mesh=mesh,
-            dtype=dtype or jnp.float32,
+        self.index = _segmented(
+            ShardedKnnIndex(
+                dim,
+                metric=metric,
+                capacity=capacity,
+                mesh=mesh,
+                dtype=dtype or jnp.float32,
+            ),
+            delta_cap,
+            tombstone_fraction,
+            auto_merge,
         )
         self.meta: dict[Any, dict | None] = {}
 
@@ -96,6 +122,19 @@ class KnnAdapter:
             out.append(reply[: k[qi]])
         return out
 
+    # ------------------------------------------------- persistence / stats
+
+    def state_dict(self) -> dict:
+        return {"index": self.index.state_dict(), "meta": dict(self.meta)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.index.load_state_dict(state["index"])
+        self.meta = dict(state["meta"])
+
+    def stats(self) -> dict:
+        s = getattr(self.index, "stats", None)
+        return s() if s is not None else {"size": len(self.index)}
+
 
 class HnswAdapter(KnnAdapter):
     """(key, vector) index over the host HNSW graph
@@ -111,16 +150,24 @@ class HnswAdapter(KnnAdapter):
         M: int = 16,
         ef_construction: int = 128,
         ef_search: int = 64,
+        delta_cap: int | None = None,
+        tombstone_fraction: float | None = None,
+        auto_merge: bool | None = None,
         **_ignored: Any,
     ):
         from pathway_tpu.stdlib.indexing.hnsw import HnswIndex
 
-        self.index = HnswIndex(
-            dim,
-            metric=metric,
-            M=M,
-            ef_construction=ef_construction,
-            ef_search=ef_search,
+        self.index = _segmented(
+            HnswIndex(
+                dim,
+                metric=metric,
+                M=M,
+                ef_construction=ef_construction,
+                ef_search=ef_search,
+            ),
+            delta_cap,
+            tombstone_fraction,
+            auto_merge,
         )
         self.meta: dict[Any, dict | None] = {}
 
@@ -175,6 +222,25 @@ class BM25Adapter:
 
     def __len__(self) -> int:
         return len(self.doc_len)
+
+    def state_dict(self) -> dict:
+        return {
+            "postings": {t: dict(d) for t, d in self.postings.items()},
+            "doc_len": dict(self.doc_len),
+            "doc_terms": dict(self.doc_terms),
+            "meta": dict(self.meta),
+            "total_len": self.total_len,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.postings = defaultdict(dict, {t: dict(d) for t, d in state["postings"].items()})
+        self.doc_len = dict(state["doc_len"])
+        self.doc_terms = dict(state["doc_terms"])
+        self.meta = dict(state["meta"])
+        self.total_len = state["total_len"]
+
+    def stats(self) -> dict:
+        return {"size": len(self.doc_len), "terms": len(self.postings)}
 
     def search(
         self,
@@ -235,6 +301,26 @@ class HybridAdapter:
             if hasattr(child, "set_meta"):
                 child.set_meta(key, meta)
 
+    def state_dict(self) -> dict:
+        return {
+            "children": [
+                child.state_dict() if hasattr(child, "state_dict") else None
+                for child in self.children
+            ]
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        for child, sub in zip(self.children, state["children"]):
+            if sub is not None and hasattr(child, "load_state_dict"):
+                child.load_state_dict(sub)
+
+    def stats(self) -> dict:
+        return {
+            f"child{ci}": child.stats()
+            for ci, child in enumerate(self.children)
+            if hasattr(child, "stats")
+        }
+
     def search(self, payloads, k, filters):
         per_child = []
         for ci, child in enumerate(self.children):
@@ -272,17 +358,25 @@ class IvfAdapter(KnnAdapter):
         dtype: Any = None,
         nlist: int | None = None,
         nprobe: int | None = None,
+        delta_cap: int | None = None,
+        tombstone_fraction: float | None = None,
+        auto_merge: bool | None = None,
     ):
         import jax.numpy as jnp
 
         from pathway_tpu.parallel import IvfKnnIndex
 
-        self.index = IvfKnnIndex(
-            dim,
-            metric=metric,
-            capacity=capacity,
-            dtype=dtype or jnp.bfloat16,
-            nlist=nlist,
-            nprobe=nprobe,
+        self.index = _segmented(
+            IvfKnnIndex(
+                dim,
+                metric=metric,
+                capacity=capacity,
+                dtype=dtype or jnp.bfloat16,
+                nlist=nlist,
+                nprobe=nprobe,
+            ),
+            delta_cap,
+            tombstone_fraction,
+            auto_merge,
         )
         self.meta: dict[Any, dict | None] = {}

@@ -83,6 +83,9 @@ class BruteForceKnn(InnerIndex):
         metric: str = BruteForceKnnMetricKind.COS,
         mesh: Any = None,
         dtype: Any = None,
+        delta_cap: int | None = None,
+        tombstone_fraction: float | None = None,
+        auto_merge: bool | None = None,
     ):
         super().__init__(data_column, metadata_column)
         self.dimensions = dimensions
@@ -90,6 +93,19 @@ class BruteForceKnn(InnerIndex):
         self.metric = metric
         self.mesh = mesh
         self.dtype = dtype
+        # live-maintenance knobs for the segment layer fronting the
+        # index (delta segment + background merge; PATHWAY_INDEX_* env
+        # defaults apply when unset)
+        self.delta_cap = delta_cap
+        self.tombstone_fraction = tombstone_fraction
+        self.auto_merge = auto_merge
+
+    def _maintenance_kwargs(self) -> dict:
+        return {
+            "delta_cap": self.delta_cap,
+            "tombstone_fraction": self.tombstone_fraction,
+            "auto_merge": self.auto_merge,
+        }
 
     def make_adapter(self) -> Any:
         return KnnAdapter(
@@ -98,6 +114,7 @@ class BruteForceKnn(InnerIndex):
             capacity=self.reserved_space,
             mesh=self.mesh,
             dtype=self.dtype,
+            **self._maintenance_kwargs(),
         )
 
 
@@ -127,6 +144,9 @@ class UsearchKnn(BruteForceKnn):
         M: int = 16,
         ef_construction: int = 128,
         ef_search: int = 64,
+        delta_cap: int | None = None,
+        tombstone_fraction: float | None = None,
+        auto_merge: bool | None = None,
     ):
         super().__init__(
             data_column,
@@ -136,6 +156,9 @@ class UsearchKnn(BruteForceKnn):
             metric=metric,
             mesh=mesh,
             dtype=dtype,
+            delta_cap=delta_cap,
+            tombstone_fraction=tombstone_fraction,
+            auto_merge=auto_merge,
         )
         self.nlist = nlist
         self.nprobe = nprobe
@@ -167,6 +190,7 @@ class UsearchKnn(BruteForceKnn):
                 dtype=self.dtype,
                 nlist=self.nlist,
                 nprobe=self.nprobe,
+                **self._maintenance_kwargs(),
             )
         from pathway_tpu.stdlib.indexing.adapters import HnswAdapter
 
@@ -176,6 +200,7 @@ class UsearchKnn(BruteForceKnn):
             M=self.M,
             ef_construction=self.ef_construction,
             ef_search=self.ef_search,
+            **self._maintenance_kwargs(),
         )
 
 
@@ -249,6 +274,9 @@ class BruteForceKnnFactory(InnerIndexFactory):
     metric: str = BruteForceKnnMetricKind.COS
     embedder: Any = None
     mesh: Any = None
+    delta_cap: int | None = None
+    tombstone_fraction: float | None = None
+    auto_merge: bool | None = None
 
     _cls = BruteForceKnn
 
@@ -265,6 +293,9 @@ class BruteForceKnnFactory(InnerIndexFactory):
             reserved_space=self.reserved_space,
             metric=self.metric,
             mesh=self.mesh,
+            delta_cap=self.delta_cap,
+            tombstone_fraction=self.tombstone_fraction,
+            auto_merge=self.auto_merge,
         )
         idx.embedder = self.embedder
         return idx

@@ -11,10 +11,19 @@ identical in results.
 
 Scores follow the repo convention (higher = closer): ``cos``/``dot``
 return the inner product; ``l2sq`` the negated squared distance.
+
+Removal tombstones graph slots rather than unlinking them, so
+long-running churn walks over dead entries; once the dead fraction
+passes ``tombstone_fraction`` the index compacts itself by rebuilding
+the graph from the host-side vector store.  The same store backs
+``state_dict``/``load_state_dict`` (checkpoint restore) and
+``export``/``fresh`` (segment merges, see
+:class:`~pathway_tpu.stdlib.indexing.segments.SegmentedIndex`).
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Sequence
 
 import numpy as np
@@ -23,9 +32,15 @@ from pathway_tpu.internals import native as _native
 
 __all__ = ["HnswIndex"]
 
+_COMPACT_MIN_SLOTS = 64
+_CHUNK = 4096
+
 
 class HnswIndex:
     """(key, vector) ANN index with live add/remove."""
+
+    # segment merges rebuild a fresh graph rather than editing in place
+    merge_strategy = "rebuild"
 
     def __init__(
         self,
@@ -35,6 +50,7 @@ class HnswIndex:
         M: int = 16,
         ef_construction: int = 128,
         ef_search: int = 64,
+        tombstone_fraction: float = 0.33,
     ):
         if metric not in ("cos", "dot", "l2sq"):
             raise ValueError(f"unknown metric {metric!r}")
@@ -43,8 +59,15 @@ class HnswIndex:
         self.M = M
         self.ef_construction = ef_construction
         self.ef_search = ef_search
+        self.tombstone_fraction = tombstone_fraction
         self._slot_of: dict[Any, int] = {}
         self._key_of: dict[int, Any] = {}
+        # host copy of every live vector (already ``_prep``-ed): feeds
+        # the exact fallback, compaction rebuilds, and state_dict
+        self._store: dict[Any, np.ndarray] = {}
+        self._hw = 0  # native slot high-water mark (live + tombstoned)
+        self.compactions = 0
+        self._lock = threading.RLock()
         native = _native.load()
         if native is not None and hasattr(native, "hnsw_new"):
             self._native = native
@@ -53,12 +76,15 @@ class HnswIndex:
             )
         else:  # exact fallback: same results, no graph
             self._native = None
-            self._vecs: dict[Any, np.ndarray] = {}
 
     def __len__(self) -> int:
-        if self._native is None:
-            return len(self._vecs)
-        return self._native.hnsw_len(self._h)
+        return len(self._store)
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._store
+
+    def keys(self) -> list:
+        return list(self._store)
 
     def _prep(self, vecs: np.ndarray) -> np.ndarray:
         vecs = np.ascontiguousarray(vecs, np.float32)
@@ -77,34 +103,79 @@ class HnswIndex:
         for k, v in items:
             last[k] = v
         items = list(last.items())
-        # re-adding a key replaces its vector
-        stale = [k for k, _ in items if k in self._slot_of]
-        if stale:
-            self.remove(stale)
         keys = [k for k, _ in items]
         mat = self._prep(np.stack([np.asarray(v, np.float32) for _, v in items]))
+        with self._lock:
+            # re-adding a key replaces its vector
+            stale = [k for k in keys if k in self._slot_of]
+            if stale:
+                self.remove(stale)
+            self._insert_prepped(keys, mat)
+
+    def _insert_prepped(self, keys: list, mat: np.ndarray) -> None:
+        for key, row in zip(keys, mat):
+            self._store[key] = row
         if self._native is None:
-            for key, row in zip(keys, mat):
-                self._vecs[key] = row
             return
         slots = self._native.hnsw_add(self._h, mat)
         for key, slot in zip(keys, slots):
             self._slot_of[key] = slot
             self._key_of[slot] = key
+            if slot >= self._hw:
+                self._hw = slot + 1
 
     def remove(self, keys: Sequence[Any]) -> None:
-        if self._native is None:
+        """Remove keys; absent keys are a no-op (churn replay sends
+        deletes for rows that never made the checkpoint)."""
+        with self._lock:
+            if self._native is None:
+                for k in keys:
+                    self._store.pop(k, None)
+                return
+            slots = []
             for k in keys:
-                self._vecs.pop(k, None)
+                s = self._slot_of.pop(k, None)
+                if s is not None:
+                    self._key_of.pop(s, None)
+                    self._store.pop(k, None)
+                    slots.append(s)
+            if slots:
+                self._native.hnsw_remove(self._h, slots)
+            dead = self._hw - len(self._slot_of)
+            if (
+                self._hw >= _COMPACT_MIN_SLOTS
+                and dead > self.tombstone_fraction * self._hw
+            ):
+                self.compact()
+
+    def compact(self) -> None:
+        """Rebuild the native graph from live vectors, reclaiming
+        tombstoned slots (satellite: unbounded tombstone growth)."""
+        if self._native is None:
             return
-        slots = []
-        for k in keys:
-            s = self._slot_of.pop(k, None)
-            if s is not None:
-                self._key_of.pop(s, None)
-                slots.append(s)
-        if slots:
-            self._native.hnsw_remove(self._h, slots)
+        with self._lock:
+            keys = list(self._store.keys())
+            h = self._native.hnsw_new(
+                self.dim, self.M, self.ef_construction,
+                1 if self.metric == "l2sq" else 0,
+            )
+            slot_of: dict[Any, int] = {}
+            key_of: dict[int, Any] = {}
+            hw = 0
+            for i in range(0, len(keys), _CHUNK):
+                chunk = keys[i : i + _CHUNK]
+                mat = np.stack([self._store[k] for k in chunk])
+                slots = self._native.hnsw_add(h, np.ascontiguousarray(mat))
+                for key, slot in zip(chunk, slots):
+                    slot_of[key] = slot
+                    key_of[slot] = key
+                    if slot >= hw:
+                        hw = slot + 1
+            # atomic swap: a concurrent search snapshots the old pair
+            self._h, self._slot_of, self._key_of, self._hw = (
+                h, slot_of, key_of, hw,
+            )
+            self.compactions += 1
 
     def search(
         self, queries: np.ndarray, k: int
@@ -117,28 +188,30 @@ class HnswIndex:
         k = min(k, n)
         if self._native is None:
             return self._search_exact(queries, k)
+        with self._lock:  # consistent (handle, key map) pair vs compact()
+            h, key_of = self._h, self._key_of
         ef = max(self.ef_search, k)
-        raw = self._native.hnsw_search(self._h, queries, k, ef)
+        raw = self._native.hnsw_search(h, queries, k, ef)
         # adaptive retry: heavy tombstone churn can starve survivors
         while any(len(ids) < k for ids, _ in raw) and ef < 4 * n:
             ef *= 4
-            raw = self._native.hnsw_search(self._h, queries, k, ef)
+            raw = self._native.hnsw_search(h, queries, k, ef)
         out: list[list[tuple[Any, float]]] = []
         for ids, dists in raw:
             # native distance is -dot (ip) or l2sq; both negate into the
             # higher-is-closer score convention
             out.append(
                 [
-                    (self._key_of[s], -d)
+                    (key_of[s], -d)
                     for s, d in zip(ids, dists)
-                    if s in self._key_of
+                    if s in key_of
                 ]
             )
         return out
 
     def _search_exact(self, q: np.ndarray, k: int) -> list[list[tuple[Any, float]]]:
-        keys = list(self._vecs.keys())
-        mat = np.stack([self._vecs[key] for key in keys])
+        keys = list(self._store.keys())
+        mat = np.stack([self._store[key] for key in keys])
         if self.metric == "l2sq":
             scores = -(
                 ((q[:, None, :] - mat[None, :, :]) ** 2).sum(-1)
@@ -151,6 +224,74 @@ class HnswIndex:
             out.append([(keys[i], float(row[i])) for i in top])
         return out
 
-# NOTE: no state_dict — external-index adapters are rebuilt from replayed
-# input on recovery (engine/external_index.py keeps docs in operator
-# state; the adapter is reconstructed, never pickled).
+    # ------------------------------------------------- segments / persistence
+
+    def fresh(self) -> "HnswIndex":
+        """Empty index with the same hyperparameters (merge rebuilds)."""
+        return HnswIndex(
+            self.dim,
+            metric=self.metric,
+            M=self.M,
+            ef_construction=self.ef_construction,
+            ef_search=self.ef_search,
+            tombstone_fraction=self.tombstone_fraction,
+        )
+
+    def export(self) -> tuple[list, np.ndarray]:
+        """(keys, matrix) of live vectors, already normalized."""
+        with self._lock:
+            keys = list(self._store.keys())
+            mat = (
+                np.stack([self._store[k] for k in keys])
+                if keys
+                else np.zeros((0, self.dim), np.float32)
+            )
+        return keys, mat
+
+    def stats(self) -> dict:
+        slots = self._hw if self._native is not None else len(self._store)
+        return {
+            "size": len(self._store),
+            "slots": slots,
+            "tombstones": max(0, slots - len(self._store)),
+            "compactions": self.compactions,
+        }
+
+    def state_dict(self) -> dict:
+        """Host arrays only (picklable through the checkpoint writer);
+        the graph itself is rebuilt on load — insertion is the cost of
+        restore, but no native memory layout leaks into snapshots."""
+        keys, mat = self.export()
+        return {
+            "kind": "hnsw",
+            "dim": self.dim,
+            "metric": self.metric,
+            "M": self.M,
+            "ef_construction": self.ef_construction,
+            "ef_search": self.ef_search,
+            "keys": keys,
+            "vectors": mat,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if state.get("dim", self.dim) != self.dim or state.get(
+            "metric", self.metric
+        ) != self.metric:
+            raise ValueError("state_dict does not match index configuration")
+        keys = list(state["keys"])
+        mat = np.ascontiguousarray(np.asarray(state["vectors"], np.float32))
+        with self._lock:
+            self._store = {}
+            self._slot_of = {}
+            self._key_of = {}
+            self._hw = 0
+            if self._native is not None:
+                self._h = self._native.hnsw_new(
+                    self.dim, self.M, self.ef_construction,
+                    1 if self.metric == "l2sq" else 0,
+                )
+            for i in range(0, len(keys), _CHUNK):
+                self._insert_prepped(
+                    keys[i : i + _CHUNK],
+                    np.ascontiguousarray(mat[i : i + _CHUNK]),
+                )

@@ -24,6 +24,10 @@ Cluster fault primitives (drive ``tests/test_cluster_recovery.py``):
 - :meth:`chaos.kill_worker` — a chosen worker rank dies at the start of
   its Nth epoch (``ChaosError`` or a hard ``os._exit`` — the latter is
   what a real SIGKILL looks like to the rest of the mesh).
+- :meth:`chaos.kill_worker_mid_merge` — the process hosting a chosen
+  rank dies in the instant between a finished background index merge
+  and its atomic commit (``SegmentedIndex._pre_commit``), the widest
+  crash window online index maintenance has.
 - :meth:`chaos.delay_exchange_frames` / :meth:`chaos.drop_exchange_frames`
   — latency or loss injected at the peer link's single egress point
   (``_PeerSender._transmit``); dropping mutes heartbeats too, so a muted
@@ -32,6 +36,10 @@ Cluster fault primitives (drive ``tests/test_cluster_recovery.py``):
   cluster fault-free, re-run it with a worker killed at a random epoch
   under :class:`~pathway_tpu.internals.resilience.ClusterSupervisor`,
   and assert the recovered output is byte-identical.
+- :class:`IndexDrill` — the live-index variant: a vector index under
+  upsert churn, killed mid-merge, must recover with exactly-once
+  upserts (index size equals the distinct doc count — nothing dropped,
+  nothing double-applied) and recall over the final corpus.
 
 Usage::
 
@@ -52,7 +60,7 @@ import threading
 import time as _time
 from typing import Any, Callable, Iterable
 
-__all__ = ["ChaosError", "ClusterDrill", "chaos", "flaky_once"]
+__all__ = ["ChaosError", "ClusterDrill", "IndexDrill", "chaos", "flaky_once"]
 
 
 class ChaosError(RuntimeError):
@@ -300,6 +308,47 @@ class chaos:
             return orig(sched, time, inject, **kwargs)
 
         self._patch(Scheduler, "run_epoch", wrapper)
+
+    def kill_worker_mid_merge(
+        self,
+        rank: int,
+        on_nth_merge: int = 1,
+        generation: int = 0,
+        exit_code: int = 71,
+    ) -> None:
+        """The process hosting worker ``rank`` dies (hard ``os._exit``)
+        in the instant between a finished background index merge and its
+        atomic commit — :meth:`SegmentedIndex._pre_commit`, the widest
+        crash window online index maintenance has: the merge work is
+        done but none of it is published, and the last checkpoint holds
+        the pre-merge segmentation.  Recovery must restore that
+        checkpoint, replay the connector tail (idempotent upserts), and
+        simply re-merge — nothing lost, nothing double-applied.
+
+        ``on_nth_merge`` counts merge commits within the armed process
+        (1-based); ``generation`` arms the fault only in that supervisor
+        respawn generation (vs ``PATHWAY_WORKER_RESTARTS``) so the
+        restarted cluster does not re-kill itself forever.  The rank is
+        matched against ``PATHWAY_PROCESS_ID`` at arm time: the merge
+        runs on a maintenance thread with no worker context, so the
+        fault is scoped per process, not per in-process thread."""
+        from pathway_tpu.stdlib.indexing.segments import SegmentedIndex
+
+        if int(os.environ.get("PATHWAY_WORKER_RESTARTS", "0")) != generation:
+            return  # a later generation: the fault already fired and is spent
+        if int(os.environ.get("PATHWAY_PROCESS_ID", "0")) != rank:
+            return
+        orig = SegmentedIndex._pre_commit
+        key = self._counter_key(SegmentedIndex, "_pre_commit")
+
+        @functools.wraps(orig)
+        def wrapper(seg: Any) -> Any:
+            count = self._bump(key)
+            if count == on_nth_merge:
+                os._exit(exit_code)
+            return orig(seg)
+
+        self._patch(SegmentedIndex, "_pre_commit", wrapper)
 
     def delay_exchange_frames(
         self,
@@ -578,6 +627,316 @@ class ClusterDrill:
             "faulted_seconds": faulted_seconds,
             "baseline_output": baseline.decode(),
             "recovered_output": recovered.decode(),
+            "failures": list(drill_report.failures),
+        }
+
+
+_INDEX_DRILL_PROGRAM = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+import pathway_tpu as pw
+from pathway_tpu.persistence import Backend, Config, PersistenceMode
+
+_kill_rank = int(os.environ.get("CHAOS_KILL_RANK", "-1"))
+if _kill_rank >= 0:
+    from pathway_tpu.testing.chaos import chaos as _chaos
+
+    _c = _chaos(seed=int(os.environ.get("CHAOS_SEED", "0")))
+    _c.__enter__()  # never restored: this process dies or exits
+    _c.kill_worker_mid_merge(
+        _kill_rank, on_nth_merge=int(os.environ["CHAOS_KILL_MERGE"])
+    )
+
+
+class Doc(pw.Schema):
+    # "id" is the engine's reserved row-key column — the doc key is "doc"
+    doc: str = pw.column_definition(primary_key=True)
+    vec: str
+
+
+class Q(pw.Schema):
+    qid: str = pw.column_definition(primary_key=True)
+    qvec: str
+
+
+class DocSubject(pw.io.python.ConnectorSubject):
+    # one ordered reader (worker 0): an upsert stream is ordered per key,
+    # and the partitioned static-file byte-range split would let a
+    # re-upsert race its own base version across ranks
+    deterministic_replay = True  # same file, same order, every generation
+
+    def run(self):
+        n = 0
+        with open({docs!r}) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                row = json.loads(line)
+                self.next(doc=row["doc"], vec=row["vec"])
+                n += 1
+                if n % {commit_every} == 0:
+                    self.commit()
+
+
+docs = pw.io.python.read(DocSubject(), schema=Doc)
+docs = docs.select(
+    doc=pw.this.doc,
+    vec=pw.apply(lambda s: tuple(json.loads(s)), pw.this.vec),
+)
+queries = pw.io.jsonlines.read({queries!r}, schema=Q, mode="static")
+queries = queries.select(
+    qid=pw.this.qid,
+    qvec=pw.apply(lambda s: tuple(json.loads(s)), pw.this.qvec),
+)
+
+from pathway_tpu.stdlib.indexing import DataIndex
+from pathway_tpu.stdlib.indexing.data_index import UsearchKnn
+
+inner = UsearchKnn(
+    docs.vec, dimensions={dim}, reserved_space=4096, delta_cap={delta_cap}
+)
+di = DataIndex(docs, inner)
+reply = di.query(queries.qvec, number_of_matches={k})
+out = reply.select(
+    qid=pw.this.qid,
+    ids=pw.apply(
+        lambda ds: [d["doc"] for d in ds if d], pw.this._pw_index_reply
+    ),
+)
+pw.io.jsonlines.write(out, {output!r})
+pconf = Config.simple_config(
+    Backend.filesystem({persist!r}),
+    persistence_mode=PersistenceMode("operator_persisting"),
+)
+pw.run(
+    autocommit_duration_ms=20,
+    persistence_config=pconf,
+    monitoring_level="none",
+)
+if int(os.environ.get("PATHWAY_PROCESS_ID", "0")) == 0:
+    with open({dump!r}, "w") as f:
+        json.dump(reply._node.adapter.stats(), f)
+"""
+
+
+class IndexDrill(ClusterDrill):
+    """Live-index churn drill: exactly-once recovery from a crash
+    mid-merge.
+
+    Runs a doc-upsert + KNN-query pipeline twice over one seeded corpus
+    (base docs followed by re-upserts of random ids under new vectors,
+    flowing through the delta segment of a
+    :class:`~pathway_tpu.stdlib.indexing.segments.SegmentedIndex`):
+    a fault-free baseline, then a drill where the process hosting
+    worker 0 — the index owner — is hard-killed between a finished
+    background merge and its atomic commit
+    (:meth:`chaos.kill_worker_mid_merge`).  The supervisor restarts the
+    generation, the worker restores the checkpointed index (pre-merge
+    view) and replays only the connector tail; primary-keyed rows make
+    the replayed upserts idempotent.
+
+    Passes when the recovered index holds each doc **exactly once**
+    (index size equals the distinct id count — nothing dropped by the
+    lost merge, nothing double-applied by the replay) and the final
+    query answers reach ``recall_target`` against brute force over the
+    final (post-churn) corpus.  ``delta_cap`` stays above the per-epoch
+    batch size so churn actually flows through the delta segment and
+    background merges fire; ``kill_merge=2`` leaves merge #1 and some
+    checkpoints behind so recovery genuinely restores state.
+    """
+
+    def __init__(
+        self,
+        workdir: Any,
+        *,
+        seed: int = 0,
+        processes: int = 2,
+        n_docs: int = 64,
+        n_upserts: int = 96,
+        dim: int = 16,
+        n_queries: int = 16,
+        k: int = 5,
+        delta_cap: int = 24,
+        kill_merge: int = 2,
+        recall_target: float = 0.95,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("checkpoint_interval_s", 0.05)
+        kwargs.setdefault("epoch_max_rows", 8)
+        # the index lives on worker 0 (route_all_to_zero): kill that rank
+        super().__init__(
+            workdir,
+            seed=seed,
+            processes=processes,
+            kill_rank=0,
+            kill_epoch=1,
+            **kwargs,
+        )
+        self.n_docs = n_docs
+        self.n_upserts = n_upserts
+        self.dim = dim
+        self.n_queries = n_queries
+        self.k = k
+        self.delta_cap = delta_cap
+        self.kill_merge = kill_merge
+        self.recall_target = recall_target
+        self._final: dict[str, list[float]] = {}
+        self._queries: dict[str, list[float]] = {}
+
+    # -- pieces ---------------------------------------------------------
+    def _write_inputs(self) -> tuple[str, str]:
+        import json
+
+        import numpy as np
+
+        rng = np.random.default_rng(self.seed)
+
+        def vec() -> list[float]:
+            v = rng.standard_normal(self.dim)
+            return (v / np.linalg.norm(v)).tolist()
+
+        lines = []
+        for i in range(self.n_docs):
+            v = vec()
+            self._final[f"d{i}"] = v
+            lines.append({"doc": f"d{i}", "vec": json.dumps(v)})
+        for _ in range(self.n_upserts):
+            doc_id = f"d{int(rng.integers(self.n_docs))}"
+            v = vec()
+            self._final[doc_id] = v
+            lines.append({"doc": doc_id, "vec": json.dumps(v)})
+        docs_path = os.path.join(self.workdir, "docs.jsonl")
+        with open(docs_path, "w") as f:
+            for row in lines:
+                f.write(json.dumps(row) + "\n")
+        queries_path = os.path.join(self.workdir, "queries.jsonl")
+        with open(queries_path, "w") as f:
+            for j in range(self.n_queries):
+                v = vec()
+                self._queries[f"q{j}"] = v
+                f.write(json.dumps({"qid": f"q{j}", "qvec": json.dumps(v)}) + "\n")
+        return docs_path, queries_path
+
+    def _write_index_program(
+        self, tag: str, docs_path: str, queries_path: str
+    ) -> tuple[str, str, str]:
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        out = os.path.join(self.workdir, f"{tag}_out.jsonl")
+        dump = os.path.join(self.workdir, f"{tag}_index.json")
+        persist = os.path.join(self.workdir, f"{tag}_snap")
+        prog = os.path.join(self.workdir, f"{tag}_prog.py")
+        with open(prog, "w") as f:
+            f.write(
+                _INDEX_DRILL_PROGRAM.format(
+                    repo=repo,
+                    docs=docs_path,
+                    queries=queries_path,
+                    output=out,
+                    persist=persist,
+                    dump=dump,
+                    dim=self.dim,
+                    delta_cap=self.delta_cap,
+                    k=self.k,
+                    commit_every=self.epoch_max_rows,
+                )
+            )
+        return prog, out, dump
+
+    def _final_answers(self, path: str) -> dict[str, list]:
+        """Consolidate the query sink's diff log to its final state."""
+        import json
+
+        state: dict[str, list] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    row = json.loads(line)
+                    if row["diff"] > 0:
+                        state[row["qid"]] = row["ids"]
+                    elif state.get(row["qid"]) == row["ids"]:
+                        del state[row["qid"]]
+        return state
+
+    def _recall(self, output_path: str) -> float:
+        """Top-k recall of the sink's final answers vs brute force over
+        the final (post-churn) corpus."""
+        import numpy as np
+
+        answers = self._final_answers(output_path)
+        ids = sorted(self._final)
+        mat = np.asarray([self._final[i] for i in ids], np.float64)
+        k = min(self.k, len(ids))
+        hits, total = 0, 0
+        for qid, qv in self._queries.items():
+            scores = mat @ np.asarray(qv, np.float64)
+            gt = {ids[i] for i in np.argsort(-scores)[:k]}
+            hits += len(gt & set(answers.get(qid, ())))
+            total += k
+        return hits / max(total, 1)
+
+    # -- the drill ------------------------------------------------------
+    def run(self) -> dict[str, Any]:
+        docs_path, queries_path = self._write_inputs()
+
+        prog, base_out, base_dump = self._write_index_program(
+            "baseline", docs_path, queries_path
+        )
+        base_report = self._run_supervised(prog, {})
+        if base_report.returncode != 0:
+            raise ChaosError(
+                f"baseline index run failed: {base_report.failures}"
+            )
+
+        prog, drill_out, drill_dump = self._write_index_program(
+            "drill", docs_path, queries_path
+        )
+        t0 = _time.monotonic()
+        drill_report = self._run_supervised(
+            prog,
+            {
+                "CHAOS_KILL_RANK": str(self.kill_rank),
+                "CHAOS_KILL_MERGE": str(self.kill_merge),
+                "CHAOS_SEED": str(self.seed),
+            },
+        )
+        faulted_seconds = _time.monotonic() - t0
+
+        import json
+
+        def read_dump(path: str) -> dict:
+            if not os.path.exists(path):
+                return {}
+            with open(path) as f:
+                return json.load(f)
+
+        expected = len(self._final)
+        base_stats = read_dump(base_dump)
+        drill_stats = read_dump(drill_dump)
+        baseline_recall = self._recall(base_out)
+        recall = self._recall(drill_out)
+        exactly_once = drill_stats.get("size") == expected
+        return {
+            "ok": (
+                drill_report.returncode == 0
+                and exactly_once
+                and recall >= self.recall_target
+            ),
+            "exactly_once": exactly_once,
+            "expected_size": expected,
+            "recovered_size": drill_stats.get("size"),
+            "baseline_size": base_stats.get("size"),
+            "recall": recall,
+            "baseline_recall": baseline_recall,
+            "merges_total": drill_stats.get("merges_total", 0),
+            "baseline_merges_total": base_stats.get("merges_total", 0),
+            "restarts": drill_report.restarts,
+            "recovery_seconds": list(drill_report.recovery_seconds),
+            "faulted_seconds": faulted_seconds,
+            "returncode": drill_report.returncode,
             "failures": list(drill_report.failures),
         }
 

@@ -37,14 +37,25 @@ class VectorStoreServer:
         index_factory: InnerIndexFactory | None = None,
         reserved_space: int = 1024,
         mesh: Any = None,
+        delta_cap: int | None = None,
+        tombstone_fraction: float | None = None,
+        auto_merge: bool | None = None,
     ):
         if embedder is None and index_factory is None:
             from pathway_tpu.xpacks.llm.embedders import TPUEncoderEmbedder
 
             embedder = TPUEncoderEmbedder()
         if index_factory is None:
+            # delta_cap/tombstone_fraction/auto_merge tune the live index
+            # maintenance layer (delta segment + background merge) the
+            # built index runs under; see stdlib/indexing/segments.py
             index_factory = BruteForceKnnFactory(
-                embedder=embedder, reserved_space=reserved_space, mesh=mesh
+                embedder=embedder,
+                reserved_space=reserved_space,
+                mesh=mesh,
+                delta_cap=delta_cap,
+                tombstone_fraction=tombstone_fraction,
+                auto_merge=auto_merge,
             )
         self.docs = docs
         self.document_store = DocumentStore(

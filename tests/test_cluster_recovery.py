@@ -17,7 +17,7 @@ import time
 
 import pytest
 
-from pathway_tpu.testing.chaos import ClusterDrill, chaos
+from pathway_tpu.testing.chaos import ClusterDrill, IndexDrill, chaos
 
 _port_counter = [13000 + (os.getpid() % 500) * 16]
 
@@ -85,6 +85,35 @@ def test_kill_random_worker_4proc_output_identical(tmp_path):
     """The same property at 4 workers — more ranks to kill, more peers
     whose sockets die mid-conversation, same byte-identical bar."""
     _run_drill(tmp_path, processes=4, seed=5)
+
+
+@pytest.mark.chaos
+def test_kill_worker_mid_merge_exactly_once(tmp_path):
+    """Live-index churn drill (ISSUE 9): hard-kill the index-owning
+    worker in the window between a finished background merge and its
+    atomic commit.  The restarted worker restores the checkpointed
+    (pre-merge) index and replays the tail; the recovered index must
+    hold each doc exactly once — the lost merge dropped nothing, the
+    replay double-applied nothing — and final query answers must reach
+    recall >= 0.95 vs brute force over the post-churn corpus."""
+    drill = IndexDrill(str(tmp_path), seed=7, processes=2)
+    report = drill.run()
+    assert report["restarts"] >= 1, (
+        f"mid-merge kill never triggered a restart: {report}"
+    )
+    assert report["returncode"] == 0, (
+        f"cluster did not recover: {report['failures']}"
+    )
+    assert report["exactly_once"], (
+        f"recovered index holds {report['recovered_size']} docs, expected "
+        f"{report['expected_size']} (lost or double-applied upserts): "
+        f"{report}"
+    )
+    assert report["recall"] >= 0.95, (
+        f"recovered recall {report['recall']:.3f} < 0.95 "
+        f"(baseline {report['baseline_recall']:.3f}): {report}"
+    )
+    assert report["merges_total"] >= 1, report
 
 
 # ---------------------------------------------------------------------------

@@ -136,9 +136,15 @@ def test_hnsw_fallback_mode_matches_native(monkeypatch):
     fb = HnswIndex.__new__(HnswIndex)
     fb.dim, fb.metric, fb.M = 8, "cos", 16
     fb.ef_construction, fb.ef_search = 128, 64
+    fb.tombstone_fraction = 0.33
     fb._slot_of, fb._key_of = {}, {}
     fb._native = None
-    fb._vecs = {}
+    fb._store = {}
+    fb._hw = 0
+    fb.compactions = 0
+    import threading
+
+    fb._lock = threading.RLock()
     for idx in (native_idx, fb):
         idx.add([(i, x[i]) for i in range(8)])
     q = x[:4]
