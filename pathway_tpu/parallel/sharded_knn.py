@@ -85,11 +85,15 @@ class ShardedKnnIndex:
         # so collect() never resolves a reused slot to the wrong key
         self._inflight = 0
         self._quarantine: list[int] = []
-        # buffer generation: bumped on every realloc (_grow) so a handle
-        # dispatched against the old arrays is recognizably pre-grow —
-        # its captured buffers stay alive (no donation while in flight)
-        # and its slot->key decode is grow-stable
+        # buffer generation: bumped on every realloc (_grow and
+        # load_state_dict).  collect() branches on the generation in the
+        # handle: anything at or past _reset_version decodes against the
+        # live map (slot numbering is append-only across grows and freed
+        # slots are quarantined), while a handle from before the last
+        # load_state_dict is rejected — the slot->key map was replaced
+        # wholesale, so decoding it would silently return wrong keys.
         self._version = 0
+        self._reset_version = 0
 
     # ------------------------------------------------------------------
     def _round_capacity(self, cap: int) -> int:
@@ -395,13 +399,22 @@ class ShardedKnnIndex:
         """Resolve a :meth:`dispatch` handle to [[(key, score), ...], ...].
 
         Valid across a ``_grow``: the handle's computation captured the
-        dispatch-time buffers (generation recorded in the handle), slot
-        numbering is grow-stable, and freed slots stay quarantined while
-        any handle is outstanding — so a pre-grow handle decodes to
-        exactly the keys that were live when it was dispatched."""
-        out, nq, k, _version = handle
+        dispatch-time buffers, slot numbering is grow-stable, and freed
+        slots stay quarantined while any handle is outstanding — so a
+        pre-grow handle decodes to exactly the keys that were live when
+        it was dispatched.  NOT valid across ``load_state_dict``: that
+        replaces the slot->key map wholesale, so the generation recorded
+        in the handle gates the decode and a pre-restore handle raises
+        instead of resolving to arbitrary wrong keys."""
+        out, nq, k, version = handle
         if out is None:
             return [[] for _ in range(nq)]
+        if version < self._reset_version:
+            raise RuntimeError(
+                "stale dispatch handle: the index was restored via "
+                "load_state_dict after this dispatch; slot numbering is "
+                "only stable across capacity grows, not restores"
+            )
         self._inflight = max(0, self._inflight - 1)
         if self._inflight == 0 and self._quarantine:
             self._free.extend(self._quarantine)
@@ -465,3 +478,10 @@ class ShardedKnnIndex:
         self._key_of = {s: k for k, s in self._slot_of.items()}
         self._cursor = state["cursor"]
         self._free = list(state["free"])
+        # outstanding handles reference the pre-restore slot space:
+        # invalidate them (collect() rejects their generation) and reset
+        # the in-flight bookkeeping they would otherwise leak into
+        self._version += 1
+        self._reset_version = self._version
+        self._inflight = 0
+        self._quarantine = []

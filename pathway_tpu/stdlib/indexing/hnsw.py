@@ -41,6 +41,12 @@ class HnswIndex:
 
     # segment merges rebuild a fresh graph rather than editing in place
     merge_strategy = "rebuild"
+    # concurrent search/search and search/add are safe: the native graph
+    # serializes on its own mutex (GIL released), compact/load swap the
+    # (handle, key map) pair atomically against the snapshot below, and
+    # the slot decode tolerates concurrent remove()s — so SegmentedIndex
+    # lets queries hit this main without serializing on _main_mutex
+    concurrent_search = True
 
     def __init__(
         self,
@@ -199,19 +205,23 @@ class HnswIndex:
         out: list[list[tuple[Any, float]]] = []
         for ids, dists in raw:
             # native distance is -dot (ip) or l2sq; both negate into the
-            # higher-is-closer score convention
-            out.append(
-                [
-                    (key_of[s], -d)
-                    for s, d in zip(ids, dists)
-                    if s in key_of
-                ]
-            )
+            # higher-is-closer score convention.  remove() pops entries
+            # from the shared key map in place, so decode with .get: a
+            # slot deleted mid-search drops out instead of raising.
+            row: list[tuple[Any, float]] = []
+            for s, d in zip(ids, dists):
+                key = key_of.get(s)
+                if key is not None:
+                    row.append((key, -d))
+            out.append(row)
         return out
 
     def _search_exact(self, q: np.ndarray, k: int) -> list[list[tuple[Any, float]]]:
-        keys = list(self._store.keys())
-        mat = np.stack([self._store[key] for key in keys])
+        with self._lock:  # consistent snapshot vs concurrent add/remove
+            keys = list(self._store.keys())
+            if not keys:
+                return [[] for _ in range(q.shape[0])]
+            mat = np.stack([self._store[key] for key in keys])
         if self.metric == "l2sq":
             scores = -(
                 ((q[:, None, :] - mat[None, :, :]) ** 2).sum(-1)

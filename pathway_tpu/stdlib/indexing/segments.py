@@ -19,13 +19,21 @@ with
   "rebuild"``) or by applying remove+upsert in place (device slabs:
   ``"inplace"``).
 
-Consistency: every public method takes ``self._lock``; the merge thread
-holds it only to *freeze* and to *commit* (rebuilds run unlocked), so a
-query — and a checkpoint's :meth:`state_dict` — observes either the
-pre-merge or the post-merge segmentation, never a torn mix.  A merge
-interrupted by a crash loses only the merge work: the checkpointed state
-is the pre-merge view, and a failed in-process merge rolls the frozen
-delta/tombstones back into the live segment.
+Consistency: segment bookkeeping (delta, tombstones, freeze, commit)
+happens under ``self._lock``; a query snapshots the delta view and mask
+under it, then runs the main-segment search and the delta scan OFF the
+lock — so queries don't serialize on the segment and updates or
+checkpoints never queue behind a graph walk or device dispatch.
+In-place main mutation (bulk load, inplace merge, restore) excludes
+searchers via a second ``_main_mutex``; rebuild merges swap ``main``
+atomically, which the snapshot tolerates.  A query — and a checkpoint's
+:meth:`state_dict` — therefore observes either the pre-merge or the
+post-merge segmentation, never a torn mix; a key deleted mid-merge is
+filtered from the frozen delta everywhere (search, checkpoint, merge
+fold-in, rollback), so a delete is never undone by merge machinery.  A
+merge interrupted by a crash loses only the merge work: the
+checkpointed state is the pre-merge view, and a failed in-process merge
+rolls the frozen delta/tombstones back into the live segment.
 
 Tuning knobs (constructor args, env defaults):
 
@@ -101,6 +109,10 @@ class SegmentedIndex:
             else _env_int("PATHWAY_INDEX_AUTO_MERGE", 1) != 0
         )
         self._lock = threading.RLock()
+        # excludes in-place main mutation (bulk load, inplace merge,
+        # restore) from searchers, which run main.search off `_lock`;
+        # always acquired INSIDE `_lock`, never the other way around
+        self._main_mutex = threading.Lock()
         # live segment membership (authoritative: main ∪ delta − tombs)
         self._keys: set[Any] = set(self._main_keys())
         self._delta: dict[Any, np.ndarray] = {}
@@ -159,7 +171,8 @@ class SegmentedIndex:
                 and not self._tombs
                 and not self._merging
             ):
-                self.main.add(list(items))
+                with self._main_mutex:
+                    self.main.add(list(items))
                 self._keys = set(self._main_keys())
                 return
             for key, vec in items:
@@ -210,33 +223,59 @@ class SegmentedIndex:
             if not self._keys:
                 return [[] for _ in range(queries.shape[0])]
             k = min(k, len(self._keys))
-            # delta view: frozen entries shadowed by live ones
-            delta = (
-                {**self._frozen, **self._delta}
-                if self._frozen
-                else dict(self._delta)
-            )
+            delta = self._delta_view_locked()
             # main results to drop: deleted keys + keys shadowed by delta
             mask = set(delta)
             mask.update(self._tombs)
             mask.update(self._frozen_tombs)
-            main_hits: list[list[tuple[Any, float]]]
-            n_main = len(self.main)
-            if n_main:
-                fetch = min(k + len(mask), n_main)
-                main_hits = self.main.search(queries, fetch)
+            main = self.main
+            n_main = len(main)
+        # The main-segment search and the delta scan run OFF the segment
+        # lock, so upserts, deletes and checkpoints never queue behind a
+        # graph walk or device dispatch, and queries don't serialize on
+        # the segment.  This is safe because ``self.main`` only changes
+        # by atomic pointer swap at a rebuild commit (the snapshot above
+        # tolerates that), in-place main mutation (bulk load, inplace
+        # merge, restore) excludes searchers via ``_main_mutex``, and
+        # every key such a mutation touches is covered by the
+        # snapshotted delta/mask — either the pre- or post-merge main
+        # yields the same merged result.
+        main_hits: list[list[tuple[Any, float]]]
+        if n_main:
+            fetch = min(k + len(mask), n_main)
+            if getattr(main, "concurrent_search", False):
+                main_hits = main.search(queries, fetch)
             else:
-                main_hits = [[] for _ in range(queries.shape[0])]
-            out: list[list[tuple[Any, float]]] = []
-            delta_hits = self._search_delta(queries, delta, k)
-            for qi in range(queries.shape[0]):
-                merged = [
-                    (key, s) for key, s in main_hits[qi] if key not in mask
-                ]
-                merged.extend(delta_hits[qi])
-                merged.sort(key=lambda kv: (-kv[1], str(kv[0])))
-                out.append(merged[:k])
-            return out
+                with self._main_mutex:
+                    main_hits = main.search(queries, fetch)
+        else:
+            main_hits = [[] for _ in range(queries.shape[0])]
+        out: list[list[tuple[Any, float]]] = []
+        delta_hits = self._search_delta(queries, delta, k)
+        for qi in range(queries.shape[0]):
+            merged = [
+                (key, s) for key, s in main_hits[qi] if key not in mask
+            ]
+            merged.extend(delta_hits[qi])
+            merged.sort(key=lambda kv: (-kv[1], str(kv[0])))
+            out.append(merged[:k])
+        return out
+
+    def _delta_view_locked(self) -> dict[Any, np.ndarray]:
+        """Combined delta: frozen entries shadowed by live ones.  A key
+        deleted AFTER the freeze sits in ``_tombs`` and its frozen copy
+        must not resurface through this view (the live ``_delta`` is
+        always disjoint from ``_tombs``, so the filter only ever drops
+        stale frozen entries)."""
+        if not self._frozen:
+            return dict(self._delta)
+        view = {
+            key: vec
+            for key, vec in self._frozen.items()
+            if key not in self._tombs
+        }
+        view.update(self._delta)
+        return view
 
     def _search_delta(
         self, queries: np.ndarray, delta: dict[Any, np.ndarray], k: int
@@ -307,6 +346,13 @@ class SegmentedIndex:
                 self.merge_failures += 1
                 frozen, self._frozen = self._frozen, {}
                 ftombs, self._frozen_tombs = self._frozen_tombs, set()
+                # keys deleted after the freeze stay deleted: their
+                # frozen copies must not ride the rollback back to life
+                frozen = {
+                    key: vec
+                    for key, vec in frozen.items()
+                    if key not in self._tombs
+                }
                 frozen.update(self._delta)  # post-freeze upserts win
                 self._delta = frozen
                 self._tombs |= {t for t in ftombs if t not in self._delta}
@@ -329,16 +375,33 @@ class SegmentedIndex:
         except Exception:  # noqa: BLE001
             pass
 
+    def _frozen_survivors_locked(self) -> dict[Any, np.ndarray]:
+        """Frozen-delta entries that still belong in main: a key deleted
+        after the freeze (now in ``_tombs``) must not be folded back in,
+        or the delete would be undone once its tombstone is discarded."""
+        return {
+            key: vec
+            for key, vec in self._frozen.items()
+            if key not in self._tombs and key not in self._frozen_tombs
+        }
+
     def _merge_rebuild(self) -> None:
         """Build a fresh main from survivors + frozen delta off-lock,
         then pointer-swap.  Doubles as compaction for graph indexes."""
         old = self.main
+        with self._lock:
+            # `_frozen`/`_frozen_tombs` are only touched by this merge,
+            # but `_tombs` absorbs concurrent deletes — snapshot the
+            # survivor set under the lock.  A delete landing after this
+            # snapshot leaves its key in the new main AND in `_tombs`:
+            # still masked from every query, reclaimed next merge.
+            frozen = self._frozen_survivors_locked()
+            drop = set(self._frozen_tombs) | set(self._frozen)
         keys, mat = old.export()
-        drop = set(self._frozen_tombs) | set(self._frozen)
         new = old.fresh()
         survivors = [i for i, key in enumerate(keys) if key not in drop]
         items: list[tuple[Any, Any]] = [(keys[i], mat[i]) for i in survivors]
-        items.extend(self._frozen.items())
+        items.extend(frozen.items())
         for i in range(0, len(items), 4096):
             new.add(items[i : i + 4096])
         with self._lock:
@@ -349,14 +412,18 @@ class SegmentedIndex:
     def _merge_inplace(self) -> None:
         """Apply frozen tombstones + delta to the device slab.  The lock
         is held across remove+add: both are cheap host-side dispatches,
-        and holding it keeps a concurrent query from seeing the
-        removed-but-not-yet-upserted gap."""
+        and holding it keeps a concurrent checkpoint from seeing the
+        removed-but-not-yet-upserted gap (searchers are excluded by
+        ``_main_mutex`` and their snapshotted delta/mask covers every
+        key touched here)."""
         with self._lock:
             dead = [t for t in self._frozen_tombs if self._has_in_main(t)]
-            if dead:
-                self.main.remove(dead)
-            if self._frozen:
-                self.main.add(list(self._frozen.items()))
+            frozen = self._frozen_survivors_locked()
+            with self._main_mutex:
+                if dead:
+                    self.main.remove(dead)
+                if frozen:
+                    self.main.add(list(frozen.items()))
             self._pre_commit()
             self._commit_locked()
 
@@ -368,7 +435,12 @@ class SegmentedIndex:
         folded back into the delta) — a crash mid-merge restores cleanly
         and the merge simply re-runs after replay."""
         with self._lock:
-            delta = {**self._frozen, **self._delta}
+            # the tombstone-filtered view: a key deleted after the
+            # freeze must serialize as deleted, not in delta_keys AND
+            # tombstones at once (loading such a state, then merging,
+            # would re-insert the frozen vector while discarding the
+            # tombstone — permanently resurrecting the deleted doc)
+            delta = self._delta_view_locked()
             tombs = set(self._tombs) | {
                 t for t in self._frozen_tombs if t not in delta
             }
@@ -386,12 +458,18 @@ class SegmentedIndex:
 
     def load_state_dict(self, state: dict) -> None:
         with self._lock:
-            self.main.load_state_dict(state["main"])
+            with self._main_mutex:
+                self.main.load_state_dict(state["main"])
             vecs = np.asarray(state["delta_vectors"], np.float32)
+            tombs = set(state["tombstones"])
+            # a checkpoint from before the delta-view fix could carry a
+            # key in both delta_keys and tombstones; the delete wins
             self._delta = {
-                key: vecs[i] for i, key in enumerate(state["delta_keys"])
+                key: vecs[i]
+                for i, key in enumerate(state["delta_keys"])
+                if key not in tombs
             }
-            self._tombs = set(state["tombstones"])
+            self._tombs = tombs
             self._frozen = {}
             self._frozen_tombs = set()
             self._merging = False

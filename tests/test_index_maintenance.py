@@ -13,6 +13,8 @@ dispatch handles surviving a capacity grow."""
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -272,6 +274,244 @@ def test_segmented_upsert_during_merge_wins_over_frozen():
     assert not seg._delta
     (res,) = seg.search(new[None, :], 1)
     assert res[0][0] == "k" and res[0][1] > 0.99, res
+
+
+# ---------------------------------------------------------------------------
+# deletes racing a merge: the frozen delta must never resurrect them
+
+
+@pytest.mark.parametrize("kind", ["hnsw", "sharded"])
+def test_segmented_remove_frozen_key_mid_merge(kind):
+    """A key whose latest value lives in the FROZEN delta, deleted while
+    the merge is in flight, must be invisible for the whole merge window
+    (search), must serialize as deleted (a checkpoint taken in the
+    window restores without it), and must stay gone after the commit and
+    after every later merge — the exactly-once guarantee."""
+    rng = np.random.default_rng(11)
+    seg = SegmentedIndex(_factory(kind), delta_cap=8, auto_merge=False)
+    x = _unit(rng, 48)
+    seg.add([(f"m{i}", x[i]) for i in range(32)])  # bulk -> main
+    seg.add([("victim", x[40]), ("d0", x[41]), ("d1", x[42])])  # delta
+
+    captured = {}
+
+    def in_window():
+        # the merge has frozen the delta but not committed: delete the
+        # frozen-delta key NOW (the re-entrant lock admits us)
+        seg.remove(["victim"])
+        (hits,) = seg.search(x[40][None, :], 8)
+        captured["mid_hits"] = {key for key, _ in hits}
+        captured["mid_state"] = seg.state_dict()
+
+    seg._pre_commit = in_window
+    seg.merge(wait=True)
+    del seg._pre_commit
+
+    # invisible inside the merge window, in search AND in the snapshot
+    assert "victim" not in captured["mid_hits"]
+    mid = captured["mid_state"]
+    assert "victim" not in set(mid["delta_keys"]), (
+        "mid-merge checkpoint serialized the deleted key's frozen copy"
+    )
+    # gone after the commit
+    assert "victim" not in seg
+    (hits,) = seg.search(x[40][None, :], 8)
+    assert "victim" not in {key for key, _ in hits}
+    # the NEXT merge (which retires the tombstone) must not fold the
+    # frozen vector back into main — the review's resurrection path
+    seg.merge(wait=True)
+    assert "victim" not in seg and "victim" not in set(seg.keys())
+    (hits,) = seg.search(x[40][None, :], 8)
+    assert "victim" not in {key for key, _ in hits}
+    assert {"d0", "d1"} <= set(seg.keys())
+
+    # a checkpoint taken in the window restores WITHOUT the key, and
+    # merging the restored index does not resurrect it either
+    restored = SegmentedIndex(_factory(kind), delta_cap=8, auto_merge=False)
+    restored.load_state_dict(mid)
+    assert "victim" not in restored
+    (hits,) = restored.search(x[40][None, :], 8)
+    assert "victim" not in {key for key, _ in hits}
+    restored.merge(wait=True)
+    assert "victim" not in restored and "victim" not in set(restored.keys())
+    (hits,) = restored.search(x[40][None, :], 8)
+    assert "victim" not in {key for key, _ in hits}
+    assert set(restored.keys()) == set(seg.keys())
+
+
+def test_segmented_remove_between_freeze_and_rebuild_fold():
+    """A delete landing in the instant between the freeze and the
+    rebuild reading the frozen delta: the rebuild must not fold the
+    deleted key into the new main."""
+    holder: dict = {}
+
+    class Sneaky(HnswIndex):
+        @property
+        def merge_strategy(self):  # read by _run_merge right after freeze
+            seg = holder.get("seg")
+            if (
+                seg is not None
+                and "victim" in seg._frozen
+                and "victim" in seg._keys
+            ):
+                seg.remove(["victim"])
+            return "rebuild"
+
+    rng = np.random.default_rng(12)
+    seg = SegmentedIndex(Sneaky(D, metric="cos"), delta_cap=4, auto_merge=False)
+    holder["seg"] = seg
+    x = _unit(rng, 8)
+    # bulk load keeps the Sneaky instance as main (a rebuild would swap
+    # in a plain HnswIndex via fresh() and disarm the trigger)
+    seg.add([(f"m{i}", x[i]) for i in range(4)])
+    assert len(seg.main) == 4 and isinstance(seg.main, Sneaky)
+    seg.add([("victim", x[6]), ("d9", x[7])])
+    seg.merge(wait=True)  # property deletes victim post-freeze
+    assert "victim" not in seg
+    assert "victim" not in {k for k in seg.main.keys()}, (
+        "rebuild folded a post-freeze-deleted frozen key into main"
+    )
+    (hits,) = seg.search(x[6][None, :], 8)
+    assert "victim" not in {key for key, _ in hits}
+    seg.merge(wait=True)
+    assert "victim" not in seg
+
+
+def test_segmented_failed_merge_rollback_preserves_deletes():
+    """A delete issued while a merge is in flight must survive that
+    merge FAILING: the rollback folds the frozen delta back into the
+    live segment but must not revive the deleted keys."""
+    rng = np.random.default_rng(13)
+    seg = SegmentedIndex(HnswIndex(D, metric="cos"), delta_cap=8, auto_merge=False)
+    x = _unit(rng, 40)
+    seg.add([(f"m{i}", x[i]) for i in range(16)])  # bulk -> main
+    seg.add([("victim", x[20]), ("d0", x[21]), ("d1", x[22])])
+
+    def boom():
+        # between freeze and commit: delete a frozen-delta key and a
+        # main key, then die
+        seg.remove(["victim", "m1"])
+        raise RuntimeError("rebuild died")
+
+    seg.main.fresh = boom
+    with pytest.raises(RuntimeError, match="rebuild died"):
+        seg.merge(wait=True)
+    assert seg.merge_failures == 1 and not seg._merging
+    assert "victim" not in seg and "m1" not in seg
+    assert "victim" not in seg._delta, "rollback revived a deleted key"
+    assert {"d0", "d1"} <= set(seg._delta)
+    (hits,) = seg.search(x[20][None, :], 16)
+    found = {key for key, _ in hits}
+    assert "victim" not in found and "m1" not in found
+
+    del seg.main.fresh  # the next merge succeeds and retires tombstones
+    seg.merge(wait=True)
+    assert not seg._tombs and not seg._delta
+    assert "victim" not in seg and "m1" not in seg
+    (hits,) = seg.search(x[20][None, :], 16)
+    found = {key for key, _ in hits}
+    assert "victim" not in found and "m1" not in found
+    assert {"d0", "d1"} <= found
+
+
+def test_segmented_load_state_dict_delete_wins_on_conflict():
+    """Checkpoints written before the delta-view fix can carry a key in
+    both delta_keys and tombstones; loading one must treat the key as
+    deleted."""
+    rng = np.random.default_rng(14)
+    seg = SegmentedIndex(HnswIndex(D, metric="cos"), delta_cap=8, auto_merge=False)
+    x = _unit(rng, 4)
+    seg.add([("a", x[0]), ("b", x[1])])
+    state = seg.state_dict()
+    state["tombstones"] = list(state["tombstones"]) + ["b"]  # conflict
+    fresh = SegmentedIndex(HnswIndex(D, metric="cos"), delta_cap=8, auto_merge=False)
+    fresh.load_state_dict(state)
+    assert "a" in fresh and "b" not in fresh
+    (hits,) = fresh.search(x[1][None, :], 4)
+    assert "b" not in {key for key, _ in hits}
+    fresh.merge(wait=True)
+    assert "b" not in fresh
+
+
+# ---------------------------------------------------------------------------
+# concurrency: queries off the segment lock vs live updates and merges
+
+
+@pytest.mark.parametrize("kind", ["hnsw", "sharded"])
+def test_segmented_concurrent_queries_and_updates(kind):
+    """Searcher threads hammer the index while the main thread upserts,
+    deletes and auto-merges (background maintenance thread): no
+    exception may escape, and the final membership must track the
+    reference exactly.  Exercises the off-lock main search, _main_mutex
+    exclusion around in-place merges, and the defensive slot decode."""
+    seg = SegmentedIndex(_factory(kind), delta_cap=16, auto_merge=True)
+    rng = np.random.default_rng(15)
+    ref: dict[str, np.ndarray] = {}
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def searcher(seed):
+        srng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                seg.search(_unit(srng, 2), 3)
+        except BaseException as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=searcher, args=(100 + i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        next_id = 0
+        for step in range(30):
+            items = []
+            for _ in range(6):
+                key = f"k{next_id}"
+                next_id += 1
+                vec = _unit(rng)[0]
+                items.append((key, vec))
+                ref[key] = vec
+            seg.add(items)
+            if ref and step % 3 == 2:
+                victims = [
+                    str(v)
+                    for v in rng.choice(
+                        sorted(ref), size=min(4, len(ref)), replace=False
+                    )
+                ]
+                seg.remove(victims + ["absent"])
+                for v in victims:
+                    del ref[v]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        seg.close()
+    assert not errors, f"concurrent search raised: {errors[:3]}"
+    assert set(seg.keys()) == set(ref)
+    q = _unit(rng, 8)
+    r = _recall(seg, ref, q)
+    assert r >= 0.95, f"post-churn recall {r:.3f} < 0.95"
+
+
+def test_sharded_handle_across_load_state_dict_raises():
+    """load_state_dict replaces the slot->key map wholesale, so a
+    dispatch handle from before the restore must be rejected (its
+    generation gates the decode) instead of resolving to wrong keys."""
+    rng = np.random.default_rng(16)
+    idx = ShardedKnnIndex(D, metric="cos", capacity=128)
+    x = _unit(rng, 8)
+    idx.add_batch([f"a{i}" for i in range(8)], x)
+    state = idx.state_dict()
+    handle = idx.dispatch(x[:1], 1)
+    idx.load_state_dict(state)
+    assert idx._inflight == 0 and not idx._quarantine
+    with pytest.raises(RuntimeError, match="stale dispatch handle"):
+        idx.collect(handle)
+    # post-restore dispatches decode against the fresh map
+    rows = idx.collect(idx.dispatch(x[:1], 1))
+    assert rows[0][0][0] == "a0"
+    assert idx._inflight == 0
 
 
 # ---------------------------------------------------------------------------
