@@ -999,6 +999,104 @@ def bench_index_churn(extra: dict) -> None:
         )
 
 
+def bench_rag_serving(extra: dict) -> None:
+    """Multi-tenant RAG serving (``pathway_tpu/serving/``, ISSUE 10):
+    per-tenant-class p50/p99 vs offered load, measured open-loop under
+    the paper's live regime — an interactive tenant querying while a
+    rate-capped batch tenant mixes queries with index upserts, so every
+    load point exercises admission shed, SLO-class scheduling, and
+    lookahead retrieval against a churning index at once."""
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.serving import LoadGen, RagServingApp, TenantLoad, TenantPolicy
+
+    points = (15.0, 60.0, 240.0) if SMOKE else (20.0, 80.0, 320.0)
+    duration = 1.2 if SMOKE else 2.5
+    n_docs = 48
+    rng = np.random.default_rng(29)
+    vocab = ["solar", "merge", "slab", "tail", "bucket", "chunk", "probe", "lane"]
+    docs = [
+        (f"doc{i}", " ".join(rng.choice(vocab) for _ in range(30)))
+        for i in range(n_docs)
+    ]
+    rows = []
+    for qi, qps in enumerate(points):
+        G.clear()
+        pols = {
+            # interactive tenant provisioned above its offer: its tail
+            # is the scheduler's to hold, not admission's to hide
+            "live": TenantPolicy(
+                "interactive",
+                rate_per_s=max(qps * 4, 50.0),
+                burst=max(qps, 16.0),
+                queue_cap=256,
+            ),
+            # batch tenant capped at half its offer: shed must grow
+            # with load instead of queueing into the interactive tail
+            "bulk": TenantPolicy(
+                "batch", rate_per_s=max(qps / 2, 2.0), burst=8, queue_cap=16
+            ),
+        }
+        app = RagServingApp(pols, embed_dim=64, delta_cap=64, autocommit_ms=10)
+        app.start()
+        try:
+            for doc_id, text in docs:
+                app.upsert(doc_id, text, tenant="live")
+            if not app.wait_indexed(n_docs, timeout=30.0):
+                raise RuntimeError(f"ingest stalled: {app.stats()}")
+            for _ in range(3):  # warm the embed/search/generate lanes
+                app.answer("bucket probe lane", tenant="live", timeout=30)
+            rep = LoadGen(
+                app,
+                [
+                    TenantLoad("live", qps=qps),
+                    TenantLoad("bulk", qps=qps, write_fraction=0.4),
+                ],
+                duration_s=duration,
+                seed=13 + qi,
+            ).run()
+            cls = rep["classes"]
+            cos = app.coscheduler.stats()
+            rows.append(
+                {
+                    "offered_qps_per_tenant": qps,
+                    "interactive": cls.get("interactive", {}),
+                    "batch": cls.get("batch", {}),
+                    "lookahead_overlap_ms_mean": round(cos["overlap_ms_mean"], 4),
+                    "index_merges": app.index.stats()["merges_total"],
+                }
+            )
+            inter = cls["interactive"]
+            log(
+                f"rag serving @ {qps:g} qps/tenant: interactive "
+                f"p50 {inter['p50_ms']:.2f}ms p99 {inter['p99_ms']:.2f}ms "
+                f"shed {inter['shed']}; batch shed {cls['batch']['shed']} "
+                f"writes {cls['batch']['writes']}"
+            )
+        finally:
+            app.close()
+    extra["rag_serving_points"] = rows
+    low, high = rows[0], rows[-1]
+    extra["rag_serving_interactive_p50_ms_low_load"] = low["interactive"]["p50_ms"]
+    extra["rag_serving_interactive_p99_ms_low_load"] = low["interactive"]["p99_ms"]
+    extra["rag_serving_interactive_p99_ms_high_load"] = high["interactive"]["p99_ms"]
+    extra["rag_serving_interactive_shed_total"] = sum(
+        r["interactive"]["shed"] for r in rows
+    )
+    extra["rag_serving_batch_shed_high_load"] = high["batch"]["shed"]
+    extra["rag_serving_lookahead_overlap_ms_mean"] = rows[-1][
+        "lookahead_overlap_ms_mean"
+    ]
+    if SMOKE:
+        p50 = max(low["interactive"]["p50_ms"], 0.05)
+        p99 = low["interactive"]["p99_ms"]
+        if p99 > 5.0 * p50:
+            raise RuntimeError(
+                f"interactive tail blew past the SLO at LOW load: "
+                f"p99 {p99:.2f}ms > 5x p50 {p50:.2f}ms — the class "
+                "partition is not holding even without contention"
+            )
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -1037,6 +1135,7 @@ def main() -> None:
         (bench_checkpoint_overhead, "checkpoint_overhead"),
         (bench_cluster_recovery, "cluster_recovery"),
         (bench_index_churn, "index_churn"),
+        (bench_rag_serving, "rag_serving"),
     ]
     if not SMOKE:
         sections += [

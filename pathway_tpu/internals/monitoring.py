@@ -10,9 +10,11 @@ from dataclasses import dataclass, field
 from typing import Any
 
 __all__ = [
+    "LabeledLatencyProbe",
     "LatencyProbe",
     "MonitoringLevel",
     "ProberStats",
+    "SERVING_STAGES",
     "STAGES",
     "collect_stats",
     "index_stats",
@@ -27,6 +29,21 @@ __all__ = [
 #:   sink     — epoch cut -> update delivered to an output node
 #:   e2e      — earliest enqueue in the epoch -> sink delivery
 STAGES = ("ingest", "cut", "process", "exchange", "sink", "e2e")
+
+#: serving-layer stages instrumented per tenant class (ISSUE 10): the
+#: SLO scheduler's queue wait, then the co-scheduled pipeline stages
+#:   serve_sched    — submit -> lane dispatch (weighted-fair queue wait)
+#:   serve_embed    — submit -> query embedding done
+#:   serve_retrieve — embedding done -> index hits resolved
+#:   serve_generate — hits resolved -> answer produced
+#:   serve_e2e      — submit -> answer delivered
+SERVING_STAGES = (
+    "serve_sched",
+    "serve_embed",
+    "serve_retrieve",
+    "serve_generate",
+    "serve_e2e",
+)
 
 _LAT_BUCKETS = 488  # mirrors kLatBuckets in native/pathway_native.cpp
 
@@ -154,6 +171,75 @@ class LatencyProbe:
         return out
 
 
+class LabeledLatencyProbe:
+    """Latency histograms keyed by ``(stage, label)`` — the serving
+    layer's per-tenant-class variant of :class:`LatencyProbe`.
+
+    Histograms are created on first record per key (tenant classes are
+    not known up front) and share the native/py histogram substrate:
+    recording is one lock-free bucket increment, snapshots never reset,
+    so concurrent recording at worst lands a sample in the next read."""
+
+    def __init__(self, stages: tuple[str, ...] = SERVING_STAGES):
+        self._stages = tuple(stages)
+        native = None
+        try:
+            from pathway_tpu.internals import native as _native_mod
+
+            native = _native_mod.load()
+        except Exception:
+            native = None
+        if native is not None and hasattr(native, "hist_new"):
+            self._native = native
+            self._new = native.hist_new
+            self.now_ns = native.monotonic_ns
+            self._rec = native.hist_record
+        else:
+            self._native = None
+            self._new = _PyHist
+            self.now_ns = time.monotonic_ns
+            self._rec = lambda h, ns: h.record(ns)
+        self._h: dict[tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+
+    def _hist(self, stage: str, label: str) -> Any:
+        key = (stage, label)
+        h = self._h.get(key)
+        if h is None:
+            with self._lock:
+                h = self._h.get(key)
+                if h is None:
+                    h = self._h[key] = self._new()
+        return h
+
+    def record(self, stage: str, label: str, ns: int) -> None:
+        self._rec(self._hist(stage, label), ns)
+
+    def record_since(self, stage: str, label: str, t0_ns: int) -> None:
+        self._rec(self._hist(stage, label), self.now_ns() - t0_ns)
+
+    def snapshot(self) -> dict[str, dict[str, dict]]:
+        """``{stage: {label: {count, p50_ms, p95_ms, p99_ms, max_ms,
+        mean_ms}}}`` for every key with at least one sample."""
+        with self._lock:
+            keys = list(self._h.items())
+        out: dict[str, dict[str, dict]] = {}
+        for (stage, label), h in keys:
+            d = self._native.hist_snapshot(h) if self._native else h.snapshot()
+            n = d["count"]
+            if not n:
+                continue
+            out.setdefault(stage, {})[label] = {
+                "count": n,
+                "p50_ms": d["p50_ns"] / 1e6,
+                "p95_ms": d["p95_ns"] / 1e6,
+                "p99_ms": d["p99_ns"] / 1e6,
+                "max_ms": d["max_ns"] / 1e6,
+                "mean_ms": d["sum_ns"] / n / 1e6,
+            }
+        return out
+
+
 class MonitoringLevel:
     NONE = "none"
     IN_OUT = "in_out"
@@ -194,6 +280,11 @@ class ProberStats:
     #: count, wall_at}; empty when persistence is off) plus the cluster
     #: supervisor's restart generation under "worker_restarts"
     checkpoint: dict[str, Any] = field(default_factory=dict)
+    #: serving-layer snapshot (pathway_tpu.serving.serving_snapshot():
+    #: admission counters per tenant class, scheduler lane stats,
+    #: co-scheduler overlap, per-(stage, tenant_class) latency); empty
+    #: when no serving component is live in this process
+    serving: dict[str, Any] = field(default_factory=dict)
 
 
 def collect_stats(sched: Any) -> ProberStats:
@@ -231,7 +322,24 @@ def collect_stats(sched: Any) -> ProberStats:
         latency=latency_stats(sched),
         analysis=dict(getattr(sched, "analysis_findings", {}) or {}),
         checkpoint=checkpoint_stats(sched),
+        serving=serving_stats(),
     )
+
+
+def serving_stats() -> dict[str, Any]:
+    """Process-wide serving-layer snapshot — admission/scheduler/latency
+    aggregates from ``pathway_tpu.serving``.  Deliberately keyed off
+    ``sys.modules`` so a process that never imported the serving layer
+    pays nothing for this on every scrape."""
+    import sys
+
+    mod = sys.modules.get("pathway_tpu.serving")
+    if mod is None:
+        return {}
+    try:
+        return mod.serving_snapshot()
+    except Exception:
+        return {}
 
 
 def checkpoint_stats(sched: Any) -> dict[str, Any]:

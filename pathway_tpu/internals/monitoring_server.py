@@ -164,6 +164,49 @@ def _metrics_text(sched: Any) -> str:
         f"pathway_tpu_worker_restarts_total "
         f"{int(getattr(sched, 'worker_restarts', 0) or 0)}"
     )
+    # multi-tenant serving layer (admission + SLO scheduling, ISSUE 10):
+    # admitted/shed counters per tenant class, and the serving stages'
+    # latency quantiles carrying the tenant_class label.  The engine
+    # stage lines above stay label-free — serving emits ADDITIONAL
+    # labeled series, so existing dashboards keep parsing.
+    srv = _serving_snapshot()
+    adm = srv.get("admission", {})
+    if adm:
+        lines.append("# TYPE pathway_tpu_serving_admitted_total counter")
+        lines.append("# TYPE pathway_tpu_serving_shed_total counter")
+        lines.append("# TYPE pathway_tpu_serving_inflight gauge")
+        for cls, n in sorted(adm.get("admitted_total", {}).items()):
+            label = str(cls).replace('"', "'")
+            lines.append(
+                f'pathway_tpu_serving_admitted_total{{tenant_class="{label}"}} {n}'
+            )
+        for cls, n in sorted(adm.get("shed_total", {}).items()):
+            label = str(cls).replace('"', "'")
+            lines.append(
+                f'pathway_tpu_serving_shed_total{{tenant_class="{label}"}} {n}'
+            )
+        for cls, n in sorted(adm.get("inflight", {}).items()):
+            label = str(cls).replace('"', "'")
+            lines.append(
+                f'pathway_tpu_serving_inflight{{tenant_class="{label}"}} {n}'
+            )
+    srv_lat = srv.get("latency", {})
+    if srv_lat:
+        lines.append("# TYPE pathway_tpu_stage_latency_ms gauge")
+        lines.append("# TYPE pathway_tpu_stage_latency_count gauge")
+        for stage, by_class in sorted(srv_lat.items()):
+            for cls, d in sorted(by_class.items()):
+                label = str(cls).replace('"', "'")
+                for qk in ("p50", "p95", "p99", "max"):
+                    lines.append(
+                        f'pathway_tpu_stage_latency_ms{{stage="{stage}",'
+                        f'tenant_class="{label}",quantile="{qk}"}} '
+                        f"{d[qk + '_ms']:.4f}"
+                    )
+                lines.append(
+                    f'pathway_tpu_stage_latency_count{{stage="{stage}",'
+                    f'tenant_class="{label}"}} {d["count"]}'
+                )
     return "\n".join(lines) + "\n# EOF\n"
 
 
@@ -183,6 +226,12 @@ def _index_snapshot(sched: Any) -> dict[str, Any]:
     from pathway_tpu.internals.monitoring import index_stats
 
     return index_stats(sched)
+
+
+def _serving_snapshot() -> dict[str, Any]:
+    from pathway_tpu.internals.monitoring import serving_stats
+
+    return serving_stats()
 
 
 def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
@@ -221,6 +270,10 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         # live index maintenance per index operator:
                         # delta/tombstones/merges (segments.py)
                         "index": _index_snapshot(sched),
+                        # multi-tenant serving layer: admission counters
+                        # per tenant class, scheduler lane stats, and
+                        # per-(stage, tenant_class) latency (ISSUE 10)
+                        "serving": _serving_snapshot(),
                     }
                 ).encode()
                 ctype = "application/json"

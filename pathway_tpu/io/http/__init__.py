@@ -23,9 +23,23 @@ from pathway_tpu.internals.table import Table
 from pathway_tpu.io._connector import RowSource, coerce_row, fmt_value, input_table
 from pathway_tpu.io._subscribe import subscribe
 
-__all__ = ["rest_connector", "PathwayWebserver"]
+__all__ = ["rest_connector", "PathwayWebserver", "RetryLater"]
 
 logger = logging.getLogger("pathway_tpu.http")
+
+
+class RetryLater(Exception):
+    """Request shed by admission control before entering the engine.
+
+    The ingress maps it to HTTP 429 with a ``Retry-After`` header — the
+    client is told WHEN capacity is expected back instead of having its
+    request buffered into an unbounded queue (see
+    ``pathway_tpu/serving/admission.py``)."""
+
+    def __init__(self, retry_after: float = 1.0, reason: str = "overloaded"):
+        super().__init__(reason)
+        self.retry_after = max(0.0, float(retry_after))
+        self.reason = reason
 
 
 class PathwayWebserver:
@@ -87,6 +101,16 @@ class PathwayWebserver:
                 if isinstance(result, web.Response):
                     return result
                 return web.json_response(result, dumps=lambda o: json.dumps(o, default=str))
+            except RetryLater as e:
+                # load shed: bounded queues + explicit backpressure, never
+                # a silent drop or an unbounded buffer
+                import math
+
+                return web.json_response(
+                    {"error": e.reason, "retry_after": e.retry_after},
+                    status=429,
+                    headers={"Retry-After": str(max(1, math.ceil(e.retry_after)))},
+                )
             except ValueError as e:
                 return web.json_response({"error": str(e)}, status=400)
             except Exception as e:  # noqa: BLE001
@@ -122,6 +146,8 @@ class RestServerSubject(RowSource):
         schema: sch.SchemaMetaclass,
         delete_completed_queries: bool,
         request_validator: Callable | None = None,
+        admission: Any = None,
+        tenant_field: str = "tenant",
     ):
         self.webserver = webserver
         self.route = route
@@ -129,6 +155,12 @@ class RestServerSubject(RowSource):
         self.schema = schema
         self.delete_completed_queries = delete_completed_queries
         self.request_validator = request_validator
+        #: admission controller (serving/admission.py contract: ``admit(
+        #: tenant, route=...) -> ticket`` raising :class:`RetryLater` on
+        #: shed, ticket released when the request leaves the system) —
+        #: None keeps the legacy unbounded ingress
+        self.admission = admission
+        self.tenant_field = tenant_field
         self.futures: dict[K.Pointer, asyncio.Future] = {}
         self._seq = 0
         self._events: Any = None
@@ -166,21 +198,32 @@ class RestServerSubject(RowSource):
             maybe_error = self.request_validator(payload)
             if maybe_error is not None:
                 raise ValueError(str(maybe_error))
-        self._seq += 1
-        key = K.ref_scalar("__rest__", id(self), self._seq)
-        row = coerce_row(payload, self.schema)
-        loop = asyncio.get_running_loop()
-        future: asyncio.Future = loop.create_future()
-        self.futures[key] = future
-        self._events.add(key, row)
-        self._events.commit()
+        ticket = None
+        if self.admission is not None:
+            # bounded ingress: admit or shed BEFORE the row enters the
+            # engine; the ticket holds one slot of the tenant's bounded
+            # queue until the response resolves (raises RetryLater)
+            tenant = str(payload.get(self.tenant_field) or "default")
+            ticket = self.admission.admit(tenant, route=self.route)
         try:
-            result = await asyncio.wait_for(future, timeout=120)
+            self._seq += 1
+            key = K.ref_scalar("__rest__", id(self), self._seq)
+            row = coerce_row(payload, self.schema)
+            loop = asyncio.get_running_loop()
+            future: asyncio.Future = loop.create_future()
+            self.futures[key] = future
+            self._events.add(key, row)
+            self._events.commit()
+            try:
+                result = await asyncio.wait_for(future, timeout=120)
+            finally:
+                self.futures.pop(key, None)
+                if self.delete_completed_queries:
+                    self._events.remove(key, row)
+                    self._events.commit()
         finally:
-            self.futures.pop(key, None)
-            if self.delete_completed_queries:
-                self._events.remove(key, row)
-                self._events.commit()
+            if ticket is not None:
+                ticket.release()
         return result
 
     def resolve(self, key: K.Pointer, value: Any) -> None:
@@ -208,16 +251,31 @@ def rest_connector(
     delete_completed_queries: bool = False,
     request_validator: Callable | None = None,
     documentation: Any = None,
+    admission: Any = None,
+    tenant_field: str = "tenant",
 ) -> tuple[Table, Callable[[Table], None]]:
     """Expose an HTTP endpoint as an input table; returns the table and a
     ``response_writer(responses)`` that resolves each request's HTTP response
-    from the row in ``responses`` with the same key (column ``result``)."""
+    from the row in ``responses`` with the same key (column ``result``).
+
+    ``admission`` (optional) is an admission controller (see
+    ``pathway_tpu/serving/admission.py``): each request is admitted
+    against the tenant named by ``payload[tenant_field]`` before its row
+    enters the engine, and a shed request gets HTTP 429 + ``Retry-After``
+    instead of unbounded buffering."""
     if schema is None:
         schema = sch.schema_from_types(query=str)
     if webserver is None:
         webserver = PathwayWebserver(host or "0.0.0.0", port or 8080)
     subject = RestServerSubject(
-        webserver, route, methods, schema, delete_completed_queries, request_validator
+        webserver,
+        route,
+        methods,
+        schema,
+        delete_completed_queries,
+        request_validator,
+        admission=admission,
+        tenant_field=tenant_field,
     )
     table = input_table(subject, schema, name=f"rest:{route}")
 

@@ -1,0 +1,130 @@
+"""``pathway_tpu.serving`` — multi-tenant RAG serving layer.
+
+Admission control (:mod:`~pathway_tpu.serving.admission`), SLO-class
+scheduling (:mod:`~pathway_tpu.serving.scheduler`), stage co-scheduling
+with lookahead retrieval (:mod:`~pathway_tpu.serving.coscheduler`), the
+composed live-RAG graph (:mod:`~pathway_tpu.serving.graph`), and a
+seedable traffic generator (:mod:`~pathway_tpu.serving.loadgen`).
+
+This module is import-light on purpose: the monitoring endpoint calls
+:func:`serving_snapshot` on every ``/metrics`` scrape, and the heavy
+graph/loadgen modules (which pull in the engine) load lazily.
+
+The module-level registry tracks live serving components (weakly — a
+closed app's entries vanish with it) so process-wide monitoring can
+aggregate admission counters, scheduler lane stats, and per-tenant-class
+latency without holding references that keep dead apps alive.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any
+
+from .admission import AdmissionController, AdmissionTicket, TenantPolicy
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionTicket",
+    "TenantPolicy",
+    "SloScheduler",
+    "StageCoScheduler",
+    "RagServingApp",
+    "HashingEmbedder",
+    "LoadGen",
+    "TenantLoad",
+    "serving_probe",
+    "serving_snapshot",
+]
+
+_registry_lock = threading.Lock()
+_admissions: "weakref.WeakSet[Any]" = weakref.WeakSet()
+_schedulers: "weakref.WeakSet[Any]" = weakref.WeakSet()
+_coschedulers: "weakref.WeakSet[Any]" = weakref.WeakSet()
+_probe: Any = None
+
+
+def _register_admission(obj: Any) -> None:
+    with _registry_lock:
+        _admissions.add(obj)
+
+
+def _register_scheduler(obj: Any) -> None:
+    with _registry_lock:
+        _schedulers.add(obj)
+
+
+def _register_coscheduler(obj: Any) -> None:
+    with _registry_lock:
+        _coschedulers.add(obj)
+
+
+def serving_probe() -> Any:
+    """The process-wide per-tenant-class latency probe (lazy singleton)."""
+    global _probe
+    with _registry_lock:
+        if _probe is None:
+            from pathway_tpu.internals.monitoring import LabeledLatencyProbe
+
+            _probe = LabeledLatencyProbe()
+        return _probe
+
+
+def serving_snapshot() -> dict[str, Any]:
+    """Aggregate snapshot across every live serving component: admission
+    counters per tenant class, scheduler lane/class stats, co-scheduler
+    overlap counters, and the per-(stage, tenant_class) latency
+    histograms.  Empty sections mean no component of that kind is live."""
+    with _registry_lock:
+        admissions = list(_admissions)
+        schedulers = list(_schedulers)
+        coschedulers = list(_coschedulers)
+        probe = _probe
+    admitted: dict[str, int] = {}
+    shed: dict[str, int] = {}
+    inflight: dict[str, int] = {}
+    for a in admissions:
+        s = a.stats()
+        for cls, n in s.get("admitted_total", {}).items():
+            admitted[cls] = admitted.get(cls, 0) + n
+        for cls, n in s.get("shed_total", {}).items():
+            shed[cls] = shed.get(cls, 0) + n
+        for cls, n in s.get("inflight", {}).items():
+            inflight[cls] = inflight.get(cls, 0) + n
+    out: dict[str, Any] = {}
+    if admissions:
+        out["admission"] = {
+            "admitted_total": admitted,
+            "shed_total": shed,
+            "inflight": inflight,
+        }
+    if schedulers:
+        out["schedulers"] = [s.stats() for s in schedulers]
+    if coschedulers:
+        out["coschedulers"] = [c.stats() for c in coschedulers]
+    if probe is not None:
+        lat = probe.snapshot()
+        if lat:
+            out["latency"] = lat
+    return out
+
+
+def __getattr__(name: str) -> Any:
+    if name == "SloScheduler":
+        from .scheduler import SloScheduler
+
+        return SloScheduler
+    if name in ("StageCoScheduler", "extractive_answerer"):
+        from . import coscheduler as _m
+
+        return getattr(_m, name)
+    if name in ("RagServingApp", "HashingEmbedder", "simple_splitter"):
+        from . import graph as _m
+
+        return getattr(_m, name)
+    if name in ("LoadGen", "TenantLoad", "percentile"):
+        from . import loadgen as _m
+
+        return getattr(_m, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,265 @@
+"""Stage co-scheduler: overlap retrieval with generation (lookahead).
+
+A lockstep RAG pipeline runs embed → retrieve → generate as barriers:
+the index sits idle while the generator works and vice versa.  TeleRAG
+(PAPERS.md) shows the win from *lookahead retrieval* — fire the index
+probe speculatively as soon as the query embedding exists, while the
+generation stage of the previous request is still busy; HedraRAG makes
+the general case: co-schedule heterogeneous RAG stages instead of
+serializing them.  :class:`StageCoScheduler` implements that shape:
+
+- **embed** runs on the SLO scheduler's ``embed`` lane (coalescable, so
+  concurrent queries share one batched embedding call);
+- **retrieve** runs on the ``search`` lane and only *dispatches* the
+  probe (:meth:`SegmentedIndex.dispatch` — an async device launch), then
+  parks the request in the generation queue.  The probe is in flight on
+  the device while the request waits behind the previous generation —
+  that wait is the overlap the lookahead buys;
+- **generate** runs on a dedicated worker thread (modeling the
+  generation stream): it *collects* the already-running probe, reranks,
+  and answers.
+
+Every queue handoff is WakeupHub-notified with finite waits (LK006);
+per-request latencies land in the serving
+:class:`~pathway_tpu.internals.monitoring.LabeledLatencyProbe` under the
+request's tenant class.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable
+
+from .scheduler import SloScheduler
+
+__all__ = ["StageCoScheduler", "extractive_answerer"]
+
+
+def extractive_answerer(query: str, docs: list[dict]) -> str:
+    """Dependency-free default generator: extractive answer from the top
+    retrieved chunk (keeps the serving pipeline runnable without an LLM)."""
+    if not docs:
+        return f"no context found for: {query}"
+    top = docs[0]
+    return f"[{top.get('id')}] {str(top.get('text', ''))[:240]}"
+
+
+class _Req:
+    __slots__ = (
+        "query",
+        "k",
+        "tenant_class",
+        "future",
+        "t0_ns",
+        "t_embed_ns",
+        "t_dispatch_ns",
+        "payload",
+    )
+
+    def __init__(self, query: str, k: int, tenant_class: str, future: Future, t0_ns: int):
+        self.query = query
+        self.k = k
+        self.tenant_class = tenant_class
+        self.future = future
+        self.t0_ns = t0_ns
+        self.t_embed_ns = 0
+        self.t_dispatch_ns = 0
+        self.payload: Any = None
+
+
+class StageCoScheduler:
+    """embed → (speculative retrieve) → generate, stages overlapped."""
+
+    def __init__(
+        self,
+        *,
+        embedder: Callable[[str], Any],
+        index: Any,
+        doc_text: Callable[[Any], str] | None = None,
+        answerer: Callable[[str, list[dict]], str] | None = None,
+        scheduler: SloScheduler | None = None,
+        probe: Any = None,
+        k: int = 4,
+        lookahead: bool = True,
+        gen_queue_cap: int = 1024,
+        idle_wait_s: float = 0.05,
+    ):
+        self.embedder = embedder
+        self.index = index
+        self.doc_text = doc_text or (lambda key: str(key))
+        self.answerer = answerer or extractive_answerer
+        self.probe = probe
+        self.default_k = max(1, int(k))
+        self.lookahead = bool(lookahead)
+        self.gen_queue_cap = max(1, int(gen_queue_cap))
+        self._idle_wait_s = idle_wait_s
+        self.scheduler = scheduler if scheduler is not None else SloScheduler(probe=probe)
+        self.hub = self.scheduler.hub
+        self._gen_q: deque[_Req] = deque()
+        self._gen_lock = threading.Lock()
+        self._stop = threading.Event()
+        # lookahead accounting: how often the probe was already in
+        # flight when generation picked the request up, and for how long
+        self.lookahead_probes = 0
+        self.overlap_ns_total = 0
+        self.completed = 0
+        self.failed = 0
+        self._gen_thread = threading.Thread(
+            target=self._gen_loop, daemon=True, name="serving_generate"
+        )
+        self._gen_thread.start()
+        from pathway_tpu import serving as _serving
+
+        _serving._register_coscheduler(self)
+
+    # -------------------------------------------------------------- submit
+
+    def submit(
+        self, query: str, tenant_class: str = "interactive", k: int | None = None
+    ) -> Future:
+        """Returns a Future resolving to ``{"answer", "docs", ...}``."""
+        fut: Future = Future()
+        req = _Req(
+            str(query),
+            k if k is not None else self.default_k,
+            tenant_class,
+            fut,
+            time.monotonic_ns(),
+        )
+        efut = self.scheduler.submit(
+            "embed", tenant_class, self._embed_batch, item=req.query, coalesce="query_embed"
+        )
+        efut.add_done_callback(lambda f: self._after_embed(f, req))
+        return fut
+
+    def _embed_batch(self, queries: list[str]) -> list[Any]:
+        return [self.embedder(q) for q in queries]
+
+    def _after_embed(self, efut: Future, req: _Req) -> None:
+        exc = efut.exception(timeout=0)
+        if exc is not None:
+            self._fail(req, exc)
+            return
+        req.t_embed_ns = time.monotonic_ns()
+        if self.probe is not None:
+            self.probe.record(
+                "serve_embed", req.tenant_class, req.t_embed_ns - req.t0_ns
+            )
+        vec = efut.result(timeout=0)
+        rfut = self.scheduler.submit(
+            "search", req.tenant_class, self._retrieve, item=(req, vec)
+        )
+        rfut.add_done_callback(lambda f: self._after_retrieve(f, req))
+
+    def _retrieve(self, req_vec: tuple[_Req, Any]) -> Any:
+        """Search-lane stage: fire the probe, do NOT wait for results."""
+        req, vec = req_vec
+        dispatch = getattr(self.index, "dispatch", None)
+        if self.lookahead and dispatch is not None:
+            req.t_dispatch_ns = time.monotonic_ns()
+            return ("handle", dispatch(vec, req.k))
+        return ("hits", self.index.search(vec, req.k))
+
+    def _after_retrieve(self, rfut: Future, req: _Req) -> None:
+        exc = rfut.exception(timeout=0)
+        if exc is not None:
+            self._fail(req, exc)
+            return
+        req.payload = rfut.result(timeout=0)
+        overflow = False
+        with self._gen_lock:
+            if len(self._gen_q) >= self.gen_queue_cap:
+                overflow = True
+            else:
+                self._gen_q.append(req)
+        if overflow:
+            # bounded handoff even past admission (belt and suspenders):
+            # fail loudly instead of buffering without limit
+            self._fail(req, RuntimeError("generation queue full"))
+            return
+        self.hub.notify()
+
+    # ------------------------------------------------------------ generate
+
+    def _gen_loop(self) -> None:
+        while not self._stop.is_set():
+            seen = self.hub.seq()
+            with self._gen_lock:
+                req = self._gen_q.popleft() if self._gen_q else None
+            if req is None:
+                self.hub.wait(seen, self._idle_wait_s)
+                continue
+            self._generate(req)
+
+    def _resolve_hits(self, req: _Req) -> list[tuple[Any, float]]:
+        kind, value = req.payload
+        if kind == "hits":
+            return value[0] if value else []
+        t_collect = time.monotonic_ns()
+        hits = self.index.collect(value)
+        if req.t_dispatch_ns:
+            self.lookahead_probes += 1
+            self.overlap_ns_total += t_collect - req.t_dispatch_ns
+        return hits[0] if hits else []
+
+    def _generate(self, req: _Req) -> None:
+        try:
+            t_hits_start = req.t_embed_ns or req.t0_ns
+            hits = self._resolve_hits(req)
+            t_hits = time.monotonic_ns()
+            docs = [
+                {"id": key, "score": float(score), "text": self.doc_text(key)}
+                for key, score in hits
+            ]
+            answer = self.answerer(req.query, docs)
+            t_done = time.monotonic_ns()
+            if self.probe is not None:
+                cls = req.tenant_class
+                self.probe.record("serve_retrieve", cls, t_hits - t_hits_start)
+                self.probe.record("serve_generate", cls, t_done - t_hits)
+                self.probe.record("serve_e2e", cls, t_done - req.t0_ns)
+            self.completed += 1
+            if not req.future.done():
+                req.future.set_result(
+                    {
+                        "answer": answer,
+                        "docs": docs,
+                        "tenant_class": req.tenant_class,
+                        "latency_ms": (t_done - req.t0_ns) / 1e6,
+                    }
+                )
+        except BaseException as e:  # noqa: BLE001 — fault goes to the caller
+            self._fail(req, e)
+
+    def _fail(self, req: _Req, exc: BaseException) -> None:
+        self.failed += 1
+        if not req.future.done():
+            req.future.set_exception(exc)
+
+    # --------------------------------------------------------------- admin
+
+    def stats(self) -> dict[str, Any]:
+        with self._gen_lock:
+            queued = len(self._gen_q)
+        n = max(1, self.lookahead_probes)
+        return {
+            "completed": self.completed,
+            "failed": self.failed,
+            "gen_queued": queued,
+            "lookahead_probes": self.lookahead_probes,
+            "overlap_ms_total": self.overlap_ns_total / 1e6,
+            "overlap_ms_mean": self.overlap_ns_total / n / 1e6,
+        }
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self.hub.notify()
+        self._gen_thread.join(timeout)
+        with self._gen_lock:
+            leftovers = list(self._gen_q)
+            self._gen_q.clear()
+        for req in leftovers:
+            self._fail(req, RuntimeError("coscheduler closed"))

@@ -57,6 +57,25 @@ import numpy as np
 __all__ = ["SegmentedIndex"]
 
 
+class _SegProbe:
+    """In-flight search snapshot from :meth:`SegmentedIndex.dispatch`:
+    the delta view + tombstone mask taken at dispatch time, plus either
+    the main segment's async device handle (``probe``) or its eagerly
+    computed hits (``main_hits``).  ``main is None`` marks a probe over
+    an empty index."""
+
+    __slots__ = ("queries", "k", "delta", "mask", "main", "probe", "main_hits")
+
+    def __init__(self, queries, k, delta, mask, main, probe, main_hits):
+        self.queries = queries
+        self.k = k
+        self.delta = delta
+        self.mask = mask
+        self.main = main
+        self.probe = probe
+        self.main_hits = main_hits
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))
@@ -123,6 +142,12 @@ class SegmentedIndex:
         self._merging = False
         self.merges_total = 0
         self.merge_failures = 0
+        #: speculative-probe accounting (serving lookahead retrieval):
+        #: probes fired via :meth:`dispatch`, and probes whose device
+        #: handle went stale (index restored mid-flight) and were
+        #: recovered by re-running the search
+        self.probes_dispatched = 0
+        self.probes_recovered = 0
         self._maintenance = maintenance
 
     # ---------------------------------------------------------------- helpers
@@ -217,11 +242,38 @@ class SegmentedIndex:
         Precedence per key: live delta > frozen delta > main; tombstones
         mask the older segments.  Scores are computed in the same metric
         space for every segment, so the cross-segment merge is a plain
-        sort."""
+        sort.  Implemented as an immediate dispatch + collect pair, so
+        the synchronous path and the serving lookahead path share one
+        snapshot/merge discipline."""
+        return self.collect(self.dispatch(queries, k))
+
+    def dispatch(self, queries: np.ndarray, k: int) -> "_SegProbe":
+        """Fire a search probe and return a handle for :meth:`collect`.
+
+        The segment view (delta + tombstone mask) is snapshotted under
+        ``_lock``; the main-segment probe then launches OFF the lock, so
+        upserts, deletes and checkpoints never queue behind a graph walk
+        or device dispatch, and queries don't serialize on the segment.
+        This is safe because ``self.main`` only changes by atomic
+        pointer swap at a rebuild commit (the snapshot tolerates that),
+        in-place main mutation (bulk load, inplace merge, restore)
+        excludes probes via ``_main_mutex``, and every key such a
+        mutation touches is covered by the snapshotted delta/mask —
+        either the pre- or post-merge main yields the same merged
+        result.
+
+        When the main segment supports async device probes
+        (``main.dispatch``, e.g. the sharded slab), only the launch
+        happens here — the device computes while the caller does other
+        work and :meth:`collect` pays the host sync (TeleRAG-style
+        lookahead retrieval).  Host-only main segments run their search
+        eagerly on the dispatching thread instead, which preserves the
+        same overlap for a serving loop whose dispatch and collect run
+        on different stages."""
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         with self._lock:
             if not self._keys:
-                return [[] for _ in range(queries.shape[0])]
+                return _SegProbe(queries, 0, {}, set(), None, None, None)
             k = min(k, len(self._keys))
             delta = self._delta_view_locked()
             # main results to drop: deleted keys + keys shadowed by delta
@@ -230,28 +282,46 @@ class SegmentedIndex:
             mask.update(self._frozen_tombs)
             main = self.main
             n_main = len(main)
-        # The main-segment search and the delta scan run OFF the segment
-        # lock, so upserts, deletes and checkpoints never queue behind a
-        # graph walk or device dispatch, and queries don't serialize on
-        # the segment.  This is safe because ``self.main`` only changes
-        # by atomic pointer swap at a rebuild commit (the snapshot above
-        # tolerates that), in-place main mutation (bulk load, inplace
-        # merge, restore) excludes searchers via ``_main_mutex``, and
-        # every key such a mutation touches is covered by the
-        # snapshotted delta/mask — either the pre- or post-merge main
-        # yields the same merged result.
-        main_hits: list[list[tuple[Any, float]]]
+        probe = None
+        main_hits: list[list[tuple[Any, float]]] | None = None
         if n_main:
             fetch = min(k + len(mask), n_main)
-            if getattr(main, "concurrent_search", False):
+            main_dispatch = getattr(main, "dispatch", None)
+            if main_dispatch is not None:
+                with self._main_mutex:
+                    probe = main_dispatch(queries, fetch)
+                with self._lock:
+                    self.probes_dispatched += 1
+            elif getattr(main, "concurrent_search", False):
                 main_hits = main.search(queries, fetch)
             else:
                 with self._main_mutex:
                     main_hits = main.search(queries, fetch)
-        else:
+        return _SegProbe(queries, k, delta, mask, main, probe, main_hits)
+
+    def collect(self, handle: "_SegProbe") -> list[list[tuple[Any, float]]]:
+        """Resolve a :meth:`dispatch` handle to merged top-k results.
+
+        A device probe whose handle went stale (the index was restored
+        via ``load_state_dict`` while it was in flight) is recovered by
+        re-running the full search against the restored index — the
+        caller sees current results, never an exception or wrong keys."""
+        queries, k = handle.queries, handle.k
+        if handle.main is None:
+            return [[] for _ in range(queries.shape[0])]
+        main_hits = handle.main_hits
+        if main_hits is None and handle.probe is not None:
+            try:
+                main_hits = handle.main.collect(handle.probe)
+            except RuntimeError:
+                with self._lock:
+                    self.probes_recovered += 1
+                return self.search(queries, k)
+        if main_hits is None:
             main_hits = [[] for _ in range(queries.shape[0])]
+        delta_hits = self._search_delta(queries, handle.delta, k)
+        mask = handle.mask
         out: list[list[tuple[Any, float]]] = []
-        delta_hits = self._search_delta(queries, delta, k)
         for qi in range(queries.shape[0]):
             merged = [
                 (key, s) for key, s in main_hits[qi] if key not in mask
@@ -486,6 +556,8 @@ class SegmentedIndex:
                 "merges_total": self.merges_total,
                 "merge_failures": self.merge_failures,
                 "merging": self._merging,
+                "probes_dispatched": self.probes_dispatched,
+                "probes_recovered": self.probes_recovered,
             }
 
     def close(self) -> None:

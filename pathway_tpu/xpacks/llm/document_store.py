@@ -147,6 +147,9 @@ class DocumentStore:
         k: int
         metadata_filter: str | None = pw.column_definition(default_value=None)
         filepath_globpattern: str | None = pw.column_definition(default_value=None)
+        # multi-tenant serving: names the tenant for admission control /
+        # SLO-class scheduling; absent → "default" tenant
+        tenant: str | None = pw.column_definition(default_value=None)
 
     class StatisticsQuerySchema(pw.Schema):
         pass

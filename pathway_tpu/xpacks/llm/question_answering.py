@@ -103,6 +103,9 @@ class BaseRAGQuestionAnswerer(SummaryQuestionAnswerer):
         filters: str | None = pw.column_definition(default_value=None)
         model: str | None = pw.column_definition(default_value=None)
         return_context_docs: bool | None = pw.column_definition(default_value=False)
+        # multi-tenant serving: names the tenant for admission control /
+        # SLO-class scheduling; absent → "default" tenant
+        tenant: str | None = pw.column_definition(default_value=None)
 
     class RetrieveQuerySchema(DocumentStore.RetrieveQuerySchema):
         pass

@@ -18,11 +18,27 @@ __all__ = [
 
 
 class BaseRestServer:
-    """Route registry over one webserver (reference ``servers.py:16``)."""
+    """Route registry over one webserver (reference ``servers.py:16``).
 
-    def __init__(self, host: str, port: int, **kwargs: Any):
+    ``admission`` (optional) is a serving-layer admission controller
+    (``pathway_tpu/serving/admission.py``): every route this server
+    registers admits requests against the tenant named by the payload's
+    ``tenant_field`` before they enter the engine — a full tenant queue
+    sheds with 429 + ``Retry-After`` instead of buffering unboundedly."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        admission: Any = None,
+        tenant_field: str = "tenant",
+        **kwargs: Any,
+    ):
         self.host = host
         self.port = port
+        self.admission = admission
+        self.tenant_field = tenant_field
         self.webserver = PathwayWebserver(host=host, port=port)
 
     def serve(
@@ -37,6 +53,8 @@ class BaseRestServer:
             route=route,
             schema=schema,
             delete_completed_queries=kwargs.get("delete_completed_queries", False),
+            admission=kwargs.get("admission", self.admission),
+            tenant_field=kwargs.get("tenant_field", self.tenant_field),
         )
         writer(handler(queries))
 

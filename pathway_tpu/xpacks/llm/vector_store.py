@@ -149,9 +149,19 @@ class VectorStoreServer:
         with_cache: bool = True,
         cache_backend: Any = None,
         terminate_on_error: bool = False,
+        admission: Any = None,
+        tenant_field: str = "tenant",
     ) -> threading.Thread | None:
-        """reference ``vector_store.py:478``"""
-        self._server = DocumentStoreServer(host, port, self.document_store)
+        """reference ``vector_store.py:478``; ``admission`` bounds the
+        ingress per tenant (serving/admission.py) — full queues shed with
+        429 + Retry-After instead of buffering unboundedly."""
+        self._server = DocumentStoreServer(
+            host,
+            port,
+            self.document_store,
+            admission=admission,
+            tenant_field=tenant_field,
+        )
         return self._server.run(threaded=threaded, with_cache=with_cache)
 
 

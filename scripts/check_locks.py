@@ -30,6 +30,13 @@ AST-based checks over ``engine/cluster.py`` and ``engine/scheduler.py``
   class that never arms a finite ``settimeout`` — each is an infinite
   wait that turns a peer crash into a hang instead of a bounded-time
   liveness failure.
+- **LK006** — serving-path wait discipline: in files under ``serving/``
+  (override with ``serving_path=``) every queue handoff must ride the
+  WakeupHub and every admission-path wait must be finite.  Flags bare
+  ``time.sleep`` (polling puts a floor under tail latency), any
+  ``.wait()`` with no timeout (or an explicit ``None``), and zero-
+  argument ``.join()`` / ``.result()`` / ``.get()`` (each blocks a
+  serving thread forever if its producer died).
 
 Usage: ``python scripts/check_locks.py [files...]``; exits 1 on
 findings.  Importable — tests feed synthetic sources through
@@ -318,16 +325,85 @@ def _check_liveness_discipline(
         _scan_scope(pseudo, "module scope")
 
 
+def _check_serving_discipline(
+    tree: ast.AST, filename: str, findings: list[Finding]
+) -> None:
+    """LK006 (serving paths only): finite waits everywhere.  The serving
+    layer's contract is bounded everything — queues are capped by
+    admission, so the only way a request hangs is an unbounded wait.
+    Flags bare ``time.sleep``, ``.wait()`` with no timeout (or a literal
+    ``None`` timeout), and zero-argument ``.join()``/``.result()``/
+    ``.get()``."""
+
+    def _none_arg(node: ast.Call) -> bool:
+        for a in node.args:
+            if isinstance(a, ast.Constant) and a.value is None:
+                return True
+        for kw in node.keywords:
+            if kw.arg == "timeout" and (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None
+            ):
+                return True
+        return False
+
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+        ):
+            continue
+        meth = node.func.attr
+        if (
+            meth == "sleep"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in ("time", "_time")
+        ):
+            findings.append(
+                Finding(
+                    filename,
+                    node.lineno,
+                    "LK006",
+                    "polling time.sleep in a serving path; park on a "
+                    "WakeupHub generation-wait (or Event.wait with a "
+                    "timeout) so a notify wakes the handoff immediately",
+                )
+            )
+        elif meth == "wait":
+            if (not node.args and not node.keywords) or _none_arg(node):
+                findings.append(
+                    Finding(
+                        filename,
+                        node.lineno,
+                        "LK006",
+                        "wait() without a finite timeout in a serving "
+                        "path; every admission-path wait must have a "
+                        "deadline or a request can hang forever",
+                    )
+                )
+        elif meth in ("join", "result", "get") and not node.args and not node.keywords:
+            findings.append(
+                Finding(
+                    filename,
+                    node.lineno,
+                    "LK006",
+                    f"{meth}() with no timeout in a serving path blocks "
+                    "this thread forever if the producer died; pass a "
+                    "finite timeout",
+                )
+            )
+
+
 def check_source(
     source: str,
     filename: str,
     *,
     scheduler_path: bool | None = None,
     cluster_path: bool | None = None,
+    serving_path: bool | None = None,
 ) -> list[Finding]:
     """Lint one file's source.  ``scheduler_path`` controls LK003
     (default: filename contains 'scheduler'); ``cluster_path`` controls
-    LK005 (default: filename contains 'cluster')."""
+    LK005 (default: filename contains 'cluster'); ``serving_path``
+    controls LK006 (default: the path contains 'serving')."""
     findings: list[Finding] = []
     tree = ast.parse(source, filename=filename)
 
@@ -338,6 +414,11 @@ def check_source(
         cluster_path = "cluster" in os.path.basename(filename)
     if cluster_path:
         _check_liveness_discipline(tree, filename, findings)
+
+    if serving_path is None:
+        serving_path = "serving" in filename.replace(os.sep, "/")
+    if serving_path:
+        _check_serving_discipline(tree, filename, findings)
 
     if scheduler_path is None:
         scheduler_path = "scheduler" in os.path.basename(filename)
@@ -395,6 +476,11 @@ def check_lock_order(
 DEFAULT_TARGETS = (
     "pathway_tpu/engine/cluster.py",
     "pathway_tpu/engine/scheduler.py",
+    "pathway_tpu/serving/admission.py",
+    "pathway_tpu/serving/scheduler.py",
+    "pathway_tpu/serving/coscheduler.py",
+    "pathway_tpu/serving/graph.py",
+    "pathway_tpu/serving/loadgen.py",
 )
 
 

@@ -211,6 +211,73 @@ def test_lk005_not_applied_outside_cluster_paths(cl):
     assert cl.check_source(src, "scheduler.py") == []
 
 
+def test_lk006_bare_event_wait_flagged(cl):
+    src = (
+        "def park(ev):\n"
+        "    ev.wait()\n"
+    )
+    findings = cl.check_source(src, "pathway_tpu/serving/admission.py")
+    assert [f.code for f in findings] == ["LK006"]
+
+
+def test_lk006_none_timeout_flagged(cl):
+    src = (
+        "def park(ev):\n"
+        "    ev.wait(timeout=None)\n"
+    )
+    findings = cl.check_source(src, "pathway_tpu/serving/admission.py")
+    assert [f.code for f in findings] == ["LK006"]
+
+
+def test_lk006_finite_wait_clean(cl):
+    src = (
+        "def park(ev):\n"
+        "    ev.wait(0.05)\n"
+    )
+    assert cl.check_source(src, "pathway_tpu/serving/admission.py") == []
+
+
+def test_lk006_unbounded_result_and_join_flagged(cl):
+    src = (
+        "def settle(fut, t):\n"
+        "    fut.result()\n"
+        "    t.join()\n"
+    )
+    findings = cl.check_source(src, "pathway_tpu/serving/graph.py")
+    assert [f.code for f in findings] == ["LK006", "LK006"]
+
+
+def test_lk006_bounded_result_and_join_clean(cl):
+    src = (
+        "def settle(fut, t):\n"
+        "    fut.result(timeout=30)\n"
+        "    t.join(5.0)\n"
+    )
+    assert cl.check_source(src, "pathway_tpu/serving/graph.py") == []
+
+
+def test_lk006_time_sleep_flagged(cl):
+    src = (
+        "import time\n"
+        "def poll():\n"
+        "    time.sleep(0.1)\n"
+    )
+    findings = cl.check_source(src, "pathway_tpu/serving/loadgen.py")
+    assert [f.code for f in findings] == ["LK006"]
+
+
+def test_lk006_not_applied_outside_serving_paths(cl):
+    # tooling and tests may block; LK006 is a serving-path rule only
+    src = (
+        "def settle(fut):\n"
+        "    fut.result()\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+    # and the override forces it on for any path
+    findings = cl.check_source(src, "x.py", serving_path=True)
+    assert [f.code for f in findings] == ["LK006"]
+
+
 def test_engine_files_clean():
     """The shipped cluster/scheduler must satisfy the discipline; this
     is the gate that keeps future edits honest."""
