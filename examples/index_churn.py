@@ -1,0 +1,70 @@
+"""Live vector index under churn: keyed upserts through a python
+connector into a KNN data index, queried as-of-now.
+
+The ingest stream re-upserts a key, exercising the delta-segment /
+background-merge path (PR 9).  The connector is ``pw.io.python`` — a
+single reader thread, so the keyed upsert is order-safe and the
+distribution-safety pass (PW-X001) stays quiet; swap the feed for a
+byte-range file source and it would not.  Lintable without running:
+``python -m pathway_tpu.cli lint examples/index_churn.py`` (accepted
+warnings in ``scripts/lint_baseline.json``: the embedding ``pw.apply``
+is a Python fallback on the hot path, PW-P001).
+"""
+
+import pathway_tpu as pw
+from pathway_tpu.io.python import ConnectorSubject
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+
+class DocSchema(pw.Schema):
+    doc_id: str = pw.column_definition(primary_key=True)
+    vx: float
+    vy: float
+
+
+class QuerySchema(pw.Schema):
+    qid: str = pw.column_definition(primary_key=True)
+    qx: float
+    qy: float
+
+
+class DocFeed(ConnectorSubject):
+    def run(self):
+        self.next(doc_id="a", vx=1.0, vy=0.0)
+        self.next(doc_id="b", vx=0.0, vy=1.0)
+        self.commit()
+        # churn: the re-upsert lands in a delta segment and is merged
+        self.next(doc_id="a", vx=0.5, vy=0.5)
+        self.commit()
+
+
+class QueryFeed(ConnectorSubject):
+    def run(self):
+        self.next(qid="q1", qx=1.0, qy=0.0)
+        self.commit()
+
+
+docs = pw.io.python.read(DocFeed("docs"), schema=DocSchema, name="docs")
+docs = docs.select(
+    doc_id=pw.this.doc_id,
+    vec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.vx, pw.this.vy),
+)
+queries = pw.io.python.read(QueryFeed("queries"), schema=QuerySchema, name="queries")
+queries = queries.select(
+    qid=pw.this.qid,
+    qvec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.qx, pw.this.qy),
+)
+
+index = BruteForceKnnFactory(dimensions=2, reserved_space=16).build_data_index(
+    docs.vec, docs
+)
+hits = index.query_as_of_now(queries.qvec, number_of_matches=2)
+
+
+def on_change(key, row, time, is_addition):
+    if is_addition:
+        print(f"{row['qid']}: {row.get('_pw_index_reply')}")
+
+
+pw.io.subscribe(hits, on_change=on_change)
+pw.run()

@@ -1,0 +1,23 @@
+"""Multi-tenant RAG serving graph (build only).
+
+Builds the :class:`RagServingApp` ingest dataflow — python-connector
+doc feed → splitter → keyed upsert into a churn-safe SegmentedIndex —
+into the global graph so ``pw.analyze()`` / ``cli lint`` can verify it:
+the serving nodes carry ``meta["serving"]`` stage annotations and the
+sink declares itself a keyed index upsert, which PW-X001 checks against
+the (order-preserving, single-reader) feed.  Accepted warnings live in
+``scripts/lint_baseline.json`` (the splitter ``pw.apply`` is a Python
+fallback on the hot path, PW-P001).
+"""
+
+import pathway_tpu as pw  # noqa: F401  (pw.run is what the lint stubs)
+from pathway_tpu.serving import RagServingApp, TenantPolicy
+
+app = RagServingApp(
+    {"demo": TenantPolicy("interactive", rate_per_s=50.0, burst=10, queue_cap=32)},
+    embed_dim=16,
+    delta_cap=32,
+    auto_merge=False,
+)
+app.build()
+app.close()

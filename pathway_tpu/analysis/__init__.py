@@ -4,18 +4,13 @@
 the expression-VM programs compiled for it, BEFORE execution, and
 returns structured :class:`Diagnostic` findings — the build-time
 equivalent of the checks the reference Rust engine does inside
-``trait Graph`` (``src/engine/graph.rs``), plus perf and state-growth
-lints no runtime check can give you:
+``trait Graph`` (``src/engine/graph.rs``), plus perf, state-growth and
+distribution-safety lints no runtime check can give you.
 
-- ``PW-T001`` (error)   type mismatch: join keys, concat columns, or a
-  declared column dtype the bytecode contradicts
-- ``PW-P001`` (warning) CALL_PY fallback on a streaming (hot) path
-- ``PW-S001`` (warning) unwindowed join/groupby over a streaming source
-- ``PW-S002`` (error)   append-only violation (deduplicate over a
-  retracting upstream)
-- ``PW-D001`` (warning) dead column: computed, never read
-- ``PW-N001`` (warning) nullability flowing into a non-optional
-  sink-reaching column
+The code registry lives in ONE place —
+:data:`pathway_tpu.analysis.diagnostics.CODE_INFO` — and that module's
+docstring embeds the generated table (``render_code_table()``); codes
+are never listed by hand anywhere else.
 
 Three surfaces: ``pathway_tpu.analyze()``, the CLI ``pathway_tpu lint
 program.py``, and strict mode (``pw.run(strict=True)`` /
@@ -28,6 +23,7 @@ from __future__ import annotations
 from typing import Any
 
 from pathway_tpu.analysis.diagnostics import (
+    CODE_INFO,
     CODES,
     SEV_ERROR,
     SEV_INFO,
@@ -36,6 +32,7 @@ from pathway_tpu.analysis.diagnostics import (
     Diagnostic,
     count_by_severity,
     format_diagnostics,
+    render_code_table,
     sort_diagnostics,
 )
 from pathway_tpu.analysis.graph_facts import GraphFacts
@@ -50,6 +47,8 @@ __all__ = [
     "Diagnostic",
     "AnalysisError",
     "CODES",
+    "CODE_INFO",
+    "render_code_table",
     "SEV_ERROR",
     "SEV_WARNING",
     "SEV_INFO",

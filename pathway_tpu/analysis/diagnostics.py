@@ -1,25 +1,15 @@
 """Diagnostic records for the pre-flight static analyzer.
 
 Each finding carries a STABLE code (``PW-Xnnn``) so CI gates, dashboards
-and strict mode can match on it without parsing prose.  Codes:
+and strict mode can match on it without parsing prose.
 
-==========  ========  =====================================================
-code        severity  meaning
-==========  ========  =====================================================
-PW-T001     error     type mismatch (join keys, concat columns, or a
-                      declared column dtype the bytecode contradicts)
-PW-P001     warning   CALL_PY fallback in a program on a streaming (hot)
-                      path — the row loop drops off the native VM
-PW-S001     warning   unwindowed join/groupby over a streaming source:
-                      operator state grows without bound
-PW-S002     error     append-only violation: an operator that requires
-                      append-only input is fed retractions
-PW-D001     warning   dead column: computed by a select but never read by
-                      any downstream consumer
-PW-N001     warning   nullability leak: an optionally-None value flows
-                      into a column declared non-optional at a sink-reaching
-                      select
-==========  ========  =====================================================
+This module is the SINGLE SOURCE OF TRUTH for the code registry:
+:data:`CODE_INFO` maps every code to its fixed severity and one-line
+description, :data:`CODES` is derived from it, and
+:func:`render_code_table` generates the human-readable table that the
+module docstring (below) and any docs embed — so the registry and the
+prose can never drift apart.  ``tests/test_static_analysis.py`` checks
+that every registered code also appears in the README table.
 """
 
 from __future__ import annotations
@@ -33,15 +23,80 @@ SEV_INFO = "info"
 
 _SEV_ORDER = {SEV_ERROR: 0, SEV_WARNING: 1, SEV_INFO: 2}
 
-#: every code the analyzer can emit, with its fixed severity
-CODES: dict[str, str] = {
-    "PW-T001": SEV_ERROR,
-    "PW-P001": SEV_WARNING,
-    "PW-S001": SEV_WARNING,
-    "PW-S002": SEV_ERROR,
-    "PW-D001": SEV_WARNING,
-    "PW-N001": SEV_WARNING,
+#: every code the analyzer can emit: code -> (fixed severity, description)
+CODE_INFO: dict[str, tuple[str, str]] = {
+    "PW-T001": (
+        SEV_ERROR,
+        "type mismatch (join keys, concat columns, or a declared column "
+        "dtype the bytecode contradicts)",
+    ),
+    "PW-P001": (
+        SEV_WARNING,
+        "CALL_PY fallback in a program on a streaming (hot) path — the "
+        "row loop drops off the native VM",
+    ),
+    "PW-S001": (
+        SEV_WARNING,
+        "unwindowed join/groupby over a streaming source: operator state "
+        "grows without bound",
+    ),
+    "PW-S002": (
+        SEV_ERROR,
+        "append-only violation: an operator that requires append-only "
+        "input is fed retractions",
+    ),
+    "PW-D001": (
+        SEV_WARNING,
+        "dead column: computed by a select but never read by any "
+        "downstream consumer",
+    ),
+    "PW-N001": (
+        SEV_WARNING,
+        "nullability leak: an optionally-None value flows into a column "
+        "declared non-optional at a sink-reaching select",
+    ),
+    "PW-X001": (
+        SEV_ERROR,
+        "order-sensitive stateful operator (keyed upsert into an index, "
+        "deduplicate, asof join) fed by a partitioned source that does "
+        "not preserve cross-rank per-key arrival order",
+    ),
+    "PW-X002": (
+        SEV_WARNING,
+        "join/groupby whose inputs are not co-partitioned with its keys: "
+        "a full exchange of the hot streaming path",
+    ),
+    "PW-X003": (
+        SEV_ERROR,
+        "arrival-order-dependent reducer over a non-deterministically "
+        "ordered stream feeding a sink: recovered runs are not "
+        "byte-identical",
+    ),
+    "PW-R001": (
+        SEV_ERROR,
+        "stateful operator with out-of-band state but no "
+        "snapshot_state/on_restore coverage: a checkpoint-coverage hole "
+        "that duplicates work on replay",
+    ),
 }
+
+#: every code the analyzer can emit, with its fixed severity (derived —
+#: do not edit; add codes to CODE_INFO above)
+CODES: dict[str, str] = {code: sev for code, (sev, _) in CODE_INFO.items()}
+
+
+def render_code_table() -> str:
+    """The registry as an aligned text table — generated, never
+    hand-maintained.  Docs and docstrings embed this."""
+    rows = [(code, sev, desc) for code, (sev, desc) in CODE_INFO.items()]
+    lines = ["code        severity  meaning", "-" * 72]
+    for code, sev, desc in rows:
+        lines.append(f"{code:<11} {sev:<9} {desc}")
+    return "\n".join(lines)
+
+
+# the docstring advertises the registry it documents
+__doc__ = (__doc__ or "") + "\n\n" + render_code_table() + "\n"
 
 
 @dataclass(frozen=True)

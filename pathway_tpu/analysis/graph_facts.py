@@ -137,6 +137,17 @@ class GraphFacts:
                     seen.add(inp.id)
                     work.append(inp)
 
+    @property
+    def distribution(self):
+        """Lazily-built partition/order facts (analysis/distribution.py),
+        shared by every pass that consults them."""
+        cached = getattr(self, "_distribution", None)
+        if cached is None:
+            from pathway_tpu.analysis.distribution import DistributionFacts
+
+            cached = self._distribution = DistributionFacts(self.graph, self)
+        return cached
+
     def is_stateful_unbounded(self, n: eg.Node) -> bool:
         """True when ``n`` is a groupby/join holding per-key state over a
         live source with nothing upstream bounding the key space."""

@@ -346,10 +346,13 @@ def check_dead_columns(
     return out
 
 
+from pathway_tpu.analysis.distribution import check_distribution  # noqa: E402
+
 ALL_PASSES = (
     check_types,
     check_call_py,
     check_unbounded_state,
     check_append_only,
     check_dead_columns,
+    check_distribution,
 )

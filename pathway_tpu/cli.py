@@ -47,14 +47,33 @@ def spawn(
     return code
 
 
-def lint(program: str, *, werror: bool = False, plan: bool = False) -> int:
+def lint(
+    program: str,
+    *,
+    werror: bool = False,
+    plan: bool = False,
+    baseline: str | None = None,
+) -> int:
     """Build ``program``'s dataflow graph without running it and print
     the pre-flight analyzer's findings (``pathway_tpu/analysis/``).
     With ``plan=True`` also print the optimizer's execution plan for the
     built graph (``pw.explain()`` textual form, at the PATHWAY_OPTIMIZE
-    level).  Exit 1 on error-severity diagnostics (or any finding with
+    level).  ``baseline`` names a JSON file mapping program basenames to
+    ACCEPTED warning codes: baselined warnings are still printed but do
+    not fail ``--werror`` (errors are never baselined — an accepted
+    hazard belongs in the config, not silenced in code).  Exit 1 on
+    error-severity diagnostics (or any unbaselined finding with
     ``--werror``), 0 on a clean graph."""
+    import json
+    import os.path
+
     from pathway_tpu.analysis import SEV_ERROR, format_diagnostics, lint_file
+
+    accepted: set[str] = set()
+    if baseline is not None:
+        with open(baseline, encoding="utf-8") as fh:
+            table = json.load(fh)
+        accepted = set(table.get(os.path.basename(program), ()))
 
     diags = lint_file(program)
     if diags:
@@ -66,11 +85,16 @@ def lint(program: str, *, werror: bool = False, plan: bool = False) -> int:
         print(explain().format())
     errors = sum(1 for d in diags if d.severity == SEV_ERROR)
     warnings = len(diags) - errors
+    gating = [
+        d for d in diags if d.severity == SEV_ERROR or d.code not in accepted
+    ]
+    baselined = len(diags) - len(gating)
+    suffix = f", {baselined} baselined" if baselined else ""
     print(
-        f"{program}: {errors} error(s), {warnings} warning(s)",
+        f"{program}: {errors} error(s), {warnings} warning(s){suffix}",
         file=sys.stderr,
     )
-    if errors or (werror and diags):
+    if errors or (werror and gating):
         return 1
     return 0
 
@@ -105,6 +129,11 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="also print the optimizer's execution plan",
     )
+    lp.add_argument(
+        "--baseline",
+        default=None,
+        help="JSON file of accepted warning codes per program basename",
+    )
 
     args = parser.parse_args(argv)
     if args.command == "spawn":
@@ -121,7 +150,12 @@ def main(argv: list[str] | None = None) -> int:
         spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS", "").split()
         return main(["spawn", *spawn_args])
     if args.command == "lint":
-        return lint(args.program, werror=args.werror, plan=args.plan)
+        return lint(
+            args.program,
+            werror=args.werror,
+            plan=args.plan,
+            baseline=args.baseline,
+        )
     return 2
 
 

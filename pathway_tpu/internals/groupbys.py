@@ -218,7 +218,10 @@ class GroupedTable:
                             used.add(r._name)
                 except Exception:
                     pass
-        node.meta["groupby"] = {"grouping": grouping_names}
+        node.meta["groupby"] = {
+            "grouping": grouping_names,
+            "reducers": [impl.name for impl, _ in reducer_args],
+        }
         node.meta["used_cols"] = sorted(used)
         inter_cols = inter_names + [f"__r{i}" for i in range(len(reducer_slots))]
         inter_dtypes: dict[str, dt.DType] = {}

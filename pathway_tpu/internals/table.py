@@ -1079,6 +1079,9 @@ class Table:
         if instance is not None:
             dedup_refs.append(self._subst(instance))
         node.meta["used_cols"] = _referenced_names(dedup_refs)
+        # the acceptor compares each row against the PREVIOUS accepted one,
+        # so the result depends on per-instance arrival order (PW-X001)
+        node.meta["dedup"] = {"order_sensitive": True}
         return Table(node, self._column_names, self._dtypes, name="deduplicate")
 
     # -- joins ---------------------------------------------------------------

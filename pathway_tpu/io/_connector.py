@@ -59,6 +59,16 @@ class RowSource:
     #: (enables count-based persistence resume; see pathway_tpu.persistence)
     deterministic_replay = False
 
+    #: how rows split across workers in a multi-worker run: "single"
+    #: (one reader owns the whole stream), "byte-range" (static files
+    #: split by offset), "round-robin", or "key" (routed by row key).
+    #: Consumed by the distribution-safety pass (analysis/distribution.py).
+    partitioning = "single"
+
+    #: whether per-key arrival order survives a partitioned multi-worker
+    #: read.  Byte-range file splits do NOT preserve it (PR 9 gotcha).
+    order_preserving = True
+
     def run(self, events: Any) -> None:  # pragma: no cover
         raise NotImplementedError
 
@@ -214,7 +224,21 @@ def input_table(
     # pathway_tpu.internals.resilience); None keeps the historical
     # one-failure-drops-the-source behaviour
     node.recovery_policy = recovery_policy
+    # distribution-safety facts for the analyzer: static tables live on
+    # every worker identically; live sources advertise how they split and
+    # whether per-key order survives the split (analysis/distribution.py)
     dtypes = {c: schema.__columns__[c].dtype for c in cols}
+    node.meta["source"] = {
+        "name": name,
+        "upsert": upsert,
+        "partitioning": (
+            "static" if subject is None else getattr(subject, "partitioning", "single")
+        ),
+        "order_preserving": (
+            True if subject is None else bool(getattr(subject, "order_preserving", True))
+        ),
+        "dtypes": list(dtypes.values()),
+    }
     return Table(node, cols, dtypes, name=name)
 
 

@@ -23,17 +23,18 @@ def subscribe(
     *,
     name: str = "subscribe",
     sort_by: Any = None,
-) -> None:
+) -> eg.OutputNode:
     """Call ``on_change(key, row: dict, time: int, is_addition: bool)`` for
     every update of ``table``; ``on_time_end(time)`` at every closed epoch;
-    ``on_end()`` when the stream finishes."""
+    ``on_end()`` when the stream finishes.  Returns the sink node so
+    callers can annotate ``node.meta`` for the analyzer."""
     cols = table._column_names
 
     def _on_change(key: Pointer, values: tuple, time: int, diff: int) -> None:
         if on_change is not None:
             on_change(key, dict(zip(cols, values)), time, diff > 0)
 
-    eg.OutputNode(
+    return eg.OutputNode(
         G.engine_graph,
         table._node,
         _on_change if on_change else None,

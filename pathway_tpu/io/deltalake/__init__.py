@@ -183,6 +183,12 @@ class _DeltaSource(RowSource):
 
     deterministic_replay = True
 
+    # disjoint key-hash row share per worker, emitted in commit-version
+    # order on each rank: same key always lands on the same rank, so
+    # per-key arrival order survives the split
+    partitioning = "key"
+    order_preserving = True
+
     def __init__(
         self,
         table_path: str,

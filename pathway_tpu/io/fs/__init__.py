@@ -75,6 +75,13 @@ class _FilesSource(RowSource):
 
     deterministic_replay = True
 
+    # multi-worker reads split files by byte range (static, stateless
+    # parser) or interleaved line share; either way two rows with the
+    # same key can land on different ranks, so cross-rank per-key arrival
+    # order is NOT preserved (the PR 9 keyed-upsert gotcha — PW-X001)
+    partitioning = "byte-range"
+    order_preserving = False
+
     def __init__(
         self,
         path: str,

@@ -93,6 +93,11 @@ class _SubjectAdapter(RowSource):
         self.deterministic_replay = bool(
             getattr(subject, "deterministic_replay", False)
         )
+        # distribution facts: a python connector runs ONE reader thread,
+        # so it is single-owner and order-preserving unless the wrapped
+        # subject declares otherwise (analysis/distribution.py, PW-X001)
+        self.partitioning = getattr(subject, "partitioning", "single")
+        self.order_preserving = bool(getattr(subject, "order_preserving", True))
         hook = getattr(subject, "on_persistence_resume", None)
         if hook is not None:
             self.on_persistence_resume = hook

@@ -75,6 +75,12 @@ class _S3Source(RowSource):
 
     deterministic_replay = True
 
+    # disjoint key-hash row share per worker: same key always lands on
+    # the same rank, and that rank reads objects in key-sorted order, so
+    # per-key arrival order survives the split
+    partitioning = "key"
+    order_preserving = True
+
     def __init__(
         self,
         settings: AwsS3Settings,

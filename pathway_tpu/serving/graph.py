@@ -207,7 +207,25 @@ class RagServingApp:
             ),
             tenant=pw.this.tenant,
         )
-        subscribe(chunked, on_change=self._on_chunks, name="serving_ingest")
+        sink = subscribe(chunked, on_change=self._on_chunks, name="serving_ingest")
+        # analyzer-facing stage annotations: without these the serving
+        # pipeline is three opaque nodes and pw.analyze() cannot tell the
+        # ingest path from a user graph (the old PW-S001 near-miss), nor
+        # see that the sink is a keyed upsert into the live index
+        docs._node.meta["serving"] = {
+            "stage": "ingest",
+            "admission": type(self.admission).__name__,
+            "scheduler": type(self.scheduler).__name__,
+        }
+        chunked._node.meta["serving"] = {
+            "stage": "chunk",
+            "coscheduler": type(self.coscheduler).__name__,
+        }
+        sink.meta["serving"] = {"stage": "index-upsert"}
+        # chunk ids are stable (doc_id + position) and the feed is a
+        # single-reader python connector, so the upsert is order-safe —
+        # the annotation lets PW-X001 verify that instead of assuming it
+        sink.meta["index_upsert"] = True
 
     def _on_chunks(self, key: Any, row: dict, time: int, is_addition: bool) -> None:
         chunks = list(row.get("chunks") or ())

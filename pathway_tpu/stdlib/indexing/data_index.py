@@ -526,6 +526,14 @@ class DataIndex:
             query_filter_fn=f_fn,
             as_of_now=as_of_now,
         )
+        # the index side is a keyed upsert stream into adapter state:
+        # applying same-key updates out of order serves stale vectors
+        # (distribution pass treats input 0 as order-sensitive, PW-X001)
+        node.meta["index"] = {
+            "upsert": True,
+            "order_sensitive": True,
+            "adapter": type(self.inner).__name__,
+        }
         cols = query_table._column_names + [REPLY_ID, REPLY_SCORE, REPLY_DATA]
         dtypes = dict(query_table._dtypes)
         dtypes[REPLY_ID] = dt.ANY

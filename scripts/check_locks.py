@@ -30,6 +30,17 @@ AST-based checks over ``engine/cluster.py`` and ``engine/scheduler.py``
   class that never arms a finite ``settimeout`` — each is an infinite
   wait that turns a peer crash into a hang instead of a bounded-time
   liveness failure.
+- **LK007** — whole-repo lock-order deadlock lint: an inter-procedural
+  **may-hold-while-acquiring** graph built over every class and function
+  in the scanned tree (``engine/``, ``internals/``, ``stdlib/indexing/``,
+  ``serving/`` by default).  Nodes are locks (``Class.attr`` for
+  ``self._lock``-style members, ``module:name`` for globals like
+  ``segments:_main_mutex``); an edge A→B means some code path acquires B
+  — directly or through any chain of resolvable calls (``self.m()``,
+  ``self.attr.m()`` via ``self.attr = Class(...)`` assignments, bare
+  same-module calls) — while holding A.  Any cycle is a potential
+  deadlock and is reported once with the full lock-order path and the
+  call chain witnessing each edge.
 - **LK006** — serving-path wait discipline: in files under ``serving/``
   (override with ``serving_path=``) every queue handoff must ride the
   WakeupHub and every admission-path wait must be finite.  Flags bare
@@ -82,6 +93,11 @@ def _recv_name(func: ast.expr) -> str | None:
     return None
 
 
+def _locky(name: str) -> bool:
+    n = name.lower()
+    return "lock" in n or "mutex" in n
+
+
 def _lock_name(expr: ast.expr) -> str | None:
     """Identifier for a ``with <expr>:`` item that looks like a lock."""
     if isinstance(expr, ast.Attribute):
@@ -90,7 +106,7 @@ def _lock_name(expr: ast.expr) -> str | None:
         name = expr.id
     else:
         return None
-    return name if "lock" in name.lower() else None
+    return name if _locky(name) else None
 
 
 class _FunctionScanner(ast.NodeVisitor):
@@ -473,6 +489,327 @@ def check_lock_order(
     return findings
 
 
+# ---------------------------------------------------------------------------
+# LK007: inter-procedural may-hold-while-acquiring lock graph
+#
+# Precise-resolution-only by design: an edge exists only when the callee
+# is identified with certainty (same-class method, an attribute whose
+# constructing class we saw assigned, a same-module function).  Missing
+# an exotic call means a missed edge, never a false cycle — the right
+# bias for a gate that must stay clean on the real tree.
+
+
+def _qual(key: tuple) -> str:
+    """Human name for a function key ('c', Class, meth) / ('m', mod, fn)."""
+    if key[0] == "c":
+        return f"{key[1]}.{key[2]}"
+    return f"{key[1]}:{key[2]}"
+
+
+def _lock_id(expr: ast.expr, cls_name: str | None, module_key: str) -> str | None:
+    """Graph node for a ``with <expr>:`` item: ``Class.attr`` for
+    ``self.X`` members, ``module:name`` for globals; None if not a lock."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        if cls_name and _locky(expr.attr):
+            return f"{cls_name}.{expr.attr}"
+        return None
+    if isinstance(expr, ast.Attribute):
+        return f"{module_key}:{expr.attr}" if _locky(expr.attr) else None
+    if isinstance(expr, ast.Name):
+        return f"{module_key}:{expr.id}" if _locky(expr.id) else None
+    return None
+
+
+def _call_spec(call: ast.Call) -> tuple | None:
+    """Syntactic shape of a call we may be able to resolve."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ("bare", f.id)
+    if isinstance(f, ast.Attribute):
+        v = f.value
+        if isinstance(v, ast.Name) and v.id == "self":
+            return ("self", f.attr)
+        if (
+            isinstance(v, ast.Attribute)
+            and isinstance(v.value, ast.Name)
+            and v.value.id == "self"
+        ):
+            return ("attr", v.attr, f.attr)
+    return None
+
+
+class _LockGraph:
+    """Build per-function summaries over a set of sources, resolve calls,
+    and expose the held-while-acquiring edge set."""
+
+    def __init__(self, sources: list[tuple[str, str]]):
+        #: class name -> {module, file, methods, bases, attr_types}
+        self.classes: dict[str, dict] = {}
+        #: (module_key, name) -> summary key for module-level functions
+        self.mod_funcs: set[tuple[str, str]] = set()
+        #: function key -> summary dict
+        self.summaries: dict[tuple, dict] = {}
+        self._acq_memo: dict[tuple, dict] = {}
+
+        parsed = []
+        for source, filename in sources:
+            module_key = os.path.splitext(os.path.basename(filename))[0]
+            tree = ast.parse(source, filename=filename)
+            parsed.append((tree, filename, module_key))
+            for node in tree.body:
+                if isinstance(node, ast.ClassDef):
+                    methods = {
+                        m.name: m
+                        for m in node.body
+                        if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    }
+                    bases = [
+                        b.id if isinstance(b, ast.Name) else getattr(b, "attr", None)
+                        for b in node.bases
+                    ]
+                    self.classes[node.name] = {
+                        "module": module_key,
+                        "file": filename,
+                        "methods": methods,
+                        "bases": [b for b in bases if b],
+                        "attr_types": {},
+                    }
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.mod_funcs.add((module_key, node.name))
+
+        # second pass: attr types (self.x = Class(...)) + summaries
+        for tree, filename, module_key in parsed:
+            for node in tree.body:
+                if isinstance(node, ast.ClassDef):
+                    info = self.classes[node.name]
+                    for meth in info["methods"].values():
+                        self._collect_attr_types(meth, info)
+                    for mname, meth in info["methods"].items():
+                        self.summaries[("c", node.name, mname)] = self._summarize(
+                            meth, node.name, module_key, filename
+                        )
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.summaries[("m", module_key, node.name)] = self._summarize(
+                        node, None, module_key, filename
+                    )
+
+    def _collect_attr_types(self, fn: ast.AST, info: dict) -> None:
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+                continue
+            fexpr = node.value.func
+            cname = (
+                fexpr.id
+                if isinstance(fexpr, ast.Name)
+                else getattr(fexpr, "attr", None)
+            )
+            if cname not in self.classes:
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    info["attr_types"][tgt.attr] = cname
+
+    def _summarize(
+        self, fn: ast.AST, cls_name: str | None, module_key: str, filename: str
+    ) -> dict:
+        acquires: list[tuple[str, int]] = []
+        under: list[tuple[str, tuple, int]] = []  # (held, event, line)
+        calls: list[tuple[tuple, int]] = []
+
+        def walk(node: ast.AST, held: list[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue  # closures run at unknown lock states
+                new_held = held
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    got: list[str] = []
+                    for item in child.items:
+                        lid = _lock_id(item.context_expr, cls_name, module_key)
+                        if lid is not None:
+                            for h in held + got:
+                                under.append((h, ("acq", lid), child.lineno))
+                            got.append(lid)
+                            acquires.append((lid, child.lineno))
+                    new_held = held + got
+                elif isinstance(child, ast.Call):
+                    spec = _call_spec(child)
+                    if spec is not None:
+                        calls.append((spec, child.lineno))
+                        for h in held:
+                            under.append((h, ("call", spec), child.lineno))
+                walk(child, new_held)
+
+        walk(fn, [])
+        return {
+            "acquires": acquires,
+            "under": under,
+            "calls": calls,
+            "cls": cls_name,
+            "module": module_key,
+            "file": filename,
+        }
+
+    # -- call resolution ------------------------------------------------
+    def _method_on(self, cname: str | None, meth: str) -> tuple | None:
+        seen: set[str] = set()
+        while cname is not None and cname not in seen:
+            seen.add(cname)
+            info = self.classes.get(cname)
+            if info is None:
+                return None
+            if meth in info["methods"]:
+                return ("c", cname, meth)
+            bases = info["bases"]
+            cname = bases[0] if bases else None
+        return None
+
+    def resolve(self, spec: tuple, summary: dict) -> tuple | None:
+        if spec[0] == "self":
+            return self._method_on(summary["cls"], spec[1])
+        if spec[0] == "attr":
+            info = self.classes.get(summary["cls"] or "")
+            tc = info["attr_types"].get(spec[1]) if info else None
+            return self._method_on(tc, spec[2]) if tc else None
+        # bare name: constructor of a known class, or same-module function
+        name = spec[1]
+        if name in self.classes:
+            return self._method_on(name, "__init__")
+        if (summary["module"], name) in self.mod_funcs:
+            return ("m", summary["module"], name)
+        return None
+
+    # -- transitive acquisitions ----------------------------------------
+    def acq_star(self, key: tuple, _stack: set | None = None) -> dict:
+        """lock id -> witness call chain [(fn key, line), ...] for every
+        lock ``key`` may acquire, transitively."""
+        if key in self._acq_memo:
+            return self._acq_memo[key]
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            return {}
+        s = self.summaries.get(key)
+        if s is None:
+            return {}
+        out: dict[str, list] = {}
+        for lid, line in s["acquires"]:
+            out.setdefault(lid, [(key, line)])
+        stack.add(key)
+        for spec, line in s["calls"]:
+            callee = self.resolve(spec, s)
+            if callee is None:
+                continue
+            for lid, chain in self.acq_star(callee, stack).items():
+                out.setdefault(lid, [(key, line)] + chain)
+        stack.discard(key)
+        if not stack:  # memoize only complete (non-recursive) results
+            self._acq_memo[key] = out
+        return out
+
+    # -- the edge set ---------------------------------------------------
+    def edges(self) -> dict[tuple[str, str], tuple[str, int, str]]:
+        """(held, acquired) -> (file, line, witness description).  Edges
+        between the SAME lock id are skipped: distinct instances of one
+        class share an id here, so a self-edge is usually two objects."""
+        out: dict[tuple[str, str], tuple[str, int, str]] = {}
+        for key in sorted(self.summaries):
+            s = self.summaries[key]
+            for held, event, line in s["under"]:
+                if event[0] == "acq":
+                    lid = event[1]
+                    if lid != held:
+                        out.setdefault(
+                            (held, lid), (s["file"], line, f"in {_qual(key)}")
+                        )
+                    continue
+                callee = self.resolve(event[1], s)
+                if callee is None:
+                    continue
+                for lid, chain in self.acq_star(callee).items():
+                    if lid == held:
+                        continue
+                    via = " -> ".join(_qual(k) for k, _ in [(key, line)] + chain)
+                    out.setdefault((held, lid), (s["file"], line, f"via {via}"))
+        return out
+
+
+def _find_cycles(edges: "dict[tuple[str, str], tuple]") -> list[list[str]]:
+    """One representative cycle per distinct lock SET, deterministic."""
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    for v in adj.values():
+        v.sort()
+    cycles: list[list[str]] = []
+    seen_sets: set[frozenset] = set()
+    visited: set[str] = set()
+
+    def dfs(node: str, path: list[str], on_path: set[str]) -> None:
+        visited.add(node)
+        for b in adj.get(node, ()):
+            if b in on_path:
+                cyc = path[path.index(b) :] + [b]
+                key = frozenset(cyc)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(cyc)
+            elif b not in visited:
+                dfs(b, path + [b], on_path | {b})
+
+    for start in sorted(adj):
+        if start not in visited:
+            dfs(start, [start], {start})
+    return cycles
+
+
+def check_lock_graph(sources: list[tuple[str, str]]) -> list[Finding]:
+    """LK007 over a set of ``(source, filename)`` pairs: report every
+    cycle in the may-hold-while-acquiring graph with its full path."""
+    graph = _LockGraph(sources)
+    edges = graph.edges()
+    findings: list[Finding] = []
+    for cyc in _find_cycles(edges):
+        legs = []
+        first_file, first_line = "", 0
+        for a, b in zip(cyc, cyc[1:]):
+            f, line, desc = edges[(a, b)]
+            if not first_file:
+                first_file, first_line = f, line
+            legs.append(f"{a} -> {b} at {os.path.basename(f)}:{line} ({desc})")
+        findings.append(
+            Finding(
+                first_file,
+                first_line,
+                "LK007",
+                "potential deadlock: lock-order cycle "
+                + " -> ".join(cyc)
+                + "; "
+                + "; ".join(legs)
+                + "; break the cycle by imposing one global acquisition "
+                "order or releasing before the cross-call",
+            )
+        )
+    return findings
+
+
+#: directories whose every .py feeds the LK007 whole-repo lock graph
+LOCK_GRAPH_ROOTS = (
+    "pathway_tpu/engine",
+    "pathway_tpu/internals",
+    "pathway_tpu/stdlib/indexing",
+    "pathway_tpu/serving",
+)
+
+
 DEFAULT_TARGETS = (
     "pathway_tpu/engine/cluster.py",
     "pathway_tpu/engine/scheduler.py",
@@ -496,6 +833,23 @@ def main(argv: list[str] | None = None) -> int:
     for source, filename in sources:
         findings.extend(check_source(source, filename))
     findings.extend(check_lock_order(sources))
+
+    # LK007 runs over the whole lock surface, not just the per-file
+    # targets: explicit argv limits it to those files (tests), the
+    # default run walks LOCK_GRAPH_ROOTS
+    if args:
+        graph_sources = sources
+    else:
+        graph_sources = []
+        for root in LOCK_GRAPH_ROOTS:
+            base = os.path.join(repo_root, root)
+            for dirpath, _dirnames, filenames in os.walk(base):
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        p = os.path.join(dirpath, fn)
+                        with open(p, encoding="utf-8") as fh:
+                            graph_sources.append((fh.read(), p))
+    findings.extend(check_lock_graph(graph_sources))
     for fd in findings:
         print(fd.format())
     if findings:

@@ -11,10 +11,46 @@
 # SKIP and exits 0 so environments without it (including CI base
 # images) are not broken; exit 97 distinguishes the skip for callers
 # that want to require the tool.
+#
+# Before the ruff stage, a SELF-LINT stage runs with no external deps:
+# the repo's own analyzer (`cli lint --werror`) over every committed
+# example graph (accepted warnings baselined in lint_baseline.json,
+# never silenced in code) and the concurrency lint (check_locks.py,
+# including the LK007 whole-repo lock-order graph) over the full tree.
 set -uo pipefail
 
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 RULES="F63,F7,F82"
+
+# ---- self-lint stage (runs wherever the repo's own deps import) -------
+PYTHON=""
+for cand in python python3; do
+    if command -v "$cand" >/dev/null 2>&1 \
+        && "$cand" -c 'import jax, pathway_tpu' >/dev/null 2>&1; then
+        PYTHON="$cand"
+        break
+    fi
+done
+if [ -z "$PYTHON" ]; then
+    echo "lint_repo: no python with pathway_tpu importable, self-lint SKIP" >&2
+else
+    echo "lint_repo: self-lint stage" >&2
+    SELF_FAIL=0
+    for ex in "$REPO"/examples/*.py; do
+        if ! JAX_PLATFORMS=cpu "$PYTHON" -m pathway_tpu.cli lint --werror \
+            --baseline "$REPO/scripts/lint_baseline.json" "$ex"; then
+            SELF_FAIL=1
+        fi
+    done
+    if ! "$PYTHON" "$REPO/scripts/check_locks.py"; then
+        SELF_FAIL=1
+    fi
+    if [ "$SELF_FAIL" != "0" ]; then
+        echo "lint_repo: self-lint FAILED" >&2
+        exit 1
+    fi
+    echo "lint_repo: self-lint clean" >&2
+fi
 
 RUFF=""
 if command -v ruff >/dev/null 2>&1; then

@@ -56,3 +56,53 @@ JAX_PLATFORMS=cpu \
 python -m pytest "$REPO/tests/test_native.py" -q -p no:cacheprovider "$@"
 
 echo "sanitizer run clean" >&2
+
+# ---- ThreadSanitizer job (mirrors the ASan+UBSan one) -----------------
+# The extension's concurrency surface — the latency-histogram updates
+# and the exchange codec the multi-worker scheduler drives from several
+# threads — gets a separate -fsanitize=thread build: TSan and ASan
+# cannot share a process.  The uninstrumented interpreter again means
+# libtsan must be preloaded, and CPython's GIL-mediated accesses need a
+# suppressions file so only our extension's races report.
+LIBTSAN="$(g++ -print-file-name=libtsan.so)"
+if [ ! -e "$LIBTSAN" ]; then
+    echo "libtsan.so not found; SKIP ThreadSanitizer job" >&2
+    exit 0
+fi
+
+TSAN_OUT="$BUILD/pathway_native_tsan.so"
+echo "building $TSAN_OUT with -fsanitize=thread" >&2
+g++ -O1 -g -fno-omit-frame-pointer \
+    -fsanitize=thread \
+    -shared -fPIC -std=c++17 \
+    -I"$INCLUDE" "$SRC" -o "$TSAN_OUT"
+
+TSAN_SUPP="$BUILD/tsan_suppressions.txt"
+cat > "$TSAN_SUPP" <<'EOF'
+# CPython serialises through the GIL with synchronisation TSan cannot
+# see (it is uninstrumented), so interpreter internals false-positive.
+race:Py
+race:_Py
+race:pymalloc
+race:libpython
+# numpy's uninstrumented internals, same story
+race:_multiarray_umath
+race:numpy
+# glibc's dynamic loader / thread bootstrap
+race:ld-linux
+called_from_lib:libpython
+called_from_lib:_multiarray_umath
+EOF
+
+# concurrency-relevant subset: histogram/exchange/groupby-partial paths
+# that the threaded scheduler exercises from multiple workers
+echo "running concurrency-native tests under TSan" >&2
+LD_PRELOAD="$LIBTSAN" \
+TSAN_OPTIONS="suppressions=$TSAN_SUPP:halt_on_error=1:report_signal_unsafe=0" \
+PATHWAY_NATIVE_SO="$TSAN_OUT" \
+JAX_PLATFORMS=cpu \
+python -m pytest "$REPO/tests/test_native.py" -q -p no:cacheprovider \
+    -k "hash_parity or scan_lines or consolidate or per_key_changes or groupby_partials or multiset_reducer" \
+    "$@"
+
+echo "thread-sanitizer run clean" >&2

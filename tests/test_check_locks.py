@@ -278,6 +278,82 @@ def test_lk006_not_applied_outside_serving_paths(cl):
     assert [f.code for f in findings] == ["LK006"]
 
 
+_LK007_CYCLE = (
+    "import threading\n"
+    "class Store:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.idx = Index()\n"
+    "    def put(self):\n"
+    "        with self._lock:\n"
+    "            self.idx.refresh()\n"
+    "class Index:\n"
+    "    def __init__(self):\n"
+    "        self._main_mutex = threading.Lock()\n"
+    "        self.store = None\n"
+    "    def attach(self, s):\n"
+    "        self.store = Store()\n"
+    "    def refresh(self):\n"
+    "        with self._main_mutex:\n"
+    "            pass\n"
+    "    def merge(self):\n"
+    "        with self._main_mutex:\n"
+    "            self.store.put()\n"
+)
+
+
+def test_lk007_planted_cycle_flagged(cl):
+    """``Store.put`` holds ``Store._lock`` while (transitively) taking
+    ``Index._main_mutex``; ``Index.merge`` nests the other way round."""
+    findings = cl.check_lock_graph([(_LK007_CYCLE, "plant.py")])
+    assert [f.code for f in findings] == ["LK007"]
+    msg = findings[0].message
+    assert "Store._lock" in msg and "Index._main_mutex" in msg
+    # the full lock-order path names each edge's acquisition site
+    assert "plant.py" in msg and "via" in msg
+
+
+def test_lk007_consistent_global_order_clean(cl):
+    # same classes, but merge() calls put() OUTSIDE the mutex: both
+    # paths then acquire Store._lock before Index._main_mutex
+    src = _LK007_CYCLE.replace(
+        "    def merge(self):\n"
+        "        with self._main_mutex:\n"
+        "            self.store.put()\n",
+        "    def merge(self):\n"
+        "        self.store.put()\n"
+        "        with self._main_mutex:\n"
+        "            pass\n",
+    )
+    assert cl.check_lock_graph([(src, "plant.py")]) == []
+
+
+def test_lk007_same_lock_reentry_not_a_cycle(cl):
+    # two instances of one class taking each other's (same-named) lock
+    # is a same-id self-edge, which instance-blind analysis must skip
+    src = (
+        "import threading\n"
+        "class Shard:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.peer = None\n"
+        "    def attach(self):\n"
+        "        self.peer = Shard()\n"
+        "    def pull(self):\n"
+        "        with self._lock:\n"
+        "            self.peer.push()\n"
+        "    def push(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    assert cl.check_lock_graph([(src, "plant.py")]) == []
+
+
+def test_lk007_whole_repo_roots_exist(cl):
+    for root in cl.LOCK_GRAPH_ROOTS:
+        assert (REPO / root).is_dir(), root
+
+
 def test_engine_files_clean():
     """The shipped cluster/scheduler must satisfy the discipline; this
     is the gate that keeps future edits honest."""

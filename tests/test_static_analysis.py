@@ -4,6 +4,7 @@ and a near-miss, plus the strict-mode abort-before-connectors gate."""
 
 from __future__ import annotations
 
+import pathlib
 import threading
 
 import pytest
@@ -15,7 +16,11 @@ from pathway_tpu.analysis import (
     AnalysisError,
     analyze,
 )
+from pathway_tpu.engine import graph as eg
 from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.parse_graph import G
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
 def codes(diags):
@@ -303,3 +308,324 @@ def test_package_exports():
     assert pw.analyze is analyze
     assert pw.Diagnostic is not None
     assert pw.AnalysisError is AnalysisError
+
+
+# ------------------------------------------------- distribution helpers
+
+
+def _files_table(tmp_path):
+    """Byte-range-partitioned, non-order-preserving source (PR 9 split)."""
+    d = tmp_path / "data"
+    d.mkdir(exist_ok=True)
+    (d / "part.jsonl").write_text(
+        '{"word": "a", "n": 1}\n{"word": "b", "n": 2}\n'
+    )
+
+    class S(pw.Schema):
+        word: str
+        n: int
+
+    return pw.io.jsonlines.read(str(d), schema=S, mode="static")
+
+
+def _input_node():
+    return next(
+        n for n in G.engine_graph.nodes if isinstance(n, eg.InputNode)
+    )
+
+
+# ---------------------------------------------------------------- X001
+
+
+def test_x001_dedup_over_byte_range_files(tmp_path):
+    t = _files_table(tmp_path)
+    t.deduplicate(value=t.n, acceptor=lambda new, old: new > old)
+    diags = analyze()
+    x001 = [d for d in diags if d.code == "PW-X001"]
+    assert x001 and x001[0].severity == SEV_ERROR
+    assert "order" in x001[0].message
+
+
+def test_x001_index_upsert_over_byte_range_files(tmp_path):
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    docs = _files_table(tmp_path)
+    docs = docs.select(
+        word=pw.this.word,
+        vec=pw.apply(lambda n: (float(n), 0.0), pw.this.n),
+    )
+    index = BruteForceKnnFactory(dimensions=2, reserved_space=8).build_data_index(
+        docs.vec, docs
+    )
+
+    class QueryS(pw.Schema):
+        qx: float
+        qy: float
+
+    queries = pw.io.python.read(_Subject(), schema=QueryS)
+    queries = queries.select(
+        qvec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.qx, pw.this.qy)
+    )
+    # the index node only materializes once a query consumes it
+    index.query_as_of_now(queries.qvec, number_of_matches=1)
+    assert "PW-X001" in codes(analyze())
+
+
+def test_x001_python_fed_index_upsert_clean():
+    """The ISSUE near-miss: a ``pw.io.python``-fed upsert stream is a
+    single reader, so the keyed index upsert must NOT fire PW-X001."""
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    class DocS(pw.Schema):
+        doc_id: str = pw.column_definition(primary_key=True)
+        vx: float
+        vy: float
+
+    docs = pw.io.python.read(_Subject(), schema=DocS)
+    docs = docs.select(
+        doc_id=pw.this.doc_id,
+        vec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.vx, pw.this.vy),
+    )
+    BruteForceKnnFactory(dimensions=2, reserved_space=8).build_data_index(
+        docs.vec, docs
+    )
+    assert "PW-X001" not in codes(analyze())
+
+
+def test_x001_python_fed_dedup_clean():
+    t = _streaming_table()
+    t.deduplicate(value=t.n, acceptor=lambda new, old: new > old)
+    assert "PW-X001" not in codes(analyze())
+
+
+def test_x001_unordered_partitioned_upsert_source():
+    """The source itself is the order-sensitive consumer when it dedups
+    an upsert session across an unordered split."""
+    _streaming_table()
+    _input_node().meta["source"].update(
+        {"upsert": True, "partitioning": "round-robin", "order_preserving": False}
+    )
+    diags = analyze()
+    x001 = [d for d in diags if d.code == "PW-X001"]
+    assert x001 and x001[0].severity == SEV_ERROR
+    assert "upsert" in x001[0].message
+
+
+# ---------------------------------------------------------------- X002
+
+
+def test_x002_non_copartitioned_groupby(tmp_path):
+    t = _files_table(tmp_path)
+    t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    diags = analyze()
+    x002 = [d for d in diags if d.code == "PW-X002"]
+    assert x002 and x002[0].severity == SEV_WARNING
+    assert "exchange" in x002[0].message
+    # volume estimate comes from the source's build-time dtype annotation
+    assert "bytes/row" in x002[0].message
+
+
+def test_x002_copartitioned_regroup_clean(tmp_path):
+    """A second groupby on the first one's key is already co-partitioned:
+    only the first (source-fed) groupby warns."""
+    t = _files_table(tmp_path)
+    agg = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    agg.groupby(agg.word).reduce(agg.word, m=pw.reducers.max(agg.c))
+    diags = analyze()
+    x002 = [d for d in diags if d.code == "PW-X002"]
+    assert len(x002) == 1
+
+
+def test_x002_local_source_clean():
+    t = _streaming_table()
+    t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    assert "PW-X002" not in codes(analyze())
+
+
+# ---------------------------------------------------------------- X003
+
+
+def test_x003_order_dependent_reducer_to_sink(tmp_path):
+    t = _files_table(tmp_path)
+    agg = t.groupby(t.word).reduce(t.word, last=pw.reducers.latest(t.n))
+    agg._capture_node()
+    diags = analyze()
+    x003 = [d for d in diags if d.code == "PW-X003"]
+    assert x003 and x003[0].severity == SEV_ERROR
+    assert "latest" in x003[0].message
+
+
+def test_x003_commutative_reducer_clean(tmp_path):
+    t = _files_table(tmp_path)
+    agg = t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    agg._capture_node()
+    assert "PW-X003" not in codes(analyze())
+
+
+def test_x003_ordered_source_clean():
+    t = _streaming_table()
+    agg = t.groupby(t.word).reduce(t.word, last=pw.reducers.latest(t.n))
+    agg._capture_node()
+    assert "PW-X003" not in codes(analyze())
+
+
+# ---------------------------------------------------------------- R001
+
+
+def test_r001_external_state_without_hooks():
+    t = _streaming_table()
+    node = eg.Node(G.engine_graph, [t._node], "external_sink")
+    node.adapter = object()
+    diags = analyze()
+    r001 = [d for d in diags if d.code == "PW-R001"]
+    assert r001 and r001[0].severity == SEV_ERROR
+    assert "checkpoint" in r001[0].message
+
+
+class _StatefulAdapter:
+    def state_dict(self):
+        return {}
+
+    def load_state_dict(self, state):
+        pass
+
+
+class _HookedNode(eg.Node):
+    def snapshot_state(self, ctx):
+        return {}
+
+    def on_restore(self, ctx):
+        pass
+
+
+def test_r001_hooked_external_state_clean():
+    t = _streaming_table()
+    node = _HookedNode(G.engine_graph, [t._node], "hooked_sink")
+    node.adapter = _StatefulAdapter()
+    assert "PW-R001" not in codes(analyze())
+
+
+def test_r001_unserializable_adapter_flagged():
+    """Hooks overridden but the adapter cannot round-trip its state:
+    snapshot_state has nothing to fold in, still a coverage hole."""
+    t = _streaming_table()
+    node = _HookedNode(G.engine_graph, [t._node], "hooked_sink")
+    node.adapter = object()
+    diags = analyze()
+    r001 = [d for d in diags if d.code == "PW-R001"]
+    assert r001 and "state_dict" in r001[0].message
+
+
+def test_r001_static_path_clean():
+    """Out-of-band state on a static (bounded, replayable-from-source)
+    path is not a recovery hazard."""
+    t = _static_table()
+    node = eg.Node(G.engine_graph, [t._node], "static_sink")
+    node.adapter = object()
+    assert "PW-R001" not in codes(analyze())
+
+
+# ---------------------------------------------- registry + docs (sat 1)
+
+
+def test_registry_is_single_source_of_truth():
+    from pathway_tpu.analysis.diagnostics import CODE_INFO, CODES, render_code_table
+
+    table = render_code_table()
+    for code, (sev, desc) in CODE_INFO.items():
+        assert CODES[code] == sev
+        assert code in table and sev in table
+        assert desc  # every code carries a human description
+    for code in ("PW-X001", "PW-X002", "PW-X003", "PW-R001"):
+        assert code in CODE_INFO
+
+    import pathway_tpu.analysis.diagnostics as diag_mod
+
+    for code in CODE_INFO:
+        assert code in (diag_mod.__doc__ or ""), code
+
+
+def test_readme_documents_every_code():
+    readme = (REPO / "README.md").read_text()
+    from pathway_tpu.analysis.diagnostics import CODE_INFO
+
+    for code in CODE_INFO:
+        assert f"`{code}`" in readme, f"{code} missing from README table"
+
+
+# ----------------------------------------------- acceptance graphs
+
+
+def test_wordcount_graph_zero_errors(tmp_path):
+    t = _files_table(tmp_path)
+    counts = t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+    counts._capture_node()
+    diags = analyze()
+    assert not [d for d in diags if d.severity == SEV_ERROR], diags
+
+
+def test_index_churn_graph_zero_errors():
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    class DocS(pw.Schema):
+        doc_id: str = pw.column_definition(primary_key=True)
+        vx: float
+        vy: float
+
+    class QueryS(pw.Schema):
+        qid: str = pw.column_definition(primary_key=True)
+        qx: float
+        qy: float
+
+    docs = pw.io.python.read(_Subject(), schema=DocS)
+    docs = docs.select(
+        doc_id=pw.this.doc_id,
+        vec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.vx, pw.this.vy),
+    )
+    queries = pw.io.python.read(_Subject(), schema=QueryS)
+    queries = queries.select(
+        qid=pw.this.qid,
+        qvec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.qx, pw.this.qy),
+    )
+    index = BruteForceKnnFactory(dimensions=2, reserved_space=8).build_data_index(
+        docs.vec, docs
+    )
+    index.query_as_of_now(queries.qvec, number_of_matches=2)._capture_node()
+    diags = analyze()
+    assert not [d for d in diags if d.severity == SEV_ERROR], diags
+
+
+def test_rag_serving_graph_zero_errors():
+    from pathway_tpu.serving import RagServingApp, TenantPolicy
+
+    app = RagServingApp(
+        {"t": TenantPolicy("interactive", rate_per_s=10.0, burst=4, queue_cap=8)},
+        embed_dim=8,
+        delta_cap=8,
+        auto_merge=False,
+    )
+    app.build()
+    try:
+        diags = analyze()
+        assert not [d for d in diags if d.severity == SEV_ERROR], diags
+        # satellite 2: serving nodes carry build-time stage annotations
+        stages = {
+            n.meta["serving"]["stage"]
+            for n in G.engine_graph.nodes
+            if "serving" in n.meta
+        }
+        assert {"ingest", "chunk", "index-upsert"} <= stages
+    finally:
+        app.close()
+
+
+def test_strict_mode_surfaces_distribution_errors(tmp_path):
+    t = _files_table(tmp_path)
+    t.deduplicate(value=t.n, acceptor=lambda new, old: new > old)
+    with pytest.raises(AnalysisError) as ei:
+        pw.run(strict=True)
+    assert any(d.code == "PW-X001" for d in ei.value.diagnostics)
+    from pathway_tpu.analysis import count_by_severity
+
+    counts = count_by_severity(ei.value.diagnostics)
+    assert counts.get("error", 0) >= 1  # the /status + metrics payload
