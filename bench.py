@@ -1097,6 +1097,144 @@ def bench_rag_serving(extra: dict) -> None:
             )
 
 
+def bench_failover(extra: dict) -> None:
+    """Partial-failure survival (ISSUE 13): availability while one of two
+    shard owners is dead, and the per-shard failover time (snapshot
+    restore + exactly-once oplog tail replay) vs the whole-generation
+    recovery path ``bench_cluster_recovery`` measures — the number that
+    justifies per-rank restart over tearing the mesh down."""
+    from pathway_tpu.serving import HashingEmbedder, StageCoScheduler
+    from pathway_tpu.serving.failover import PartitionedIndex
+    from pathway_tpu.serving.loadgen import percentile
+    from pathway_tpu.stdlib.indexing.hnsw import HnswIndex
+    from pathway_tpu.stdlib.indexing.segments import SegmentedIndex
+
+    dim = 32
+    n_docs = 120 if SMOKE else 240
+    healthy_s, outage_s, recovered_s = (
+        (0.4, 0.4, 0.3) if SMOKE else (0.8, 0.8, 0.5)
+    )
+    rng = np.random.default_rng(31)
+    part = PartitionedIndex(
+        lambda: SegmentedIndex(
+            HnswIndex(dim, metric="cos"), delta_cap=64, auto_merge=False
+        ),
+        n_shards=2,
+        snapshot_every=64,
+    )
+    co = StageCoScheduler(
+        embedder=HashingEmbedder(dim=dim), index=part, k=4, lookahead=True
+    )
+    vocab = ["solar", "merge", "slab", "tail", "bucket", "chunk", "probe", "lane"]
+    try:
+        part.add(
+            [
+                (
+                    f"doc{i}",
+                    HashingEmbedder(dim=dim)(
+                        " ".join(rng.choice(vocab) for _ in range(12))
+                    ),
+                )
+                for i in range(n_docs)
+            ]
+        )
+        co.submit("bucket probe lane").result(timeout=30)  # warm the lanes
+
+        def load_phase(seconds: float) -> dict:
+            ok: list[dict] = []
+            errors = 0
+            deadline = time.perf_counter() + seconds
+            i = 0
+            while time.perf_counter() < deadline:
+                fut = co.submit(f"{vocab[i % len(vocab)]} probe {i}")
+                try:
+                    ok.append(fut.result(timeout=10))
+                except Exception:  # noqa: BLE001 — counted, not masked
+                    errors += 1
+                i += 1
+            lat = [r["latency_ms"] for r in ok]
+            n = len(ok) + errors
+            return {
+                "responses": n,
+                "availability": round(len(ok) / max(n, 1), 4),
+                "partial_fraction": round(
+                    sum(1 for r in ok if r["partial"]) / max(len(ok), 1), 4
+                ),
+                "p50_ms": round(percentile(lat, 50.0), 3) if lat else None,
+                "p99_ms": round(percentile(lat, 99.0), 3) if lat else None,
+            }
+
+        healthy = load_phase(healthy_s)
+        part.fail_shard(1)  # one owner dies; survivors keep answering
+        # writes during the outage land in the dead owner's oplog and
+        # must survive the restore via the exactly-once tail replay
+        part.add(
+            [
+                (
+                    f"late{j}",
+                    HashingEmbedder(dim=dim)(
+                        " ".join(rng.choice(vocab) for _ in range(12))
+                    ),
+                )
+                for j in range(32)
+            ]
+        )
+        outage = load_phase(outage_s)
+        failover_s = part.recover_shard(1)
+        recovered = load_phase(recovered_s)
+
+        owner = part.owners[1]
+        generation_s = extra.get("cluster_recovery_seconds")
+        extra["failover_phases"] = {
+            "healthy": healthy,
+            "outage": outage,
+            "recovered": recovered,
+        }
+        extra["failover_seconds"] = round(failover_s, 4)
+        extra["failover_tail_replayed"] = owner.tail_replayed
+        extra["failover_outage_availability"] = outage["availability"]
+        extra["failover_degraded_fraction"] = outage["partial_fraction"]
+        if generation_s:
+            extra["failover_vs_generation_speedup"] = round(
+                generation_s / max(failover_s, 1e-9), 2
+            )
+        log(
+            f"failover: outage availability {outage['availability']:.3f} "
+            f"(partial {outage['partial_fraction']:.0%}, p99 "
+            f"{outage['p99_ms']}ms), shard restore {failover_s * 1e3:.1f}ms"
+            + (
+                f" vs whole-generation {generation_s:.3f}s "
+                f"({extra['failover_vs_generation_speedup']}x)"
+                if generation_s
+                else ""
+            )
+        )
+        if SMOKE:
+            if outage["availability"] < 1.0:
+                raise RuntimeError(
+                    f"queries errored during the outage window "
+                    f"(availability {outage['availability']:.3f}) — degraded "
+                    "serving must answer partial, never 5xx"
+                )
+            if outage["partial_fraction"] <= 0.0:
+                raise RuntimeError(
+                    "no response reported partial coverage with a dead "
+                    "shard — the partial-result contract is not surfacing"
+                )
+            if recovered["partial_fraction"] > 0.0:
+                raise RuntimeError(
+                    "responses still partial after the shard recovered"
+                )
+            if generation_s and failover_s >= generation_s:
+                raise RuntimeError(
+                    f"per-shard failover ({failover_s:.3f}s) not faster than "
+                    f"whole-generation recovery ({generation_s:.3f}s)"
+                )
+    finally:
+        co.close()
+        part.close()
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -1136,6 +1274,7 @@ def main() -> None:
         (bench_cluster_recovery, "cluster_recovery"),
         (bench_index_churn, "index_churn"),
         (bench_rag_serving, "rag_serving"),
+        (bench_failover, "failover"),
     ]
     if not SMOKE:
         sections += [

@@ -8,7 +8,11 @@ distribution-safety pass (PW-X001) stays quiet; swap the feed for a
 byte-range file source and it would not.  Lintable without running:
 ``python -m pathway_tpu.cli lint examples/index_churn.py`` (accepted
 warnings in ``scripts/lint_baseline.json``: the embedding ``pw.apply``
-is a Python fallback on the hot path, PW-P001).
+is a Python fallback on the hot path, PW-P001; the KNN index is
+deliberately a single unsharded owner — the point here is the
+delta/merge path, not availability — so the single-owner-no-standby
+warning PW-R002 is accepted rather than fixed with
+``serving.PartitionedIndex``).
 """
 
 import pathway_tpu as pw

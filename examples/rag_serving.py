@@ -5,9 +5,12 @@ doc feed → splitter → keyed upsert into a churn-safe SegmentedIndex —
 into the global graph so ``pw.analyze()`` / ``cli lint`` can verify it:
 the serving nodes carry ``meta["serving"]`` stage annotations and the
 sink declares itself a keyed index upsert, which PW-X001 checks against
-the (order-preserving, single-reader) feed.  Accepted warnings live in
-``scripts/lint_baseline.json`` (the splitter ``pw.apply`` is a Python
-fallback on the hot path, PW-P001).
+the (order-preserving, single-reader) feed.  The index is sharded
+across two snapshot-backed owners (``shards=2``), so a dead owner
+degrades answers (``partial: true``) instead of taking the query
+surface down — which is also what keeps PW-R002 quiet.  Accepted
+warnings live in ``scripts/lint_baseline.json`` (the splitter
+``pw.apply`` is a Python fallback on the hot path, PW-P001).
 """
 
 import pathway_tpu as pw  # noqa: F401  (pw.run is what the lint stubs)
@@ -18,6 +21,7 @@ app = RagServingApp(
     embed_dim=16,
     delta_cap=32,
     auto_merge=False,
+    shards=2,
 )
 app.build()
 app.close()

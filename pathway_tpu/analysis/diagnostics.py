@@ -78,6 +78,13 @@ CODE_INFO: dict[str, tuple[str, str]] = {
         "snapshot_state/on_restore coverage: a checkpoint-coverage hole "
         "that duplicates work on replay",
     ),
+    "PW-R002": (
+        SEV_WARNING,
+        "single-owner stateful serving/index node with no snapshot-backed "
+        "standby: one rank's death takes the whole query surface down "
+        "until recovery completes (an availability hole degraded serving "
+        "cannot cover)",
+    ),
 }
 
 #: every code the analyzer can emit, with its fixed severity (derived —

@@ -34,6 +34,13 @@ four checks read it:
 - PW-R001 (error): node holding out-of-band state (adapter/writer) whose
   class overrides neither ``snapshot_state`` nor ``on_restore`` — a
   checkpoint-coverage hole that duplicates work on replay.
+- PW-R002 (warning): single-owner stateful serving/index node with no
+  snapshot-backed standby — correctness survives a crash (PW-R001's
+  territory) but *availability* does not: every query against it fails
+  until recovery completes.  Shard the index
+  (:class:`~pathway_tpu.serving.failover.PartitionedIndex`) or stamp
+  ``node.meta["failover"] = {"standby": True}`` once a snapshot-backed
+  standby actually serves during recovery.
 """
 
 from __future__ import annotations
@@ -334,6 +341,7 @@ def check_distribution(
                     )
 
     out.extend(_check_recovery_coverage(graph, facts))
+    out.extend(_check_failover_coverage(graph, facts))
     return out
 
 
@@ -420,4 +428,61 @@ def _check_recovery_coverage(
                     adapter=type(adapter).__name__,
                 )
             )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PW-R002: single-owner serving state with no standby
+
+
+def _check_failover_coverage(
+    graph: eg.EngineGraph, facts: GraphFacts
+) -> list[Diagnostic]:
+    """PW-R001 is about *correctness* after a crash; this is about
+    *availability* during one.  A stateful serving/index node whose whole
+    state lives on a single rank (SINGLE placement or a route-to-zero
+    operator) is a query-surface single point of failure: per-rank
+    failover restarts it, but every probe routed to it fails until the
+    snapshot restore + tail replay finishes.  A snapshot-backed standby
+    (or sharding the index across owners — ``PartitionedIndex``) keeps
+    answers flowing, degraded, through that window; graphs that wired one
+    up declare it via ``node.meta["failover"]["standby"]``."""
+    dist = facts.distribution
+    out: list[Diagnostic] = []
+    for n in graph.nodes:
+        if n.id not in facts.streaming:
+            continue
+        cls = type(n).__name__
+        single_owner = (
+            dist.placement.get(n.id, SINGLE) == SINGLE or cls in _ROUTE_TO_ZERO
+        )
+        if not single_owner:
+            continue
+        adapter = getattr(n, "adapter", None)
+        stateful_serving = (
+            bool(n.meta.get("index_upsert"))
+            or bool(n.meta.get("index"))
+            or (
+                adapter is not None
+                and hasattr(adapter, "state_dict")
+                and hasattr(adapter, "load_state_dict")
+            )
+        )
+        if not stateful_serving:
+            continue
+        if n.meta.get("failover", {}).get("standby"):
+            continue  # a snapshot-backed standby covers the window
+        out.append(
+            _diag(
+                "PW-R002",
+                SEV_WARNING,
+                f"{cls} holds the only copy of serving/index state on one "
+                "rank with no snapshot-backed standby: if that rank dies, "
+                "every query against it fails until restore + tail replay "
+                "completes; shard it (serving.PartitionedIndex) or attach "
+                'a standby and stamp meta["failover"]["standby"]',
+                n,
+                placement="single",
+            )
+        )
     return out

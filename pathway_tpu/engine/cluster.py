@@ -40,13 +40,32 @@ infinite ``recv``: every sender emits an empty heartbeat transmission when
 its link has been idle for ``PATHWAY_CLUSTER_HEARTBEAT_S`` (riding the
 existing framing — ``body_len=4, n_msgs=0`` decodes to zero deposits), and
 every reader runs its socket with a finite timeout so it can check a
-per-peer liveness deadline (``PATHWAY_CLUSTER_LIVENESS_TIMEOUT_S``).  A
-peer that goes silent past the deadline — or whose socket dies — fails the
-whole local mesh: ``_fail`` closes every socket so the failure propagates
-to all peers as EOFs within one io tick, and notifies the WakeupHub so
-parked workers observe it immediately.  The reference behaves the same (a
-worker panic aborts the cluster, ``dataflow.rs:5533-5536``); recovery is
-restart-from-persistence (see ``internals/resilience.ClusterSupervisor``).
+per-peer liveness deadline (``PATHWAY_CLUSTER_LIVENESS_TIMEOUT_S``).
+
+What happens next is the **fail policy** (``fail_policy=`` /
+``PATHWAY_CLUSTER_FAIL_POLICY``):
+
+- ``"together"`` (default, the reference semantics — a worker panic
+  aborts the cluster, ``dataflow.rs:5533-5536``): a peer silent past the
+  deadline — or whose socket dies — fails the whole local mesh.
+  ``_fail`` closes every socket so the failure propagates to all peers
+  as EOFs within one io tick, and notifies the WakeupHub so parked
+  workers observe it immediately.  Recovery is restart-from-persistence
+  (``internals/resilience.ClusterSupervisor``).
+- ``"isolate"`` (fail-domain isolation, ISSUE 13): membership is
+  per-peer.  Every peer carries an ``alive``/``suspect``/``dead`` state
+  — half a liveness window of silence marks it *suspect* (observable,
+  still served), a full window marks it *dead*.  ``_fail_peer``
+  quiesces only the links and exchange routes touching the dead peer:
+  its sender stops, its socket closes, its undelivered frames are
+  purged from the inbox, and the WakeupHub is notified so nobody blocks
+  on it — ``recv_from_all`` then waits only on peers that are still
+  alive.  Links are *incarnation-versioned*: the dial handshake carries
+  ``(process_id, incarnation)``, a replacement rank rejoins by dialing
+  every survivor with a higher incarnation (the persistent accept loop
+  admits it, replacing the dead link), and frames from a stale
+  incarnation are rejected instead of deposited — a zombie of the old
+  rank cannot corrupt the rejoined mesh.
 """
 
 from __future__ import annotations
@@ -63,7 +82,14 @@ from typing import Any, Callable
 from pathway_tpu.internals import keys as K
 from pathway_tpu.internals import native as _native_mod
 
-__all__ = ["Cluster", "WakeupHub", "stable_shard"]
+__all__ = [
+    "Cluster",
+    "WakeupHub",
+    "stable_shard",
+    "PEER_ALIVE",
+    "PEER_SUSPECT",
+    "PEER_DEAD",
+]
 
 
 def _env_float(name: str, default: float) -> float:
@@ -71,6 +97,21 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+#: per-peer membership states (isolate fail policy).  A peer is *suspect*
+#: after half a liveness window of silence — still served, but hedgeable by
+#: layers above — and *dead* after a full window or a socket error.
+PEER_ALIVE = "alive"
+PEER_SUSPECT = "suspect"
+PEER_DEAD = "dead"
 
 
 #: idle-link heartbeat period (seconds); each heartbeat is an empty
@@ -155,6 +196,9 @@ class _PeerSender(threading.Thread):
         self.peer = peer
         self.sock = sock
         self.links = links
+        #: which incarnation of this peer's link the sender serves; a
+        #: replaced link's sender dying must not kill the replacement
+        self.link_version = 0
         self._q: deque = deque()
         self._cv = threading.Condition()
         # NB: not "_stop" — that shadows threading.Thread._stop(),
@@ -204,7 +248,11 @@ class _PeerSender(threading.Thread):
                     links.stats["pack_ms"] += (t1 - t0) * 1e3
                 self._transmit(body, len(items))
         except Exception as e:  # socket OR encode failure: fail loudly
-            links._fail(f"send link to process {self.peer} lost: {e!r}")
+            links._fail_peer(
+                self.peer,
+                self.link_version,
+                f"send link to process {self.peer} lost: {e!r}",
+            )
 
     def _transmit(self, body: bytes | bytearray, n_frames: int) -> None:
         """Ship one already-encoded transmission (``n_frames == 0`` marks a
@@ -305,10 +353,28 @@ class _ProcessLinks:
         hub: "WakeupHub | None" = None,
         heartbeat_s: float | None = None,
         liveness_timeout_s: float | None = None,
+        fail_policy: str | None = None,
+        incarnation: int | None = None,
     ):
         self.process_id = process_id
         self.n_processes = n_processes
         self._hub = hub
+        self.fail_policy = fail_policy or os.environ.get(
+            "PATHWAY_CLUSTER_FAIL_POLICY", ""
+        ) or "together"
+        if self.fail_policy not in ("together", "isolate"):
+            raise ValueError(
+                f"fail_policy must be 'together' or 'isolate', "
+                f"got {self.fail_policy!r}"
+            )
+        #: this process's incarnation: 0 at first boot, bumped by the
+        #: supervisor for each per-rank replacement (the dial handshake
+        #: carries it so survivors can tell a rejoin from a zombie)
+        self.incarnation = (
+            incarnation
+            if incarnation is not None
+            else _env_int("PATHWAY_CLUSTER_INCARNATION", 0)
+        )
         self.heartbeat_s = (
             heartbeat_s
             if heartbeat_s is not None
@@ -332,6 +398,16 @@ class _ProcessLinks:
         self._inbox: dict[Any, dict[int, Any]] = {}
         self._cv = threading.Condition()
         self._failed: str | None = None
+        self._closed = False
+        self._running = False  # mesh built: admissions start links inline
+        #: membership tables (isolate policy; benign defaults otherwise)
+        self._peer_state: dict[int, str] = {}
+        self._peer_incarnation: dict[int, int] = {}
+        self._dead_reason: dict[int, str] = {}
+        #: local link version per peer, bumped each time the peer's socket
+        #: is replaced — readers/senders tag themselves with it so frames
+        #: and errors from a superseded link are rejected, not believed
+        self._link_version: dict[int, int] = {}
         self.stats: dict[str, Any] = {
             "transmissions": 0,
             "frames_sent": 0,
@@ -339,6 +415,9 @@ class _ProcessLinks:
             "heartbeats_sent": 0,
             "bytes_sent": 0,
             "bytes_recv": 0,
+            "stale_frames_dropped": 0,
+            "peers_declared_dead": 0,
+            "peers_rejoined": 0,
             "pack_ms": 0.0,
             "send_ms": 0.0,
             "unpack_ms": 0.0,
@@ -351,33 +430,45 @@ class _ProcessLinks:
         listener.listen(n_processes)
         self._listener = listener
 
-        accept_thread = threading.Thread(
-            target=self._accept_peers, args=(listener,), daemon=True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, args=(listener,), daemon=True,
+            name=f"pw-cluster-accept-{process_id}",
         )
-        accept_thread.start()
-        # dial every lower pid (it is already listening or will be soon)
-        for peer in range(process_id):
-            self._socks[peer] = self._dial(peer, first_port)
-        accept_thread.join(self._CONNECT_TIMEOUT_S)
-        if len(self._socks) != n_processes - 1:
+        self._accept_thread.start()
+        if self.incarnation == 0:
+            # first boot: dial every lower pid (it is already listening or
+            # will be soon); higher pids dial in via the accept loop
+            dial_targets = range(process_id)
+        else:
+            # rejoin (per-rank replacement): every survivor's mesh is
+            # already built, so nobody will dial us — dial them ALL, with
+            # our incarnation in the handshake so they admit the rejoin
+            dial_targets = (
+                p for p in range(n_processes) if p != process_id
+            )
+        for peer in dial_targets:
+            self._admit_peer(peer, self._dial(peer, first_port), 0)
+        deadline = _time.monotonic() + self._CONNECT_TIMEOUT_S
+        with self._cv:
+            while len(self._socks) < n_processes - 1:
+                left = deadline - _time.monotonic()
+                if left <= 0.0:
+                    break
+                self._cv.wait(min(left, 0.2))
+            complete = len(self._socks) == n_processes - 1
+        if not complete:
             raise RuntimeError(
                 f"process {process_id}: cluster mesh incomplete "
                 f"({len(self._socks)}/{n_processes - 1} peers)"
             )
         now = _time.monotonic()
-        for peer, sock in self._socks.items():
-            self._last_seen[peer] = now
-            sender = _PeerSender(peer, sock, self)
-            self._senders[peer] = sender
-            sender.start()
-            reader = threading.Thread(
-                target=self._read_loop,
-                args=(peer, sock),
-                daemon=True,
-                name=f"pw-cluster-recv-{peer}",
-            )
-            self._readers.append(reader)
-            reader.start()
+        with self._cv:
+            self._running = True
+            pairs = list(self._socks.items())
+            for peer, _sock in pairs:
+                self._last_seen[peer] = now
+        for peer, sock in pairs:
+            self._start_link(peer, sock)
 
     def _dial(self, peer: int, first_port: int) -> socket.socket:
         deadline = _time.monotonic() + self._CONNECT_TIMEOUT_S
@@ -387,7 +478,9 @@ class _ProcessLinks:
                     ("127.0.0.1", first_port + peer), timeout=5.0
                 )
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                sock.sendall(struct.pack("<I", self.process_id))
+                sock.sendall(
+                    struct.pack("<II", self.process_id, self.incarnation)
+                )
                 return sock
             except OSError:
                 if _time.monotonic() > deadline:
@@ -396,18 +489,107 @@ class _ProcessLinks:
                     )
                 _time.sleep(0.05)
 
-    def _accept_peers(self, listener: socket.socket) -> None:
-        expected = self.n_processes - 1 - self.process_id  # all higher pids
-        listener.settimeout(self._CONNECT_TIMEOUT_S)
-        for _ in range(expected):
+    def _accept_loop(self, listener: socket.socket) -> None:
+        """Persistent accept loop: admits the initial higher-pid dials AND
+        (isolate policy) any later rejoin from a replacement rank — the
+        listener stays open for the lifetime of the links."""
+        listener.settimeout(1.0)
+        while not self._closed:
             try:
                 sock, _addr = listener.accept()
+            except socket.timeout:
+                continue
             except OSError:
+                return  # listener closed: teardown
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(self._CONNECT_TIMEOUT_S)  # bound handshake
+                peer, peer_inc = struct.unpack(
+                    "<II", self._recv_exact(sock, 8)
+                )
+            except (OSError, ConnectionError, struct.error):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            self._admit_peer(peer, sock, peer_inc)
+
+    def _admit_peer(
+        self, peer: int, sock: socket.socket, peer_inc: int
+    ) -> None:
+        """Record (or replace) the link to ``peer``.  Admission control:
+        while a live link stands, a dial with an incarnation <= the known
+        one is a duplicate or a zombie of the dead rank — refused.  A
+        rejoin (dead peer, or strictly higher incarnation) replaces the
+        link: the old socket closes, the old sender stops, the dead
+        incarnation's undelivered frames are purged, and — once the mesh
+        is running — a fresh sender/reader pair starts immediately."""
+        old_sock = old_sender = None
+        with self._cv:
+            if self._closed:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 return
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            sock.settimeout(self._CONNECT_TIMEOUT_S)  # bound the handshake
-            peer = struct.unpack("<I", self._recv_exact(sock, 4))[0]
+            known_inc = self._peer_incarnation.get(peer)
+            state = self._peer_state.get(peer)
+            if (
+                peer in self._socks
+                and state != PEER_DEAD
+                and known_inc is not None
+                and peer_inc <= known_inc
+            ):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            rejoin = state == PEER_DEAD
+            old_sock = self._socks.pop(peer, None)
+            old_sender = self._senders.pop(peer, None)
+            # quiesce the dead incarnation's routes: its undelivered
+            # frames must not satisfy a wait meant for the replacement
+            for deposits in self._inbox.values():
+                deposits.pop(peer, None)
+            self._link_version[peer] = self._link_version.get(peer, -1) + 1
+            self._peer_incarnation[peer] = peer_inc
+            self._peer_state[peer] = PEER_ALIVE
+            self._dead_reason.pop(peer, None)
             self._socks[peer] = sock
+            self._last_seen[peer] = _time.monotonic()
+            running = self._running
+            self._cv.notify_all()
+        if rejoin:
+            with self.stats_lock:
+                self.stats["peers_rejoined"] += 1
+        if old_sender is not None:
+            old_sender.stop()
+        if old_sock is not None:
+            try:
+                old_sock.close()
+            except OSError:
+                pass
+        if running:
+            self._start_link(peer, sock)
+        if self._hub is not None:
+            self._hub.notify()
+
+    def _start_link(self, peer: int, sock: socket.socket) -> None:
+        version = self._link_version.get(peer, 0)
+        sender = _PeerSender(peer, sock, self)
+        sender.link_version = version
+        self._senders[peer] = sender
+        sender.start()
+        reader = threading.Thread(
+            target=self._read_loop,
+            args=(peer, sock, version),
+            daemon=True,
+            name=f"pw-cluster-recv-{peer}",
+        )
+        self._readers.append(reader)
+        reader.start()
 
     @staticmethod
     def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -436,11 +618,27 @@ class _ProcessLinks:
                         f"peer process {peer} silent for {silent_s:.1f}s "
                         f"(liveness timeout {self.liveness_timeout_s:.1f}s)"
                     ) from None
+                if (
+                    self.fail_policy == "isolate"
+                    and silent_s > self.liveness_timeout_s / 2.0
+                    and self._peer_state.get(peer) == PEER_ALIVE
+                ):
+                    # half a window of silence: observably *suspect* —
+                    # layers above may hedge around it before it is dead
+                    with self._cv:
+                        if self._peer_state.get(peer) == PEER_ALIVE:
+                            self._peer_state[peer] = PEER_SUSPECT
+                            self._cv.notify_all()
                 continue
             if not r:
                 raise ConnectionError("peer closed")
             got += r
             self._last_seen[peer] = _time.monotonic()
+            if self._peer_state.get(peer) == PEER_SUSPECT:
+                with self._cv:
+                    if self._peer_state.get(peer) == PEER_SUSPECT:
+                        self._peer_state[peer] = PEER_ALIVE
+                        self._cv.notify_all()
 
     def _fail(self, msg: str) -> None:
         with self._cv:
@@ -450,7 +648,7 @@ class _ProcessLinks:
         # turn a one-sided failure into a whole-mesh one: closing our
         # sockets EOFs every peer's reader within one io tick, so the
         # cluster fails together instead of timing out link by link
-        for sock in self._socks.values():
+        for sock in list(self._socks.values()):
             try:
                 sock.close()
             except OSError:
@@ -458,7 +656,46 @@ class _ProcessLinks:
         if self._hub is not None:
             self._hub.notify()
 
-    def _read_loop(self, peer: int, sock: socket.socket) -> None:
+    def _fail_peer(self, peer: int, link_version: int, msg: str) -> None:
+        """Single-peer failure path.  Under the ``together`` policy this
+        is :meth:`_fail` (legacy semantics).  Under ``isolate`` only the
+        fail domain of ``peer`` is quiesced: mark it dead, purge its
+        undelivered frames, stop its sender, close its socket, and wake
+        every waiter — the rest of the mesh keeps running."""
+        if self.fail_policy != "isolate":
+            self._fail(msg)
+            return
+        with self._cv:
+            if self._closed:
+                return
+            if self._link_version.get(peer) != link_version:
+                return  # a superseded link dying is not news
+            if self._peer_state.get(peer) == PEER_DEAD:
+                return
+            self._peer_state[peer] = PEER_DEAD
+            self._dead_reason[peer] = msg
+            # quiesce the routes touching this peer: its undelivered
+            # frames must never satisfy a later wait
+            for deposits in self._inbox.values():
+                deposits.pop(peer, None)
+            sender = self._senders.pop(peer, None)
+            sock = self._socks.pop(peer, None)
+            self._cv.notify_all()
+        with self.stats_lock:
+            self.stats["peers_declared_dead"] += 1
+        if sender is not None:
+            sender.stop()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._hub is not None:
+            self._hub.notify()
+
+    def _read_loop(
+        self, peer: int, sock: socket.socket, link_version: int = 0
+    ) -> None:
         native = _native_mod.load()
         header = bytearray(8)
         header_view = memoryview(header)
@@ -483,6 +720,18 @@ class _ProcessLinks:
                 if not deposits:
                     continue  # heartbeat: the bytes already did their job
                 with self._cv:
+                    if (
+                        self._link_version.get(peer, 0) != link_version
+                        or self._peer_state.get(peer) == PEER_DEAD
+                    ):
+                        # generation-versioned rejection: frames from a
+                        # superseded or dead incarnation are dropped, not
+                        # deposited — a zombie cannot corrupt the mesh
+                        with self.stats_lock:
+                            self.stats["stale_frames_dropped"] += len(
+                                deposits
+                            )
+                        return
                     box = self._inbox
                     for slot, payload in deposits:
                         box.setdefault(slot, {})[peer] = payload
@@ -492,9 +741,13 @@ class _ProcessLinks:
                     # worker parked between rounds so it joins this round
                     self._hub.notify()
         except RuntimeError as e:
+            # decode-configuration failure (e.g. native module missing in
+            # THIS process): not a peer's fault — fail the whole mesh
             self._fail(str(e))
-        except Exception as e:  # socket OR decode failure: fail loudly
-            self._fail(f"link to process {peer} lost: {e!r}")
+        except Exception as e:  # socket failure: fail this peer's domain
+            self._fail_peer(
+                peer, link_version, f"link to process {peer} lost: {e!r}"
+            )
 
     @staticmethod
     def _decode(mv: memoryview, native: Any) -> list:
@@ -555,41 +808,100 @@ class _ProcessLinks:
     # ------------------------------------------------------------------
     def send_async(self, peer: int, slot: Any, obj: Any) -> None:
         """Queue a pickled-object message; the sender thread coalesces it
-        with whatever else is outbound to this peer."""
-        self._senders[peer].enqueue(slot, _K_OBJ, obj)
+        with whatever else is outbound to this peer.  A frame addressed
+        to a dead peer (isolate policy) is dropped — its route is
+        quiesced, and the rejoin handshake re-opens it."""
+        sender = self._senders.get(peer)
+        if sender is not None:
+            sender.enqueue(slot, _K_OBJ, obj)
 
     def send_updates_async(self, peer: int, slot: Any, boxes: list) -> None:
         """Queue an update-box frame (``boxes[src_tid][dst_tid]`` lists of
         Updates); serialization happens on the sender thread."""
-        self._senders[peer].enqueue(slot, _K_UPDATES, boxes)
+        sender = self._senders.get(peer)
+        if sender is not None:
+            sender.enqueue(slot, _K_UPDATES, boxes)
 
     def recv_from_all(self, slot: Any) -> dict[int, Any]:
-        """Block until every peer delivered a payload for ``slot``.
+        """Block until every *live* peer delivered a payload for ``slot``.
 
         A notified wait: the reader threads ``notify_all`` on every
-        deposit and ``_fail`` notifies on link loss.  The wait timeout is
-        defense-in-depth only (failure detection lives in the readers'
-        liveness deadlines); on the steady-state path a deposit notify
-        always arrives first, so nothing is quantized to the timeout."""
+        deposit, ``_fail`` notifies on link loss, and ``_fail_peer``
+        notifies on a single-peer death (so nobody blocks on a dead
+        peer).  Under the ``together`` policy the live set is all peers
+        and any failure raises; under ``isolate`` dead peers are simply
+        absent from the returned dict — degraded, not dead.  The wait
+        timeout is defense-in-depth only (failure detection lives in the
+        readers' liveness deadlines)."""
         with self._cv:
             while True:
                 if self._failed is not None:
                     raise RuntimeError(f"cluster failure: {self._failed}")
                 got = self._inbox.get(slot)
-                if got is not None and len(got) == self.n_processes - 1:
+                if self.fail_policy == "isolate":
+                    live = [
+                        p
+                        for p in range(self.n_processes)
+                        if p != self.process_id
+                        and self._peer_state.get(p) != PEER_DEAD
+                    ]
+                    have = got if got is not None else {}
+                    if all(p in have for p in live):
+                        out = {p: have.pop(p) for p in live}
+                        if not have:
+                            self._inbox.pop(slot, None)
+                        return out
+                elif got is not None and len(got) == self.n_processes - 1:
                     return self._inbox.pop(slot)
                 self._cv.wait(1.0)
+
+    # ------------------------------------------------------------------
+    def peer_states(self) -> dict[int, str]:
+        """Membership snapshot: peer pid -> ``alive``/``suspect``/``dead``
+        (peers never heard from report ``alive`` — absence of evidence is
+        not failure under the liveness deadline)."""
+        with self._cv:
+            return {
+                p: self._peer_state.get(p, PEER_ALIVE)
+                for p in range(self.n_processes)
+                if p != self.process_id
+            }
+
+    def dead_peers(self) -> list[int]:
+        with self._cv:
+            return sorted(
+                p
+                for p, s in self._peer_state.items()
+                if s == PEER_DEAD
+            )
+
+    def membership(self) -> dict[int, dict[str, Any]]:
+        """Full membership view: per peer ``state``, last advertised
+        ``incarnation``, and the death ``reason`` (if dead)."""
+        with self._cv:
+            return {
+                p: {
+                    "state": self._peer_state.get(p, PEER_ALIVE),
+                    "incarnation": self._peer_incarnation.get(p, 0),
+                    "reason": self._dead_reason.get(p),
+                }
+                for p in range(self.n_processes)
+                if p != self.process_id
+            }
 
     def close(self) -> None:
         """Bounded teardown: ask the senders to drain, give them a short
         grace, then close the sockets (which breaks any sender stuck in
         ``sendall`` and any reader parked in ``recv``) and re-join — no
         unbounded join anywhere, so teardown cannot hang."""
-        for sender in self._senders.values():
+        with self._cv:
+            self._closed = True
+        senders = list(self._senders.values())
+        for sender in senders:
             sender.stop()
-        for sender in self._senders.values():
+        for sender in senders:
             sender.join(0.5)
-        for sock in self._socks.values():
+        for sock in list(self._socks.values()):
             try:
                 sock.close()
             except OSError:
@@ -598,7 +910,7 @@ class _ProcessLinks:
             self._listener.close()
         except OSError:
             pass
-        for sender in self._senders.values():
+        for sender in senders:
             sender.join(1.5)
         for reader in self._readers:
             reader.join(1.5)
@@ -622,6 +934,8 @@ class Cluster:
         first_port: int = 10000,
         heartbeat_s: float | None = None,
         liveness_timeout_s: float | None = None,
+        fail_policy: str | None = None,
+        incarnation: int | None = None,
     ):
         self.threads = threads
         self.processes = processes
@@ -642,6 +956,8 @@ class Cluster:
                 hub=self.wakeup,
                 heartbeat_s=heartbeat_s,
                 liveness_timeout_s=liveness_timeout_s,
+                fail_policy=fail_policy,
+                incarnation=incarnation,
             )
             if processes > 1
             else None
@@ -663,6 +979,13 @@ class Cluster:
 
     def worker_index(self, thread_id: int) -> int:
         return self.process_id * self.threads + thread_id
+
+    def peer_states(self) -> dict[int, str]:
+        """Membership snapshot (``{}`` for a single-process cluster)."""
+        return {} if self._links is None else self._links.peer_states()
+
+    def membership(self) -> dict[int, dict[str, Any]]:
+        return {} if self._links is None else self._links.membership()
 
     def exchange_stats(self) -> dict[str, Any]:
         """Snapshot of the exchange-overhead probe: collective counts and
@@ -725,7 +1048,9 @@ class Cluster:
                         for dst_tid in range(T):
                             merged[dst_tid].extend(boxes[base + dst_tid])
                 else:
-                    rows = remote[src_pid]  # decoded by the reader thread
+                    rows = remote.get(src_pid)  # decoded by the reader
+                    if rows is None:
+                        continue  # peer dead (isolate): degraded merge
                     for src_tid in range(T):
                         row = rows[src_tid]
                         for dst_tid in range(T):
@@ -775,7 +1100,9 @@ class Cluster:
                 if src_pid == self.process_id:
                     gathered.extend(local[tid] for tid in range(T))
                 else:
-                    gathered.extend(remote[src_pid])
+                    part = remote.get(src_pid)
+                    if part is not None:  # dead peer (isolate): absent
+                        gathered.extend(part)
             with self._lock:
                 self._merged[slot] = gathered
         self._barrier.wait()

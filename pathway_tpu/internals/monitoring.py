@@ -328,9 +328,12 @@ def collect_stats(sched: Any) -> ProberStats:
 
 def serving_stats() -> dict[str, Any]:
     """Process-wide serving-layer snapshot — admission/scheduler/latency
-    aggregates from ``pathway_tpu.serving``.  Deliberately keyed off
-    ``sys.modules`` so a process that never imported the serving layer
-    pays nothing for this on every scrape."""
+    aggregates from ``pathway_tpu.serving``, plus the ``"failover"``
+    section (shard health, degraded-response counters, and the
+    failover-seconds histogram) when a
+    :class:`~pathway_tpu.serving.failover.PartitionedIndex` is live.
+    Deliberately keyed off ``sys.modules`` so a process that never
+    imported the serving layer pays nothing for this on every scrape."""
     import sys
 
     mod = sys.modules.get("pathway_tpu.serving")

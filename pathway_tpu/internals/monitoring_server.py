@@ -207,6 +207,43 @@ def _metrics_text(sched: Any) -> str:
                     f'pathway_tpu_stage_latency_count{{stage="{stage}",'
                     f'tenant_class="{label}"}} {d["count"]}'
                 )
+    # degraded serving / shard failover (ISSUE 13): shard health, responses
+    # served with partial coverage, and the failover-duration histogram —
+    # the dashboard panel for "one owner died; did anyone notice?"
+    fo = srv.get("failover", {})
+    if fo:
+        lines.append("# TYPE pathway_tpu_shards_total gauge")
+        lines.append(f"pathway_tpu_shards_total {fo.get('shards_total', 0)}")
+        lines.append("# TYPE pathway_tpu_shards_healthy gauge")
+        lines.append(
+            f"pathway_tpu_shards_healthy {fo.get('shards_healthy', 0)}"
+        )
+        lines.append("# TYPE pathway_tpu_degraded_responses_total counter")
+        lines.append(
+            f"pathway_tpu_degraded_responses_total "
+            f"{fo.get('degraded_responses_total', 0)}"
+        )
+        lines.append("# TYPE pathway_tpu_failovers_total counter")
+        lines.append(
+            f"pathway_tpu_failovers_total {fo.get('failovers_total', 0)}"
+        )
+        hist = fo.get("failover_seconds") or {}
+        if hist.get("count"):
+            lines.append("# TYPE pathway_tpu_failover_seconds gauge")
+            for qk in ("p50", "p95", "p99", "max"):
+                lines.append(
+                    f'pathway_tpu_failover_seconds{{quantile="{qk}"}} '
+                    f"{hist.get(qk + '_ns', 0) / 1e9:.6f}"
+                )
+            lines.append("# TYPE pathway_tpu_failover_seconds_count counter")
+            lines.append(
+                f"pathway_tpu_failover_seconds_count {hist.get('count', 0)}"
+            )
+            lines.append("# TYPE pathway_tpu_failover_seconds_sum counter")
+            lines.append(
+                f"pathway_tpu_failover_seconds_sum "
+                f"{hist.get('sum_ns', 0) / 1e9:.6f}"
+            )
     return "\n".join(lines) + "\n# EOF\n"
 
 
@@ -242,6 +279,8 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self) -> None:  # noqa: N802
             if self.path.startswith("/status"):
+                srv = _serving_snapshot()
+                fo = srv.get("failover", {})
                 body = json.dumps(
                     {
                         "epoch": sched.ctx.time,
@@ -273,7 +312,21 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         # multi-tenant serving layer: admission counters
                         # per tenant class, scheduler lane stats, and
                         # per-(stage, tenant_class) latency (ISSUE 10)
-                        "serving": _serving_snapshot(),
+                        "serving": srv,
+                        # degraded-mode summary (ISSUE 13): one glance says
+                        # whether answers are currently partial and why
+                        "degraded": {
+                            "active": fo.get("shards_healthy", 0)
+                            < fo.get("shards_total", 0),
+                            "shards_healthy": fo.get("shards_healthy", 0),
+                            "shards_total": fo.get("shards_total", 0),
+                            "degraded_responses_total": fo.get(
+                                "degraded_responses_total", 0
+                            ),
+                            "failovers_total": fo.get("failovers_total", 0),
+                        }
+                        if fo
+                        else {},
                     }
                 ).encode()
                 ctype = "application/json"

@@ -548,8 +548,11 @@ class ClusterRunReport:
     """Outcome of a supervised cluster run.
 
     ``recovery_seconds`` has one entry per restart: wall time from the moment
-    a worker failure was observed to the moment every replacement process of
-    the next generation was spawned (the cluster's downtime window).
+    a worker failure was observed to the moment every replacement process was
+    spawned — the whole cluster's downtime window under
+    ``restart_scope="generation"``, the single rank's under ``"rank"``
+    (survivors never stop).  ``rank_restarts`` maps pid -> per-rank restart
+    count (empty under generation scope).
     """
 
     returncode: int
@@ -557,6 +560,7 @@ class ClusterRunReport:
     recovery_seconds: list[float] = field(default_factory=list)
     total_seconds: float = 0.0
     failures: list[str] = field(default_factory=list)
+    rank_restarts: dict[int, int] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
@@ -567,21 +571,42 @@ class ClusterSupervisor:
     """Restart a multi-process cluster run after worker death.
 
     The supervisor owns the whole mesh: it spawns one OS process per
-    ``PATHWAY_PROCESS_ID`` with the standard env contract, watches their
-    exit codes, and on any nonzero exit tears down the survivors and
-    respawns *all* of them.  Restart-all (rather than restart-one) is the
-    correct granularity here because a surviving worker cannot rejoin a
-    half-dead mesh: peers fail their sockets as soon as one side dies, and
-    epoch consensus needs every rank present.  Rollback to the last
-    globally-consistent checkpoint is not the supervisor's job — the
-    workers' own ``("snap_presence",)`` allgather refuses any checkpoint
-    epoch that is missing on some rank or skewed across ranks, so a
-    respawned cluster converges on the newest epoch that every worker
-    persisted (or replays from scratch when there is none), and file sinks
-    truncate back to their checkpointed watermark before appending.
+    ``PATHWAY_PROCESS_ID`` with the standard env contract and watches
+    their exit codes.  What a nonzero exit triggers is the
+    ``restart_scope``:
+
+    - ``"generation"`` (default, the legacy semantics): tear down the
+      survivors and respawn *all* of them.  This is the only correct
+      granularity when the workers run the fail-together mesh policy — a
+      surviving worker cannot rejoin a half-dead mesh: peers fail their
+      sockets as soon as one side dies, and epoch consensus needs every
+      rank present.
+    - ``"rank"`` (per-rank failover, ISSUE 13): respawn ONLY the dead
+      rank, on the same port range, with ``PATHWAY_CLUSTER_INCARNATION``
+      bumped so the replacement's dial handshake is admitted as a rejoin
+      by the survivors' isolate-policy mesh
+      (``engine/cluster._ProcessLinks``).  Survivors never stop; the
+      replacement restores its state from its snapshot + offset tail and
+      rejoins.  The supervisor exports
+      ``PATHWAY_CLUSTER_FAIL_POLICY=isolate`` to the workers under this
+      scope (overridable via ``env``) because per-rank restart is only
+      sound on an isolating mesh.
+
+    Rollback to the last globally-consistent checkpoint is not the
+    supervisor's job — the workers' own ``("snap_presence",)`` allgather
+    refuses any checkpoint epoch that is missing on some rank or skewed
+    across ranks, so a respawned cluster converges on the newest epoch
+    that every worker persisted (or replays from scratch when there is
+    none), and file sinks truncate back to their checkpointed watermark
+    before appending.
 
     Restart budget and backoff pacing reuse ``ConnectorRecoveryPolicy``
     so cluster supervision tunes exactly like connector supervision.
+    The budget counts the current *failure streak*, not lifetime
+    restarts: after ``healthy_reset_polls`` consecutive healthy poll
+    ticks the streak (and with it the backoff schedule) resets, so an
+    unrelated failure hours later starts from the initial delay instead
+    of inheriting a maxed-out schedule and an exhausted budget.
     """
 
     def __init__(
@@ -597,9 +622,16 @@ class ClusterSupervisor:
         first_port_factory: Callable[[int], int] | None = None,
         grace_s: float = 5.0,
         poll_interval_s: float = 0.02,
+        restart_scope: str = "generation",
+        healthy_reset_polls: int | None = 250,
     ) -> None:
         if n_processes < 1:
             raise ValueError("n_processes must be >= 1")
+        if restart_scope not in ("generation", "rank"):
+            raise ValueError(
+                f"restart_scope must be 'generation' or 'rank', "
+                f"got {restart_scope!r}"
+            )
         self.argv = list(argv)
         self.n_processes = n_processes
         self.threads = threads
@@ -612,6 +644,10 @@ class ClusterSupervisor:
         self._first_port_factory = first_port_factory or _probe_port_range
         self.grace_s = grace_s
         self.poll_interval_s = poll_interval_s
+        self.restart_scope = restart_scope
+        #: consecutive healthy poll ticks after which the failure streak
+        #: (budget + backoff position) resets; None disables the reset
+        self.healthy_reset_polls = healthy_reset_polls
         self._stop_event = threading.Event()
 
     def stop(self) -> None:
@@ -620,37 +656,59 @@ class ClusterSupervisor:
 
     # -- process plumbing ---------------------------------------------------
 
+    def _spawn_rank(
+        self,
+        generation: int,
+        first_port: int,
+        pid_: int,
+        incarnation: int = 0,
+    ) -> tuple[subprocess.Popen[bytes], Any]:
+        env = dict(os.environ)
+        if self.restart_scope == "rank":
+            # per-rank restart is only sound on an isolating mesh: the
+            # survivors must quiesce one peer, not fail together
+            env["PATHWAY_CLUSTER_FAIL_POLICY"] = "isolate"
+        env.update(self.extra_env)
+        env.update(
+            {
+                "PATHWAY_THREADS": str(self.threads),
+                "PATHWAY_PROCESSES": str(self.n_processes),
+                "PATHWAY_PROCESS_ID": str(pid_),
+                "PATHWAY_FIRST_PORT": str(first_port),
+                # surfaces as pathway_tpu_worker_restarts_total
+                "PATHWAY_WORKER_RESTARTS": str(
+                    incarnation if self.restart_scope == "rank" else generation
+                ),
+                # the rejoin handshake: survivors admit a replacement
+                # whose dial advertises a newer incarnation
+                "PATHWAY_CLUSTER_INCARNATION": str(incarnation),
+            }
+        )
+        log_f: Any = subprocess.DEVNULL
+        if self.log_dir is not None:
+            suffix = f"_i{incarnation}" if incarnation else ""
+            log_f = open(
+                os.path.join(
+                    self.log_dir, f"gen{generation}_p{pid_}{suffix}.log"
+                ),
+                "wb",
+            )
+        proc = subprocess.Popen(
+            self.argv,
+            env=env,
+            cwd=self.cwd,
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+        )
+        return proc, log_f
+
     def _spawn_generation(
         self, generation: int, first_port: int
     ) -> list[tuple[subprocess.Popen[bytes], Any]]:
-        procs: list[tuple[subprocess.Popen[bytes], Any]] = []
-        for pid_ in range(self.n_processes):
-            env = dict(os.environ)
-            env.update(self.extra_env)
-            env.update(
-                {
-                    "PATHWAY_THREADS": str(self.threads),
-                    "PATHWAY_PROCESSES": str(self.n_processes),
-                    "PATHWAY_PROCESS_ID": str(pid_),
-                    "PATHWAY_FIRST_PORT": str(first_port),
-                    # surfaces as pathway_tpu_worker_restarts_total
-                    "PATHWAY_WORKER_RESTARTS": str(generation),
-                }
-            )
-            log_f: Any = subprocess.DEVNULL
-            if self.log_dir is not None:
-                log_f = open(
-                    os.path.join(self.log_dir, f"gen{generation}_p{pid_}.log"), "wb"
-                )
-            proc = subprocess.Popen(
-                self.argv,
-                env=env,
-                cwd=self.cwd,
-                stdout=log_f,
-                stderr=subprocess.STDOUT,
-            )
-            procs.append((proc, log_f))
-        return procs
+        return [
+            self._spawn_rank(generation, first_port, pid_)
+            for pid_ in range(self.n_processes)
+        ]
 
     def _terminate(self, procs: list[tuple[subprocess.Popen[bytes], Any]]) -> None:
         for proc, _ in procs:
@@ -684,18 +742,40 @@ class ClusterSupervisor:
         backoff = self.policy.backoff_strategy()
         t0 = _time.monotonic()
         generation = 0
+        #: consecutive-failure streak: drives the backoff position AND
+        #: the restart budget; resets after a stable-healthy window so an
+        #: unrelated failure later doesn't inherit a maxed-out schedule
+        failure_streak = 0
+        healthy_polls = 0
         recovery_seconds: list[float] = []
         failures: list[str] = []
+        rank_restarts: dict[int, int] = {}
         failed_at: float | None = None
 
         def report(rc: int) -> ClusterRunReport:
             return ClusterRunReport(
                 returncode=rc,
-                restarts=generation,
+                restarts=generation + sum(rank_restarts.values()),
                 recovery_seconds=recovery_seconds,
                 total_seconds=_time.monotonic() - t0,
                 failures=failures,
+                rank_restarts=dict(rank_restarts),
             )
+
+        def tick_healthy() -> None:
+            nonlocal failure_streak, healthy_polls
+            healthy_polls += 1
+            if (
+                failure_streak
+                and self.healthy_reset_polls is not None
+                and healthy_polls >= self.healthy_reset_polls
+            ):
+                _logger.info(
+                    "cluster stable for %d polls: failure streak %d reset",
+                    healthy_polls,
+                    failure_streak,
+                )
+                failure_streak = 0
 
         while True:
             first_port = self._first_port_factory(self.n_processes)
@@ -723,10 +803,53 @@ class ClusterSupervisor:
                         f"generation {generation}: worker process "
                         f"{bad[0][0]} exited {failed_rc}"
                     )
-                    break
+                    if self.restart_scope != "rank":
+                        break
+                    # per-rank failover: respawn ONLY the dead ranks, on
+                    # the same port range — survivors keep running and
+                    # admit the replacements as rejoins
+                    rank_failed_at = _time.monotonic()
+                    telemetry.counter("cluster.worker_failures")
+                    _logger.warning(
+                        "%s; respawning only that rank (survivors keep "
+                        "running)",
+                        failures[-1],
+                    )
+                    if failure_streak >= self.policy.max_restarts:
+                        _logger.error(
+                            "cluster gave up after a streak of %d rank "
+                            "restart(s); last failure: %s",
+                            failure_streak,
+                            failures[-1],
+                        )
+                        self._terminate(procs)
+                        return report(failed_rc)
+                    delay = backoff.next_delay(failure_streak)
+                    if self._stop_event.wait(delay):
+                        failures.append(
+                            f"generation {generation}: stopped during backoff"
+                        )
+                        self._terminate(procs)
+                        return report(-1)
+                    failure_streak += 1
+                    healthy_polls = 0
+                    for i, _c in bad:
+                        _dead, old_log = procs[i]
+                        if old_log is not subprocess.DEVNULL:
+                            old_log.close()
+                        rank_restarts[i] = rank_restarts.get(i, 0) + 1
+                        procs[i] = self._spawn_rank(
+                            generation, first_port, i, rank_restarts[i]
+                        )
+                        telemetry.counter("cluster.restarts")
+                    recovery_seconds.append(
+                        _time.monotonic() - rank_failed_at
+                    )
+                    continue
                 if all(c == 0 for c in codes):
                     self._close_logs(procs)
                     return report(0)
+                tick_healthy()
                 self._stop_event.wait(self.poll_interval_s)
 
             # one worker died: the run is lost — tear down the survivors,
@@ -735,22 +858,27 @@ class ClusterSupervisor:
             telemetry.counter("cluster.worker_failures")
             _logger.warning("%s; tearing down survivors", failures[-1])
             self._terminate(procs)
-            if generation >= self.policy.max_restarts:
+            if failure_streak >= self.policy.max_restarts:
                 _logger.error(
-                    "cluster gave up after %d restart(s); last failure: %s",
-                    generation,
+                    "cluster gave up after a streak of %d restart(s); "
+                    "last failure: %s",
+                    failure_streak,
                     failures[-1],
                 )
                 return report(failed_rc if failed_rc is not None else 1)
-            delay = backoff.next_delay(generation)
+            delay = backoff.next_delay(failure_streak)
             if self._stop_event.wait(delay):
                 failures.append(f"generation {generation}: stopped during backoff")
                 return report(-1)
             telemetry.counter("cluster.restarts")
+            failure_streak += 1
+            healthy_polls = 0
             generation += 1
             _logger.warning(
-                "respawning cluster (generation %d of at most %d)",
+                "respawning cluster (generation %d; failure streak %d of "
+                "at most %d)",
                 generation,
+                failure_streak,
                 self.policy.max_restarts,
             )
 

@@ -34,6 +34,10 @@ __all__ = [
     "HashingEmbedder",
     "LoadGen",
     "TenantLoad",
+    "PartitionedIndex",
+    "ShardOwner",
+    "ShardHealthTracker",
+    "ShardFailoverSupervisor",
     "serving_probe",
     "serving_snapshot",
 ]
@@ -42,6 +46,7 @@ _registry_lock = threading.Lock()
 _admissions: "weakref.WeakSet[Any]" = weakref.WeakSet()
 _schedulers: "weakref.WeakSet[Any]" = weakref.WeakSet()
 _coschedulers: "weakref.WeakSet[Any]" = weakref.WeakSet()
+_shard_sets: "weakref.WeakSet[Any]" = weakref.WeakSet()
 _probe: Any = None
 
 
@@ -58,6 +63,11 @@ def _register_scheduler(obj: Any) -> None:
 def _register_coscheduler(obj: Any) -> None:
     with _registry_lock:
         _coschedulers.add(obj)
+
+
+def _register_shard_set(obj: Any) -> None:
+    with _registry_lock:
+        _shard_sets.add(obj)
 
 
 def serving_probe() -> Any:
@@ -80,6 +90,7 @@ def serving_snapshot() -> dict[str, Any]:
         admissions = list(_admissions)
         schedulers = list(_schedulers)
         coschedulers = list(_coschedulers)
+        shard_sets = list(_shard_sets)
         probe = _probe
     admitted: dict[str, int] = {}
     shed: dict[str, int] = {}
@@ -103,6 +114,38 @@ def serving_snapshot() -> dict[str, Any]:
         out["schedulers"] = [s.stats() for s in schedulers]
     if coschedulers:
         out["coschedulers"] = [c.stats() for c in coschedulers]
+    if shard_sets:
+        # degraded-mode aggregate across every live partitioned index:
+        # total/healthy shard counts, degraded responses, and the
+        # failover-seconds histogram (summed counts, worst-case maxima)
+        shards_total = shards_healthy = degraded = failovers = 0
+        hists = []
+        for p in shard_sets:
+            s = p.stats()
+            shards_total += s.get("shards_total", 0)
+            shards_healthy += s.get("shards_healthy", 0)
+            degraded += s.get("degraded_responses", 0)
+            failovers += s.get("failovers_total", 0)
+            h = s.get("failover_seconds")
+            if h:
+                hists.append(h)
+        failover_s: dict[str, Any] = {}
+        if hists:
+            failover_s = {
+                "count": sum(h.get("count", 0) for h in hists),
+                "sum_ns": sum(h.get("sum_ns", 0) for h in hists),
+                "max_ns": max(h.get("max_ns", 0) for h in hists),
+                "p50_ns": max(h.get("p50_ns", 0) for h in hists),
+                "p95_ns": max(h.get("p95_ns", 0) for h in hists),
+                "p99_ns": max(h.get("p99_ns", 0) for h in hists),
+            }
+        out["failover"] = {
+            "shards_total": shards_total,
+            "shards_healthy": shards_healthy,
+            "degraded_responses_total": degraded,
+            "failovers_total": failovers,
+            "failover_seconds": failover_s,
+        }
     if probe is not None:
         lat = probe.snapshot()
         if lat:
@@ -125,6 +168,15 @@ def __getattr__(name: str) -> Any:
         return getattr(_m, name)
     if name in ("LoadGen", "TenantLoad", "percentile"):
         from . import loadgen as _m
+
+        return getattr(_m, name)
+    if name in (
+        "PartitionedIndex",
+        "ShardOwner",
+        "ShardHealthTracker",
+        "ShardFailoverSupervisor",
+    ):
+        from . import failover as _m
 
         return getattr(_m, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

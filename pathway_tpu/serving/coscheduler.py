@@ -57,6 +57,7 @@ class _Req:
         "t_embed_ns",
         "t_dispatch_ns",
         "payload",
+        "coverage",
     )
 
     def __init__(self, query: str, k: int, tenant_class: str, future: Future, t0_ns: int):
@@ -68,6 +69,9 @@ class _Req:
         self.t_embed_ns = 0
         self.t_dispatch_ns = 0
         self.payload: Any = None
+        # (partial, shards_answered, shards_total) — the partial-result
+        # contract, read off the index probe handle after collect
+        self.coverage: tuple[bool, int, int] = (False, 1, 1)
 
 
 class StageCoScheduler:
@@ -107,6 +111,9 @@ class StageCoScheduler:
         self.overlap_ns_total = 0
         self.completed = 0
         self.failed = 0
+        #: responses served with partial shard coverage (degraded, not
+        #: failed — the partial-result contract)
+        self.degraded_responses = 0
         self._gen_thread = threading.Thread(
             target=self._gen_loop, daemon=True, name="serving_generate"
         )
@@ -200,6 +207,13 @@ class StageCoScheduler:
             return value[0] if value else []
         t_collect = time.monotonic_ns()
         hits = self.index.collect(value)
+        # the probe handle carries shard coverage after collect (identity
+        # 1/1 for a single index; real health for a PartitionedIndex)
+        req.coverage = (
+            bool(getattr(value, "partial", False)),
+            int(getattr(value, "shards_answered", 1)),
+            int(getattr(value, "shards_total", 1)),
+        )
         if req.t_dispatch_ns:
             self.lookahead_probes += 1
             self.overlap_ns_total += t_collect - req.t_dispatch_ns
@@ -222,6 +236,9 @@ class StageCoScheduler:
                 self.probe.record("serve_generate", cls, t_done - t_hits)
                 self.probe.record("serve_e2e", cls, t_done - req.t0_ns)
             self.completed += 1
+            partial, answered, total = req.coverage
+            if partial:
+                self.degraded_responses += 1
             if not req.future.done():
                 req.future.set_result(
                     {
@@ -229,6 +246,11 @@ class StageCoScheduler:
                         "docs": docs,
                         "tenant_class": req.tenant_class,
                         "latency_ms": (t_done - req.t0_ns) / 1e6,
+                        # partial-result contract: a response over a
+                        # degraded corpus says so instead of erroring
+                        "partial": partial,
+                        "shards_answered": answered,
+                        "shards_total": total,
                     }
                 )
         except BaseException as e:  # noqa: BLE001 — fault goes to the caller
@@ -248,6 +270,7 @@ class StageCoScheduler:
         return {
             "completed": self.completed,
             "failed": self.failed,
+            "degraded_responses": self.degraded_responses,
             "gen_queued": queued,
             "lookahead_probes": self.lookahead_probes,
             "overlap_ms_total": self.overlap_ns_total / 1e6,

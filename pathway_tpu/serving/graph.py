@@ -136,6 +136,8 @@ class RagServingApp:
         lookahead: bool = True,
         probe: Any = None,
         autocommit_ms: int = 10,
+        shards: int | None = None,
+        standby: bool = True,
     ):
         from pathway_tpu import serving as _serving
 
@@ -145,15 +147,33 @@ class RagServingApp:
             policies, default_policy=default_policy, hub=self.hub
         )
         self.embedder = embedder if embedder is not None else HashingEmbedder(embed_dim)
-        self.index = (
-            index
-            if index is not None
-            else SegmentedIndex(
+        self.shards = int(shards) if shards else 0
+        self.standby = bool(standby)
+        if index is not None:
+            self.index = index
+        elif self.shards >= 2:
+            # partial-failure survival: split the corpus across shard
+            # owners so one owner's death degrades answers (partial:true
+            # over the survivors + snapshot-backed standby) instead of
+            # taking the query surface down — serving/failover.py
+            from .failover import PartitionedIndex
+
+            dim, cap, merge = self.embedder.dim, delta_cap, auto_merge
+            self.index = PartitionedIndex(
+                lambda: SegmentedIndex(
+                    HnswIndex(dim, metric="cos"),
+                    delta_cap=cap,
+                    auto_merge=merge,
+                ),
+                n_shards=self.shards,
+                standby=self.standby,
+            )
+        else:
+            self.index = SegmentedIndex(
                 HnswIndex(self.embedder.dim, metric="cos"),
                 delta_cap=delta_cap,
                 auto_merge=auto_merge,
             )
-        )
         self.scheduler = SloScheduler(
             lanes=lanes,
             target_ms=target_ms,
@@ -226,6 +246,14 @@ class RagServingApp:
         # single-reader python connector, so the upsert is order-safe —
         # the annotation lets PW-X001 verify that instead of assuming it
         sink.meta["index_upsert"] = True
+        # availability annotation for PW-R002: a sharded index with
+        # snapshot-backed standbys keeps answering (degraded) through a
+        # shard owner's death; a single-owner index does not, and the
+        # analyzer should say so
+        sink.meta["failover"] = {
+            "standby": self.shards >= 2 and self.standby,
+            "shards": self.shards or 1,
+        }
 
     def _on_chunks(self, key: Any, row: dict, time: int, is_addition: bool) -> None:
         chunks = list(row.get("chunks") or ())

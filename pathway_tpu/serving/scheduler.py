@@ -125,6 +125,23 @@ class SloScheduler:
 
         _serving._register_scheduler(self)
 
+    def ensure_lane(
+        self, lane: str, share: float = 1.0, target_ms: float = 10.0
+    ) -> None:
+        """Add a device-time lane at runtime if it doesn't exist yet.
+
+        Used by the shard-failover path to carve out a low-share
+        ``recover`` lane: restore work then competes for device time
+        under the same deficit arbitration as live queries instead of
+        stealing it (or bypassing the partition entirely)."""
+        with self._lock:
+            if lane in self._lanes:
+                return
+            self._lanes[lane] = float(share)
+            self._target_ns[lane] = int(target_ms * 1e6)
+            self._vtime[lane] = 0.0
+            self._busy_ns[lane] = 0
+
     # -------------------------------------------------------------- submit
 
     def submit(

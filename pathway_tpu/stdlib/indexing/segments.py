@@ -62,9 +62,27 @@ class _SegProbe:
     the delta view + tombstone mask taken at dispatch time, plus either
     the main segment's async device handle (``probe``) or its eagerly
     computed hits (``main_hits``).  ``main is None`` marks a probe over
-    an empty index."""
+    an empty index.
 
-    __slots__ = ("queries", "k", "delta", "mask", "main", "probe", "main_hits")
+    The probe also carries the serving layer's partial-result contract
+    (``partial``/``shards_answered``/``shards_total``): a single
+    SegmentedIndex is one shard that always answers authoritatively, so
+    the identity coverage ``1/1`` — the multi-shard variant lives in
+    :class:`pathway_tpu.serving.failover.PartitionedIndex`, whose probe
+    carries the same fields with real per-shard health behind them."""
+
+    __slots__ = (
+        "queries",
+        "k",
+        "delta",
+        "mask",
+        "main",
+        "probe",
+        "main_hits",
+        "partial",
+        "shards_answered",
+        "shards_total",
+    )
 
     def __init__(self, queries, k, delta, mask, main, probe, main_hits):
         self.queries = queries
@@ -74,6 +92,9 @@ class _SegProbe:
         self.main = main
         self.probe = probe
         self.main_hits = main_hits
+        self.partial = False
+        self.shards_answered = 1
+        self.shards_total = 1
 
 
 def _env_int(name: str, default: int) -> int:

@@ -32,6 +32,22 @@ Cluster fault primitives (drive ``tests/test_cluster_recovery.py``):
   — latency or loss injected at the peer link's single egress point
   (``_PeerSender._transmit``); dropping mutes heartbeats too, so a muted
   peer becomes *detectably* dead.
+
+Gray-failure primitives (the failures that are NOT clean crashes —
+asymmetric, partial, or slow — the modes membership layers classically
+misdiagnose):
+
+- :meth:`chaos.asymmetric_partition` — delay or drop frames in exactly
+  ONE direction (``src -> dst``); the reverse path stays perfect, so
+  ``src`` looks dead to ``dst`` while ``dst`` looks fine to ``src``.
+- :meth:`chaos.pause_resume` — SIGSTOP a live OS process and SIGCONT it
+  after a pause: the process is silent (no heartbeats, no frames, no
+  exit code) then wakes and resumes sending as if nothing happened —
+  exactly a long GC pause / VM migration.  Survivors must mark it
+  suspect/dead and then handle the stale frames that resume on wake.
+- :meth:`chaos.slow_peer` — every outbound transmission from one rank is
+  slowed (seeded jitter): a degraded-but-alive peer that drags epochs
+  without ever missing a liveness deadline.
 - :class:`ClusterDrill` — seedable end-to-end drill: run a wordcount
   cluster fault-free, re-run it with a worker killed at a random epoch
   under :class:`~pathway_tpu.internals.resilience.ClusterSupervisor`,
@@ -408,6 +424,116 @@ class chaos:
             return orig(sender, body, n_frames)
 
         self._patch(_PeerSender, "_transmit", wrapper)
+
+    # -- gray failures ---------------------------------------------------
+    def asymmetric_partition(
+        self,
+        src: int,
+        dst: int,
+        mode: str = "drop",
+        delay_s: float = 0.2,
+        jitter_s: float = 0.0,
+        after: int = 0,
+    ) -> None:
+        """Break exactly ONE direction of one link: frames from process
+        ``src`` to process ``dst`` are dropped (``mode="drop"``) or
+        delayed (``mode="delay"``, plus a seeded uniform draw from
+        ``[0, jitter_s]``) past the first ``after`` transmissions, while
+        ``dst -> src`` stays perfect.
+
+        This is the canonical gray failure: ``dst`` stops hearing
+        heartbeats and declares ``src`` suspect/dead, while ``src`` still
+        receives from ``dst`` and believes the mesh is whole.  Under the
+        isolate fail policy the two sides may hold *different* membership
+        views — which is exactly what the drill should assert about."""
+        if mode not in ("drop", "delay"):
+            raise ValueError(f"mode must be 'drop' or 'delay', got {mode!r}")
+        from pathway_tpu.engine.cluster import _PeerSender
+
+        orig = _PeerSender._transmit
+        key = self._counter_key(_PeerSender, "_transmit")
+
+        @functools.wraps(orig)
+        def wrapper(sender: Any, body: Any, n_frames: int) -> Any:
+            count = self._bump(key)
+            mine = (
+                getattr(sender.links, "process_id", None) == src
+                and sender.peer == dst
+            )
+            if mine and count > after:
+                if mode == "drop":
+                    return None  # one-way black hole
+                _time.sleep(delay_s + self.rng.uniform(0.0, jitter_s))
+            return orig(sender, body, n_frames)
+
+        self._patch(_PeerSender, "_transmit", wrapper)
+
+    def pause_resume(
+        self, pid: int, pause_s: float = 1.0
+    ) -> threading.Timer:
+        """SIGSTOP OS process ``pid`` now; SIGCONT it ``pause_s`` seconds
+        later (from a daemon timer).  During the pause the process emits
+        nothing — no heartbeats, no frames, no exit status — then wakes
+        and resumes mid-instruction, the shape of a long GC pause, a VM
+        live-migration, or an operator's stray ``kill -STOP``.
+
+        Unlike the monkey-patching faults this targets a *separate* OS
+        process (monkey patches don't cross process boundaries), so it is
+        the primitive for supervisor/membership drills over real worker
+        processes.  Returns the SIGCONT timer; :meth:`restore` (and so
+        the context-manager exit) also fires any pending SIGCONT so a
+        failing test never leaks a stopped process."""
+        import signal
+
+        os.kill(pid, signal.SIGSTOP)
+        fired = threading.Event()
+
+        def _resume() -> None:
+            if fired.is_set():
+                return
+            fired.set()
+            try:
+                os.kill(pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass  # it died while paused; nothing to resume
+
+        timer = threading.Timer(pause_s, _resume)
+        timer.daemon = True
+        timer.start()
+        # ride the patch-restore machinery: "restoring" this fault means
+        # making sure the SIGCONT has been delivered
+        self._patches.append((_ResumeOnRestore(timer, _resume), "noop", None))
+        return timer
+
+    def slow_peer(
+        self,
+        process_id: int,
+        delay_s: float = 0.05,
+        jitter_s: float = 0.02,
+    ) -> None:
+        """Every outbound transmission from ``process_id`` (to every
+        peer) is slowed by ``delay_s`` plus a seeded uniform draw from
+        ``[0, jitter_s]`` — a degraded-but-alive rank: it keeps making
+        its liveness deadlines while dragging every epoch and probe it
+        participates in.  The fault the hedged-collect path
+        (``PartitionedIndex`` with ``hedge_timeout_s``) exists for."""
+        self.delay_exchange_frames(
+            delay_s=delay_s, jitter_s=jitter_s, process_id=process_id
+        )
+
+
+class _ResumeOnRestore:
+    """Adapter so a pending SIGCONT rides chaos's patch-restore list: the
+    restore loop calls ``setattr(owner, "noop", None)`` which lands in
+    ``__setattr__`` below and fires the resume."""
+
+    def __init__(self, timer: threading.Timer, resume: Callable[[], None]):
+        object.__setattr__(self, "_timer", timer)
+        object.__setattr__(self, "_resume", resume)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        object.__getattribute__(self, "_timer").cancel()
+        object.__getattribute__(self, "_resume")()
 
 
 _DRILL_PROGRAM = """

@@ -230,3 +230,109 @@ def test_randomized_fault_points_always_exactly_once(tmp_path):
         sched = Scheduler(G.engine_graph, autocommit_ms=10)
         sched.run()
         assert results == EXPECTED, (drill, fail_at, results)
+
+
+# ---------------------------------------------------------------------------
+# gray-failure primitives (ISSUE 13): seedable, restore-safe, scoped
+
+
+def test_asymmetric_partition_validates_mode_and_restores():
+    from pathway_tpu.engine.cluster import _PeerSender
+
+    with pytest.raises(ValueError, match="drop.*delay|mode"):
+        chaos(seed=0).asymmetric_partition(0, 1, mode="bogus")
+    orig = _PeerSender._transmit
+    with chaos(seed=0) as c:
+        c.asymmetric_partition(0, 1, mode="drop")
+        c.asymmetric_partition(1, 0, mode="delay", delay_s=0.0)
+        assert _PeerSender._transmit is not orig
+    assert _PeerSender._transmit is orig  # both patches unwound
+
+
+def test_asymmetric_partition_scopes_one_direction():
+    """Frames src->dst vanish; every other (links, peer) pair passes."""
+    from pathway_tpu.engine.cluster import _PeerSender
+
+    sent = []
+
+    class _Links:
+        process_id = 1
+
+    class _Sender:
+        links = _Links()
+
+        def __init__(self, peer):
+            self.peer = peer
+
+    orig = _PeerSender._transmit
+    try:
+        _PeerSender._transmit = lambda self, body, n: sent.append(
+            (self.links.process_id, self.peer)
+        )
+        with chaos(seed=0) as c:
+            c.asymmetric_partition(1, 0, mode="drop")
+            wrapper = _PeerSender._transmit
+            wrapper(_Sender(0), b"", 1)  # 1 -> 0: dropped
+            wrapper(_Sender(2), b"", 1)  # 1 -> 2: delivered
+        assert sent == [(1, 2)]
+    finally:
+        _PeerSender._transmit = orig
+
+
+def test_pause_resume_stops_and_continues_process():
+    """SIGSTOP/SIGCONT drill against a real child: silent while paused,
+    running again after the timer fires."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(20)"])
+
+    def state() -> str:
+        with open(f"/proc/{proc.pid}/stat") as f:
+            return f.read().rsplit(")", 1)[1].split()[0]
+
+    try:
+        with chaos(seed=1) as c:
+            c.pause_resume(proc.pid, pause_s=0.3)
+            _time.sleep(0.1)
+            assert state() == "T", f"process not stopped: {state()}"
+            _time.sleep(0.5)
+            assert state() in ("S", "R"), f"process never resumed: {state()}"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_pause_resume_restore_fires_pending_sigcont():
+    """A failing drill must not leak a stopped process: chaos restore
+    delivers the pending SIGCONT early."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(20)"])
+
+    def state() -> str:
+        with open(f"/proc/{proc.pid}/stat") as f:
+            return f.read().rsplit(")", 1)[1].split()[0]
+
+    try:
+        c = chaos(seed=2)
+        with c:
+            c.pause_resume(proc.pid, pause_s=60.0)
+            _time.sleep(0.1)
+            assert state() == "T"
+        _time.sleep(0.1)  # context exit == restore == SIGCONT now
+        assert state() in ("S", "R"), f"restore leaked a stopped process: {state()}"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_slow_peer_is_seeded_delay_wrapper():
+    from pathway_tpu.engine.cluster import _PeerSender
+
+    orig = _PeerSender._transmit
+    with chaos(seed=4) as c:
+        c.slow_peer(0, delay_s=0.0, jitter_s=0.0)
+        assert _PeerSender._transmit is not orig
+    assert _PeerSender._transmit is orig

@@ -233,3 +233,187 @@ def test_close_is_bounded_with_live_peer():
         for reader in links._readers:
             reader.join(2.0)
             assert not reader.is_alive(), "reader thread survived close()"
+
+
+# ---------------------------------------------------------------------------
+# per-peer membership under the isolate fail policy (ISSUE 13)
+
+
+def _isolate_link_pair(
+    first_port: int,
+    heartbeat_s: float = 0.1,
+    liveness_timeout_s: float = 1.0,
+):
+    """2-process mesh with ``fail_policy='isolate'``: a peer's death
+    quiesces only that peer's links instead of failing the whole mesh."""
+    from pathway_tpu.engine.cluster import _ProcessLinks
+
+    out: dict[int, "_ProcessLinks"] = {}
+
+    def build0() -> None:
+        out[0] = _ProcessLinks(
+            0,
+            2,
+            first_port,
+            heartbeat_s=heartbeat_s,
+            liveness_timeout_s=liveness_timeout_s,
+            fail_policy="isolate",
+        )
+
+    t = threading.Thread(target=build0, daemon=True)
+    t.start()
+    out[1] = _ProcessLinks(
+        1,
+        2,
+        first_port,
+        heartbeat_s=heartbeat_s,
+        liveness_timeout_s=liveness_timeout_s,
+        fail_policy="isolate",
+    )
+    t.join(10.0)
+    assert 0 in out, "mesh never completed"
+    return out[0], out[1]
+
+
+def _wait_for(pred, timeout_s: float, what: str) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        assert time.monotonic() < deadline, f"timed out waiting for {what}"
+        time.sleep(0.02)
+
+
+@pytest.mark.chaos
+def test_isolate_peer_death_degrades_instead_of_failing():
+    """One peer dies; the isolate-policy survivor marks ONLY that peer
+    dead (``_failed`` stays None — the mesh is degraded, not down) and a
+    collective over the survivors returns instead of raising."""
+    from pathway_tpu.engine.cluster import PEER_DEAD
+
+    links0, links1 = _isolate_link_pair(next_port(2))
+    try:
+        links1.close()  # rank 1 "dies": its sockets drop
+        _wait_for(
+            lambda: links0.peer_states().get(1) == PEER_DEAD,
+            8.0,
+            "survivor to declare peer 1 dead",
+        )
+        assert links0._failed is None, (
+            f"isolate policy failed the whole mesh: {links0._failed}"
+        )
+        member = links0.membership()[1]
+        assert member["state"] == PEER_DEAD and member["reason"]
+        # a collective over zero live peers degrades to the empty answer
+        assert links0.recv_from_all(("epoch", 0)) == {}
+        assert links0.stats["peers_declared_dead"] == 1
+    finally:
+        links0.close()
+
+
+@pytest.mark.chaos
+def test_isolate_rejoin_with_bumped_incarnation():
+    """A replacement rank dialing with a bumped incarnation is admitted
+    by the survivor (generation handshake), after which both directions
+    of the link carry traffic again and the membership view heals."""
+    from pathway_tpu.engine.cluster import PEER_ALIVE, PEER_DEAD, _ProcessLinks
+
+    first_port = next_port(2)
+    links0, links1 = _isolate_link_pair(first_port)
+    replacement = None
+    try:
+        links1.close()
+        _wait_for(
+            lambda: links0.peer_states().get(1) == PEER_DEAD,
+            8.0,
+            "survivor to declare peer 1 dead",
+        )
+        # in-process rebind gotcha: the dead listener's fd lingers until
+        # its 1s accept timeout elapses (a real dead rank is a separate
+        # process whose fds close on exit), so give the port time to free
+        time.sleep(1.3)
+        for attempt in range(10):
+            try:
+                replacement = _ProcessLinks(
+                    1,
+                    2,
+                    first_port,
+                    heartbeat_s=0.1,
+                    liveness_timeout_s=1.0,
+                    fail_policy="isolate",
+                    incarnation=1,
+                )
+                break
+            except OSError:
+                time.sleep(0.5)
+        assert replacement is not None, "replacement never bound its port"
+        _wait_for(
+            lambda: links0.peer_states().get(1) == PEER_ALIVE,
+            8.0,
+            "survivor to admit the rejoining rank",
+        )
+        assert links0.membership()[1]["incarnation"] == 1
+        assert links0.stats["peers_rejoined"] == 1
+        # traffic flows both ways across the healed link
+        links0.send_async(1, ("x", 0), {"hello": 0})
+        replacement.send_async(0, ("x", 0), {"hello": 1})
+        got0 = links0.recv_from_all(("x", 0))
+        got1 = replacement.recv_from_all(("x", 0))
+        assert got0 == {1: {"hello": 1}} and got1 == {0: {"hello": 0}}
+    finally:
+        links0.close()
+        if replacement is not None:
+            replacement.close()
+
+
+@pytest.mark.chaos
+def test_asymmetric_partition_is_detected_not_hung():
+    """Gray failure: ONE direction of one link goes dark (1 -> 0 frames
+    dropped, 0 -> 1 perfect).  The starved side must still classify the
+    silent peer dead within the liveness window — and under the isolate
+    policy neither side fails its whole mesh."""
+    from pathway_tpu.engine.cluster import PEER_DEAD
+
+    liveness = 1.0
+    links0, links1 = _isolate_link_pair(
+        next_port(2), heartbeat_s=0.2, liveness_timeout_s=liveness
+    )
+    try:
+        with chaos(seed=5) as c:
+            c.asymmetric_partition(1, 0, mode="drop")
+            t0 = time.monotonic()
+            _wait_for(
+                lambda: links0.peer_states().get(1) == PEER_DEAD,
+                liveness + 4.0,
+                "starved side to declare the silent peer dead",
+            )
+            detect_s = time.monotonic() - t0
+            assert detect_s < liveness + 2.0, (
+                f"one-way partition detection took {detect_s:.1f}s"
+            )
+            assert links0._failed is None and links1._failed is None
+    finally:
+        links0.close()
+        links1.close()
+
+
+@pytest.mark.chaos
+def test_slow_peer_degrades_but_stays_alive():
+    """A slowed (but alive) rank keeps making its liveness deadlines:
+    seeded per-frame delay below the suspect threshold must not get the
+    peer declared dead, and its frames still arrive."""
+    from pathway_tpu.engine.cluster import PEER_DEAD
+
+    links0, links1 = _isolate_link_pair(
+        next_port(2), heartbeat_s=0.1, liveness_timeout_s=2.0
+    )
+    try:
+        with chaos(seed=9) as c:
+            c.slow_peer(1, delay_s=0.05, jitter_s=0.02)
+            links1.send_async(0, ("y", 0), {"v": 42})
+            got = links0.recv_from_all(("y", 0))
+            assert got == {1: {"v": 42}}
+            time.sleep(0.5)  # several heartbeat intervals under the delay
+            assert links0.peer_states().get(1) != PEER_DEAD
+            assert links0._failed is None
+    finally:
+        links0.close()
+        links1.close()

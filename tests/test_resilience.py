@@ -351,3 +351,109 @@ def test_fs_truncate_clamps_past_end(tmp_path):
     assert impl.read_all("s") == []
     impl.truncate("s", 5)  # empty log + beyond-end request: still fine
     assert impl.read_all("s") == []
+
+
+# ---------------------------------------------------------------------------
+# per-rank supervisor restart + streak-based backoff reset (ISSUE 13)
+
+_RANK_WORKER = """
+import os, sys, time
+pid = int(os.environ["PATHWAY_PROCESS_ID"])
+inc = int(os.environ.get("PATHWAY_CLUSTER_INCARNATION", "0"))
+mode = os.environ.get("DRILL_MODE", "once")
+if mode == "once":
+    # rank 1 dies once at incarnation 0; everyone else finishes clean
+    if pid == 1 and inc == 0:
+        time.sleep(0.15)
+        sys.exit(1)
+    time.sleep(0.6)
+    sys.exit(0)
+else:  # "flaky": rank 0 dies at incarnations 0..3 after a healthy window
+    if pid == 0 and inc < 4:
+        time.sleep(0.4)
+        sys.exit(1)
+    time.sleep(0.5)
+    sys.exit(0)
+"""
+
+
+def _rank_worker(tmp_path):
+    import sys
+
+    prog = tmp_path / "rank_worker.py"
+    prog.write_text(_RANK_WORKER)
+    return [sys.executable, str(prog)]
+
+
+def _rank_policy(max_restarts: int):
+    from pathway_tpu.internals.resilience import ConnectorRecoveryPolicy
+
+    return ConnectorRecoveryPolicy(
+        max_restarts=max_restarts,
+        initial_delay_ms=10,
+        max_delay_ms=50,
+        jitter_ms=0,
+    )
+
+
+def test_restart_scope_rank_respawns_only_dead_rank(tmp_path):
+    """restart_scope='rank': one rank's death respawns only that rank
+    (with a bumped incarnation); survivors are never torn down, and the
+    report carries per-rank restart counts."""
+    from pathway_tpu.internals.resilience import ClusterSupervisor
+
+    sup = ClusterSupervisor(
+        _rank_worker(tmp_path),
+        3,
+        env={"DRILL_MODE": "once"},
+        restart_scope="rank",
+        policy=_rank_policy(3),
+    )
+    report = sup.run(timeout=60)
+    assert report.returncode == 0, report.failures
+    assert report.rank_restarts == {1: 1}, report.rank_restarts
+    assert report.restarts == 1
+    assert len(report.recovery_seconds) == 1
+
+
+def test_restart_scope_validation():
+    import sys
+
+    from pathway_tpu.internals.resilience import ClusterSupervisor
+
+    with pytest.raises(ValueError, match="restart_scope"):
+        ClusterSupervisor([sys.executable, "-c", "pass"], 1, restart_scope="bogus")
+
+
+def test_backoff_streak_resets_after_stable_window(tmp_path):
+    """Regression: the restart budget counts the current failure STREAK,
+    not lifetime restarts.  A rank that fails 4 times with stable-healthy
+    windows in between must survive a max_restarts=2 budget — each reset
+    window clears the streak — while the same schedule with the reset
+    disabled exhausts the budget and gives up."""
+    from pathway_tpu.internals.resilience import ClusterSupervisor
+
+    argv = _rank_worker(tmp_path)
+    with_reset = ClusterSupervisor(
+        argv,
+        2,
+        env={"DRILL_MODE": "flaky"},
+        restart_scope="rank",
+        poll_interval_s=0.02,
+        healthy_reset_polls=5,
+        policy=_rank_policy(2),
+    ).run(timeout=120)
+    assert with_reset.returncode == 0, with_reset.failures
+    assert with_reset.rank_restarts == {0: 4}, with_reset.rank_restarts
+
+    without_reset = ClusterSupervisor(
+        argv,
+        2,
+        env={"DRILL_MODE": "flaky"},
+        restart_scope="rank",
+        poll_interval_s=0.02,
+        healthy_reset_polls=None,
+        policy=_rank_policy(2),
+    ).run(timeout=120)
+    assert without_reset.returncode == 1
+    assert without_reset.rank_restarts == {0: 2}, without_reset.rank_restarts

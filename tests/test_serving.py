@@ -708,3 +708,174 @@ def test_loadgen_reports_per_class_shed_and_latency():
     # class aggregation mirrors the single-tenant-per-class rows
     assert rep["classes"]["batch"]["shed"] == b_row["shed"]
     assert rep["classes"]["interactive"]["completed"] == i_row["completed"]
+
+
+# ---------------------------------------------------------------------------
+# partial-failure survival: degraded serving + shard failover (ISSUE 13)
+
+
+def _partitioned(n_docs: int = 120, n_shards: int = 2, seed: int = 0):
+    from pathway_tpu.serving.failover import PartitionedIndex
+
+    rng = np.random.default_rng(seed)
+    part = PartitionedIndex(
+        lambda: SegmentedIndex(
+            HnswIndex(D, metric="cos"), delta_cap=64, auto_merge=False
+        ),
+        n_shards=n_shards,
+        snapshot_every=32,
+    )
+    corpus = {}
+    for i in range(n_docs):
+        v = rng.standard_normal(D)
+        v /= np.linalg.norm(v)
+        corpus[f"d{i}"] = v
+    part.add(list(corpus.items()))
+    return part, corpus, rng
+
+
+def _brute_topk(corpus: dict, q: np.ndarray, k: int) -> set:
+    ids = sorted(corpus)
+    mat = np.asarray([corpus[i] for i in ids])
+    scores = mat @ (q / np.linalg.norm(q))
+    return {ids[i] for i in np.argsort(-scores)[:k]}
+
+
+def test_shard_health_tracker_streaks():
+    from pathway_tpu.serving.failover import ShardHealthTracker
+
+    t = ShardHealthTracker(2, dead_after=2)
+    assert t.healthy_count() == 2
+    t.record_failure(0)
+    assert t.state(0) == "suspect"
+    t.record_success(0)  # one success demotes suspect back to alive
+    assert t.state(0) == "alive"
+    t.record_failure(0)
+    t.record_failure(0)
+    assert t.state(0) == "dead" and t.dead_shards() == [0]
+    t.record_success(0)  # dead is sticky until an explicit revive
+    assert t.state(0) == "dead"
+    t.revive(0)
+    assert t.state(0) == "alive" and t.healthy_count() == 2
+
+
+def test_partitioned_kill_one_shard_mid_load_partial_then_full_recall():
+    """The ISSUE 13 acceptance drill: kill one of two shard owners while
+    queries are in flight.  Every response keeps resolving (no errors) —
+    degraded ones say ``partial: true`` with shard coverage — writes keep
+    landing in the dead owner's oplog, and after a snapshot restore +
+    exactly-once tail replay recall returns to 1.0 vs brute force while
+    the surviving owner was never restarted."""
+    part, corpus, rng = _partitioned()
+    co = StageCoScheduler(
+        embedder=HashingEmbedder(dim=D), index=part, k=K, lookahead=True
+    )
+    try:
+        stop = threading.Event()
+        results: list[dict] = []
+        errors: list[BaseException] = []
+
+        def load() -> None:
+            i = 0
+            while not stop.is_set():
+                fut = co.submit(f"query {i % 7} alpha", "interactive")
+                try:
+                    results.append(fut.result(timeout=10))
+                except BaseException as e:  # noqa: BLE001 - drill bookkeeping
+                    errors.append(e)
+                i += 1
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.15)  # healthy traffic first
+        part.fail_shard(1)  # one owner dies mid-load
+        time.sleep(0.25)
+        # writes during the outage sequence into the dead owner's oplog
+        extra = {}
+        for j in range(24):
+            v = rng.standard_normal(D)
+            v /= np.linalg.norm(v)
+            extra[f"x{j}"] = v
+        part.add(list(extra.items()))
+        corpus.update(extra)
+        time.sleep(0.15)
+        stop.set()
+        t.join(10.0)
+        assert not errors, f"degraded serving raised: {errors[:3]}"
+        assert results, "no responses resolved during the drill"
+        degraded = [r for r in results if r["partial"]]
+        assert degraded, "no response reported partial coverage"
+        assert all(
+            r["shards_answered"] == 1 and r["shards_total"] == 2
+            for r in degraded
+        )
+        healthy_owner = part.owners[0]
+        assert healthy_owner.restores_total == 0  # survivor untouched
+
+        # snapshot restore + exactly-once tail replay
+        dead = part.owners[1]
+        assert not dead.alive
+        part.recover_shard(1)
+        assert dead.alive and dead.restores_total == 1
+        assert dead.tail_replayed > 0, "tail replay never happened"
+        assert len(part) == len(corpus)  # nothing lost, nothing doubled
+        assert healthy_owner.restores_total == 0
+
+        # recall back to 1.0 vs brute force over the full corpus
+        hits = total = 0
+        for _ in range(10):
+            q = rng.standard_normal(D)
+            got = part.search([q], K)[0]
+            hits += len(_brute_topk(corpus, q, K) & {key for key, _ in got})
+            total += K
+        assert hits / total == 1.0, f"post-recovery recall {hits / total:.3f}"
+        probe = part.dispatch([rng.standard_normal(D)], K)
+        part.collect(probe)
+        assert probe.partial is False and probe.shards_answered == 2
+        assert part.stats()["failovers_total"] == 1
+    finally:
+        co.close()
+        part.close()
+
+
+def test_failover_supervisor_auto_restores_dead_shard():
+    from pathway_tpu.serving.failover import ShardFailoverSupervisor
+
+    part, corpus, rng = _partitioned(n_docs=60)
+    sup = ShardFailoverSupervisor(part, poll_interval_s=0.02)
+    try:
+        part.fail_shard(0)
+        deadline = time.monotonic() + 5.0
+        while part.owners[0].restores_total == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert part.owners[0].alive, "supervisor never restored the shard"
+        assert part.stats()["shards_healthy"] == 2
+        hist = part.stats()["failover_seconds"]
+        assert hist["count"] == 1 and hist["max_ns"] > 0
+    finally:
+        sup.close()
+        part.close()
+
+
+def test_rag_app_sharded_serves_partial_results():
+    """RagServingApp(shards=2) end-to-end: the partial-result contract
+    reaches the answer dict through ingest, lookahead retrieval, and
+    generation."""
+    app = RagServingApp(shards=2, auto_merge=False, delta_cap=64).start()
+    try:
+        for i in range(30):
+            app.upsert(f"doc{i}", f"topic {i % 5} body alpha beta w{i}")
+        assert app.wait_indexed(30, timeout=15)
+        healthy = app.answer("topic 2 alpha")
+        assert healthy["partial"] is False and healthy["shards_total"] == 2
+        app.index.fail_shard(1)
+        degraded = app.answer("topic 3 beta")
+        assert degraded["partial"] is True
+        assert degraded["shards_answered"] == 1
+        assert degraded["docs"], "degraded answer returned no docs"
+        app.index.recover_shard(1)
+        recovered = app.answer("topic 1 alpha")
+        assert recovered["partial"] is False
+        assert app.coscheduler.stats()["degraded_responses"] >= 1
+    finally:
+        app.close()

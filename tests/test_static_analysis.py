@@ -525,6 +525,64 @@ def test_r001_static_path_clean():
     assert "PW-R001" not in codes(analyze())
 
 
+# ---------------------------------------------------------------- R002
+
+
+def test_r002_single_owner_index_without_standby():
+    """Availability hole: checkpoint-covered (hooks + stateful adapter,
+    so no PW-R001) but the only copy of serving state lives on one rank
+    with no snapshot-backed standby."""
+    t = _streaming_table()
+    node = _HookedNode(G.engine_graph, [t._node], "index_sink")
+    node.adapter = _StatefulAdapter()
+    diags = analyze()
+    r002 = [d for d in diags if d.code == "PW-R002"]
+    assert r002 and r002[0].severity == SEV_WARNING
+    assert "standby" in r002[0].message
+
+
+def test_r002_standby_annotation_clean():
+    """Near-miss: the same single-owner node with a declared
+    snapshot-backed standby (meta['failover']['standby']) is covered."""
+    t = _streaming_table()
+    node = _HookedNode(G.engine_graph, [t._node], "index_sink")
+    node.adapter = _StatefulAdapter()
+    node.meta["failover"] = {"standby": True}
+    assert "PW-R002" not in codes(analyze())
+
+
+def test_r002_static_path_clean():
+    """A bounded static pipeline has no availability window to cover."""
+    t = _static_table()
+    node = _HookedNode(G.engine_graph, [t._node], "index_sink")
+    node.adapter = _StatefulAdapter()
+    assert "PW-R002" not in codes(analyze())
+
+
+def test_r002_sharded_serving_graph_clean_single_owner_flagged():
+    """The composed serving graph: RagServingApp(shards=2) stamps the
+    standby annotation (near-miss), the default single-owner app does
+    not (trigger)."""
+    from pathway_tpu.serving import RagServingApp
+
+    app = RagServingApp(shards=2)
+    try:
+        app.build()
+        assert "PW-R002" not in codes(analyze())
+    finally:
+        app.close()
+
+    G.clear()
+    app2 = RagServingApp()
+    try:
+        app2.build()
+        diags = analyze()
+        r002 = [d for d in diags if d.code == "PW-R002"]
+        assert r002 and r002[0].severity == SEV_WARNING
+    finally:
+        app2.close()
+
+
 # ---------------------------------------------- registry + docs (sat 1)
 
 
