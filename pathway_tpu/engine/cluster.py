@@ -81,6 +81,7 @@ from typing import Any, Callable
 
 from pathway_tpu.internals import keys as K
 from pathway_tpu.internals import native as _native_mod
+from pathway_tpu.internals import tracing as _tracing
 
 __all__ = [
     "Cluster",
@@ -242,10 +243,15 @@ class _PeerSender(threading.Thread):
                 # thread mostly measures GIL waits while the workers run;
                 # this thread's own CPU is the compute it displaces
                 t0 = _time.thread_time()
+                t0_ns = _time.monotonic_ns()
                 body = self._encode(items)
                 t1 = _time.thread_time()
                 with links.stats_lock:
                     links.stats["pack_ms"] += (t1 - t0) * 1e3
+                _tracing.record_span(
+                    "pack", t0_ns, _time.monotonic_ns(),
+                    args={"src": links.process_id, "dst": self.peer},
+                )
                 self._transmit(body, len(items))
         except Exception as e:  # socket OR encode failure: fail loudly
             links._fail_peer(
@@ -396,6 +402,10 @@ class _ProcessLinks:
         self._readers: list[threading.Thread] = []
         self._last_seen: dict[int, float] = {}
         self._inbox: dict[Any, dict[int, Any]] = {}
+        #: per-(slot, peer) deposit timestamps (monotonic ns), recorded by
+        #: the reader threads and consumed by the collectives to split the
+        #: aggregate "status-wait" number into per-peer wait spans
+        self._arrival_ns: dict[Any, dict[int, int]] = {}
         self._cv = threading.Condition()
         self._failed: str | None = None
         self._closed = False
@@ -655,6 +665,10 @@ class _ProcessLinks:
                 pass
         if self._hub is not None:
             self._hub.notify()
+        # liveness trip: flush the flight recorder while the rings still
+        # hold the rounds leading up to the failure (no-op without a
+        # spool dir; never raises)
+        _tracing.flush("liveness")
 
     def _fail_peer(self, peer: int, link_version: int, msg: str) -> None:
         """Single-peer failure path.  Under the ``together`` policy this
@@ -678,6 +692,8 @@ class _ProcessLinks:
             # frames must never satisfy a later wait
             for deposits in self._inbox.values():
                 deposits.pop(peer, None)
+            for arrivals in self._arrival_ns.values():
+                arrivals.pop(peer, None)
             sender = self._senders.pop(peer, None)
             sock = self._socks.pop(peer, None)
             self._cv.notify_all()
@@ -692,6 +708,7 @@ class _ProcessLinks:
                 pass
         if self._hub is not None:
             self._hub.notify()
+        _tracing.flush("liveness")
 
     def _read_loop(
         self, peer: int, sock: socket.socket, link_version: int = 0
@@ -712,13 +729,19 @@ class _ProcessLinks:
                 mv = memoryview(body)[:body_len]
                 self._recv_live(peer, sock, mv)
                 t0 = _time.thread_time()  # CPU displaced, not GIL waits
+                t0_ns = _time.monotonic_ns()
                 deposits = self._decode(mv, native)
                 dt = (_time.thread_time() - t0) * 1e3
+                now_ns = _time.monotonic_ns()
                 with self.stats_lock:
                     self.stats["bytes_recv"] += 8 + body_len
                     self.stats["unpack_ms"] += dt
                 if not deposits:
                     continue  # heartbeat: the bytes already did their job
+                _tracing.record_span(
+                    "unpack", t0_ns, now_ns,
+                    args={"src": peer, "dst": self.process_id},
+                )
                 with self._cv:
                     if (
                         self._link_version.get(peer, 0) != link_version
@@ -733,8 +756,10 @@ class _ProcessLinks:
                             )
                         return
                     box = self._inbox
+                    arrivals = self._arrival_ns
                     for slot, payload in deposits:
                         box.setdefault(slot, {})[peer] = payload
+                        arrivals.setdefault(slot, {})[peer] = now_ns
                     self._cv.notify_all()
                 if self._hub is not None:
                     # frame arrival is a scheduler-relevant event: wake any
@@ -854,6 +879,13 @@ class _ProcessLinks:
                 elif got is not None and len(got) == self.n_processes - 1:
                     return self._inbox.pop(slot)
                 self._cv.wait(1.0)
+
+    def pop_arrivals(self, slot: Any) -> dict[int, int]:
+        """Consume the per-peer deposit timestamps (monotonic ns) the
+        reader threads recorded for ``slot`` — the collectives turn these
+        into per-peer wait spans after the slot is satisfied."""
+        with self._cv:
+            return self._arrival_ns.pop(slot, {})
 
     # ------------------------------------------------------------------
     def peer_states(self) -> dict[int, str]:
@@ -975,7 +1007,17 @@ class Cluster:
             "recv_wait_ms": 0.0,
             "allgather_wait_ms": 0.0,
             "status_wait_ms": 0.0,
+            # the aggregate status_wait_ms split by the peer whose frame
+            # arrived at that offset into the wait — the trace records the
+            # same split as per-round "status_wait_peer" spans
+            "status_wait_by_peer_ms": {},
         }
+        #: last epoch trace context received via the round-status
+        #: piggyback from rank 0 (None until the first piggybacked round;
+        #: tests assert genuine cross-rank propagation through this)
+        self.last_epoch_wire: Any = None
+        if processes > 1:
+            _tracing.set_rank(process_id)
 
     def worker_index(self, thread_id: int) -> int:
         return self.process_id * self.threads + thread_id
@@ -991,6 +1033,7 @@ class Cluster:
         """Snapshot of the exchange-overhead probe: collective counts and
         wait times plus transport pack/send/unpack times and volumes."""
         st = dict(self._stats)
+        st["status_wait_by_peer_ms"] = dict(st["status_wait_by_peer_ms"])
         if self._links is not None:
             with self._links.stats_lock:
                 st.update(self._links.stats)
@@ -1014,6 +1057,7 @@ class Cluster:
         # mailbox recv + merge); recorded once per collective on thread 0
         lat = self.latency if thread_id == 0 else None
         t_x0 = _time.perf_counter() if lat is not None else 0.0
+        t_x0_ns = _time.monotonic_ns() if thread_id == 0 else 0
         with self._lock:
             self._local.setdefault(slot, {})[thread_id] = outboxes
         self._barrier.wait()
@@ -1034,9 +1078,20 @@ class Cluster:
                     ]
                     self._links.send_updates_async(peer, slot, boxes)
                 t0 = _time.perf_counter()
+                t0_ns = _time.monotonic_ns()
                 remote = self._links.recv_from_all(slot)
                 wait_s = _time.perf_counter() - t0
                 st["recv_wait_ms"] += wait_s * 1e3
+                # per-peer recv spans: each peer's frame arrival stamps how
+                # long THIS rank's exchange waited on THAT rank — the span
+                # names both sides (src = sender, dst = this rank)
+                arrivals = self._links.pop_arrivals(slot)
+                if _tracing.enabled():
+                    for peer, arr_ns in arrivals.items():
+                        _tracing.record_span(
+                            "exchange_recv", t0_ns, max(arr_ns, t0_ns),
+                            args={"src": peer, "dst": self.process_id},
+                        )
             else:
                 remote = {}
             merged: list[list] = [[] for _ in range(T)]
@@ -1066,6 +1121,11 @@ class Cluster:
                 self._merged.pop(slot, None)
         if lat is not None:
             lat.record("exchange", int((_time.perf_counter() - t_x0) * 1e9))
+        if thread_id == 0:
+            _tracing.record_span(
+                "exchange", t_x0_ns, _time.monotonic_ns(),
+                args={"rank": self.process_id},
+            )
         return result
 
     # ------------------------------------------------------------------
@@ -1091,8 +1151,37 @@ class Cluster:
                     if peer != self.process_id:
                         self._links.send_async(peer, slot, payload)
                 t0 = _time.perf_counter()
+                t0_ns = _time.monotonic_ns()
                 remote = self._links.recv_from_all(slot)
                 st[wait_key] += (_time.perf_counter() - t0) * 1e3
+                # satellite: split the opaque wait by WHICH peer held it —
+                # each peer's deposit timestamp bounds this rank's wait on
+                # that peer; status rounds additionally emit per-peer spans
+                # so a slow rank is attributable to specific rounds
+                arrivals = self._links.pop_arrivals(slot)
+                if wait_key == "status_wait_ms":
+                    by_peer = st["status_wait_by_peer_ms"]
+                    round_no = slot[1] if isinstance(slot, tuple) else None
+                    ctx = (
+                        epoch_trace_context(round_no)
+                        if round_no is not None and _tracing.enabled()
+                        else None
+                    )
+                    for peer, arr_ns in arrivals.items():
+                        waited_ns = max(arr_ns - t0_ns, 0)
+                        by_peer[peer] = (
+                            by_peer.get(peer, 0.0) + waited_ns / 1e6
+                        )
+                        if ctx is not None:
+                            _tracing.record_span(
+                                "status_wait_peer", t0_ns,
+                                t0_ns + waited_ns, ctx=ctx,
+                                args={
+                                    "src": peer,
+                                    "dst": self.process_id,
+                                    "round": round_no,
+                                },
+                            )
             else:
                 remote = {}
             gathered: list = []
@@ -1130,16 +1219,54 @@ class Cluster:
         worker's status tuple.  The status message rides the same framed
         stream as data — the sender thread coalesces it with any operator
         frames still outbound (piggybacked consensus), and an idle round
-        sends it as a lone tiny transmission (the empty-frame fallback)."""
-        return self._gather(
+        sends it as a lone tiny transmission (the empty-frame fallback).
+
+        Trace piggyback: rank 0's thread 0 rides its epoch trace context
+        on its status contribution — every rank derives the same context
+        deterministically (:func:`epoch_trace_context`), so this is the
+        *confirmation* channel that stitches cross-rank spans: receivers
+        remember the last wire context (``last_epoch_wire``), and the
+        wrapper is stripped before the statuses reach the scheduler (its
+        ``s[0..8]`` indexing never sees it)."""
+        tracing_on = _tracing.enabled()
+        if tracing_on and thread_id == 0 and self.process_id == 0:
+            status = (
+                "#tc", epoch_trace_context(round_no).to_wire(), status
+            )
+        gathered = self._gather(
             ("s", round_no), thread_id, status, "status_rounds", "status_wait_ms"
         )
+        # unwrap unconditionally: rank 0 may have tracing on while this
+        # rank has it off, and the scheduler must never see the wrapper
+        out = []
+        for s in gathered:
+            if isinstance(s, tuple) and len(s) == 3 and s[0] == "#tc":
+                self.last_epoch_wire = s[1]
+                out.append(s[2])
+            else:
+                out.append(s)
+        return out
 
     def close(self) -> None:
         self._barrier.abort()  # free local threads blocked in a collective
         self.wakeup.notify()  # free threads parked in the idle branch
         if self._links is not None:
             self._links.close()
+
+
+def epoch_trace_context(round_no: int) -> "_tracing.TraceContext":
+    """The deterministic trace context for one cluster round: every rank
+    derives the identical trace id from the round number alone (FNV-1a —
+    NOT the builtin ``hash``, which is salted per process), so spans
+    recorded on different ranks stitch under one trace without waiting
+    for the piggybacked context to arrive.  The rank-0 context riding the
+    round-status frames (:meth:`Cluster.round_statuses`) then confirms
+    the stitch — and is what tests assert genuine propagation on."""
+    h = 0xCBF29CE484222325
+    for b in b"epoch:%d" % round_no:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    h = h or 1
+    return _tracing.TraceContext(h, h, True)
 
 
 def route_by_key(u: Any) -> int:

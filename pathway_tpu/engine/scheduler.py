@@ -27,11 +27,12 @@ import time as _time
 from collections import defaultdict, deque
 from typing import Any, Callable
 
-from pathway_tpu.engine.cluster import Cluster
+from pathway_tpu.engine.cluster import Cluster, epoch_trace_context
 from pathway_tpu.engine.graph import EngineGraph, InputNode, Node, RunContext
 from pathway_tpu.engine.stream import TIME_STEP, Batch, Update
 from pathway_tpu.internals import api
 from pathway_tpu.internals import native as _native
+from pathway_tpu.internals import tracing as _tracing
 from pathway_tpu.internals.keys import Pointer
 
 def _build_adds(rows: Any) -> list:
@@ -849,7 +850,15 @@ class Scheduler:
                 self.ctx.epoch_origin_ns = origin_ns
                 self.ctx.epoch_cut_ns = cut_ns
                 ep0 = _time.monotonic()
-                self.run_epoch(t, inject)
+                _ectx = (
+                    epoch_trace_context(int(t / TIME_STEP))
+                    if _tracing.enabled()
+                    else None
+                )
+                with _tracing.use(_ectx), _tracing.span(
+                    "epoch_process", {"epoch": int(t)}
+                ):
+                    self.run_epoch(t, inject)
                 last_epoch_s = _time.monotonic() - ep0
                 self.ctx.epoch_origin_ns = None
                 self.ctx.epoch_cut_ns = None
@@ -1191,12 +1200,25 @@ class Scheduler:
                 ctx.epoch_origin_ns = origin_ns
                 ctx.epoch_cut_ns = cut_ns
                 ep0 = _time.monotonic()
+                # trace: the whole epoch runs under the round's
+                # deterministic cross-rank context — exchange / status /
+                # checkpoint spans inside stitch into one timeline across
+                # every rank (round_no was already advanced past the
+                # gather round that cut this epoch)
+                _ectx = (
+                    epoch_trace_context(round_no - 1)
+                    if _tracing.enabled()
+                    else None
+                )
                 # only exchange at operators data can actually reach — the
                 # closure is identical on every worker (same gathered ids)
-                self.run_epoch(
-                    t, inject, ctx=ctx, cluster=cluster, tid=tid,
-                    active=self.active_closure(buffered_ids),
-                )
+                with _tracing.use(_ectx), _tracing.span(
+                    "epoch_process", {"round": round_no - 1, "tid": tid}
+                ):
+                    self.run_epoch(
+                        t, inject, ctx=ctx, cluster=cluster, tid=tid,
+                        active=self.active_closure(buffered_ids),
+                    )
                 last_epoch_s = _time.monotonic() - ep0
                 ctx.epoch_origin_ns = None
                 ctx.epoch_cut_ns = None
@@ -1218,10 +1240,15 @@ class Scheduler:
                         # Async: state pickles here, disk I/O rides the
                         # persistence writer thread off the epoch loop.
                         self._last_snapshot_at[w] = _time.monotonic()
-                        self._final_snapshot(
-                            w, t - TIME_STEP, consumed, wrappers, ctx=ctx,
-                            asynchronous=True,
-                        )
+                        with _tracing.span(
+                            "checkpoint_write",
+                            {"worker": w, "epoch": int(t - TIME_STEP)},
+                            ctx=_ectx,
+                        ):
+                            self._final_snapshot(
+                                w, t - TIME_STEP, consumed, wrappers, ctx=ctx,
+                                asynchronous=True,
+                            )
             elif stop or (source_done and not any_data):
                 break
             else:

@@ -167,6 +167,9 @@ class LatencyProbe:
                 "p99_ms": d["p99_ns"] / 1e6,
                 "max_ms": d["max_ns"] / 1e6,
                 "mean_ms": d["sum_ns"] / n / 1e6,
+                # cumulative sum: the Prometheus _sum companion, so
+                # rate(sum)/rate(count) average math works downstream
+                "sum_ms": d["sum_ns"] / 1e6,
             }
         return out
 
@@ -236,6 +239,7 @@ class LabeledLatencyProbe:
                 "p99_ms": d["p99_ns"] / 1e6,
                 "max_ms": d["max_ns"] / 1e6,
                 "mean_ms": d["sum_ns"] / n / 1e6,
+                "sum_ms": d["sum_ns"] / 1e6,
             }
         return out
 

@@ -1,7 +1,7 @@
 """Per-process monitoring HTTP endpoint (reference
-``src/engine/http_server.rs:21-130``): ``/status`` and OpenMetrics
-``/metrics`` on port ``PATHWAY_MONITORING_HTTP_PORT`` (default 20000) +
-process id."""
+``src/engine/http_server.rs:21-130``): ``/status``, OpenMetrics
+``/metrics``, ``/debug/stacks``, and ``/debug/trace?seconds=N`` on port
+``PATHWAY_MONITORING_HTTP_PORT`` (default 20000) + process id."""
 
 from __future__ import annotations
 
@@ -88,6 +88,8 @@ def _metrics_text(sched: Any) -> str:
     if lat:
         lines.append("# TYPE pathway_tpu_stage_latency_ms gauge")
         lines.append("# TYPE pathway_tpu_stage_latency_count gauge")
+        lines.append("# TYPE pathway_tpu_stage_latency_ms_count counter")
+        lines.append("# TYPE pathway_tpu_stage_latency_ms_sum counter")
         for stage, d in sorted(lat.items()):
             for qk in ("p50", "p95", "p99", "max"):
                 lines.append(
@@ -97,6 +99,16 @@ def _metrics_text(sched: Any) -> str:
             lines.append(
                 f'pathway_tpu_stage_latency_count{{stage="{stage}"}} '
                 f"{d['count']}"
+            )
+            # _count/_sum companions so rate(sum)/rate(count) gives the
+            # true windowed mean (quantile gauges can't be averaged)
+            lines.append(
+                f'pathway_tpu_stage_latency_ms_count{{stage="{stage}"}} '
+                f"{d['count']}"
+            )
+            lines.append(
+                f'pathway_tpu_stage_latency_ms_sum{{stage="{stage}"}} '
+                f"{d.get('sum_ms', 0.0):.4f}"
             )
     # pre-flight static-analyzer finding counts (pathway_tpu/analysis/)
     findings = getattr(sched, "analysis_findings", {}) or {}
@@ -194,6 +206,8 @@ def _metrics_text(sched: Any) -> str:
     if srv_lat:
         lines.append("# TYPE pathway_tpu_stage_latency_ms gauge")
         lines.append("# TYPE pathway_tpu_stage_latency_count gauge")
+        lines.append("# TYPE pathway_tpu_stage_latency_ms_count counter")
+        lines.append("# TYPE pathway_tpu_stage_latency_ms_sum counter")
         for stage, by_class in sorted(srv_lat.items()):
             for cls, d in sorted(by_class.items()):
                 label = str(cls).replace('"', "'")
@@ -206,6 +220,14 @@ def _metrics_text(sched: Any) -> str:
                 lines.append(
                     f'pathway_tpu_stage_latency_count{{stage="{stage}",'
                     f'tenant_class="{label}"}} {d["count"]}'
+                )
+                lines.append(
+                    f'pathway_tpu_stage_latency_ms_count{{stage="{stage}",'
+                    f'tenant_class="{label}"}} {d["count"]}'
+                )
+                lines.append(
+                    f'pathway_tpu_stage_latency_ms_sum{{stage="{stage}",'
+                    f'tenant_class="{label}"}} {d.get("sum_ms", 0.0):.4f}'
                 )
     # degraded serving / shard failover (ISSUE 13): shard health, responses
     # served with partial coverage, and the failover-duration histogram —
@@ -333,6 +355,32 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
             elif self.path.startswith("/metrics"):
                 body = _metrics_text(sched).encode()
                 ctype = "application/openmetrics-text"
+            elif self.path.startswith("/debug/stacks"):
+                from pathway_tpu.internals import tracing
+
+                body = tracing.dump_stacks().encode()
+                ctype = "text/plain"
+            elif self.path.startswith("/debug/trace"):
+                import time as _time
+                from urllib.parse import parse_qs, urlsplit
+
+                from pathway_tpu.internals import tracing
+
+                qs = parse_qs(urlsplit(self.path).query)
+                since_ns = None
+                try:
+                    secs = float(qs["seconds"][0])
+                    since_ns = _time.monotonic_ns() - int(secs * 1e9)
+                except (KeyError, IndexError, ValueError):
+                    pass
+                body = json.dumps(
+                    {
+                        "traceEvents": tracing.chrome_events(
+                            since_ns=since_ns, all_spans=True
+                        )
+                    }
+                ).encode()
+                ctype = "application/json"
             else:
                 self.send_response(404)
                 self.end_headers()
@@ -350,4 +398,9 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
     t = threading.Thread(target=server.serve_forever, daemon=True, name="pw_monitoring")
     t.start()
     sched._monitoring_server = server
+    # SIGUSR2 → dump all thread stacks to stderr and flush the tracing
+    # flight recorder to PATHWAY_TRACE_DIR (no-op off the main thread)
+    from pathway_tpu.internals import tracing
+
+    tracing.install_sigusr2()
     return t

@@ -752,7 +752,21 @@ class ClusterSupervisor:
         rank_restarts: dict[int, int] = {}
         failed_at: float | None = None
 
+        def merge_trace() -> None:
+            # flight recorder: the workers spool per-rank Chrome-trace
+            # dumps (chaos-kill flush, liveness flush, atexit); whenever a
+            # generation ends — restart or completion — fold them into one
+            # stitched merged_trace.json so a post-mortem never has to
+            spool = self.extra_env.get("PATHWAY_TRACE_DIR") or os.environ.get(
+                "PATHWAY_TRACE_DIR"
+            )
+            if spool:
+                from pathway_tpu.internals import tracing as _tracing
+
+                _tracing.merge_trace_dir(spool)
+
         def report(rc: int) -> ClusterRunReport:
+            merge_trace()
             return ClusterRunReport(
                 returncode=rc,
                 restarts=generation + sum(rank_restarts.values()),
@@ -871,6 +885,7 @@ class ClusterSupervisor:
                 failures.append(f"generation {generation}: stopped during backoff")
                 return report(-1)
             telemetry.counter("cluster.restarts")
+            merge_trace()  # fold the dead generation's dumps in now
             failure_streak += 1
             healthy_polls = 0
             generation += 1

@@ -33,6 +33,8 @@ from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable
 
+from pathway_tpu.internals import tracing as _tracing
+
 from .scheduler import SloScheduler
 
 __all__ = ["StageCoScheduler", "extractive_answerer"]
@@ -56,11 +58,24 @@ class _Req:
         "t0_ns",
         "t_embed_ns",
         "t_dispatch_ns",
+        "t_dispatch_done_ns",
+        "t_collect_ns",
+        "t_collect_done_ns",
+        "t_genq_ns",
         "payload",
         "coverage",
+        "trace",
     )
 
-    def __init__(self, query: str, k: int, tenant_class: str, future: Future, t0_ns: int):
+    def __init__(
+        self,
+        query: str,
+        k: int,
+        tenant_class: str,
+        future: Future,
+        t0_ns: int,
+        trace: Any = None,
+    ):
         self.query = query
         self.k = k
         self.tenant_class = tenant_class
@@ -68,10 +83,17 @@ class _Req:
         self.t0_ns = t0_ns
         self.t_embed_ns = 0
         self.t_dispatch_ns = 0
+        self.t_dispatch_done_ns = 0
+        self.t_collect_ns = 0
+        self.t_collect_done_ns = 0
+        self.t_genq_ns = 0
         self.payload: Any = None
         # (partial, shards_answered, shards_total) — the partial-result
         # contract, read off the index probe handle after collect
         self.coverage: tuple[bool, int, int] = (False, 1, 1)
+        #: the request's TraceContext, born at admission and carried
+        #: through every stage hop (threads change; the context doesn't)
+        self.trace = trace
 
 
 class StageCoScheduler:
@@ -125,19 +147,30 @@ class StageCoScheduler:
     # -------------------------------------------------------------- submit
 
     def submit(
-        self, query: str, tenant_class: str = "interactive", k: int | None = None
+        self,
+        query: str,
+        tenant_class: str = "interactive",
+        k: int | None = None,
+        trace: Any = None,
     ) -> Future:
-        """Returns a Future resolving to ``{"answer", "docs", ...}``."""
+        """Returns a Future resolving to ``{"answer", "docs", ...}``.
+        ``trace`` continues the caller's trace (the admission layer's);
+        without one a fresh trace is opened so every response carries a
+        ``trace_id``."""
         fut: Future = Future()
+        if trace is None:
+            trace = _tracing.new_trace()
         req = _Req(
             str(query),
             k if k is not None else self.default_k,
             tenant_class,
             fut,
             time.monotonic_ns(),
+            trace,
         )
         efut = self.scheduler.submit(
-            "embed", tenant_class, self._embed_batch, item=req.query, coalesce="query_embed"
+            "embed", tenant_class, self._embed_batch, item=req.query,
+            coalesce="query_embed", trace=trace,
         )
         efut.add_done_callback(lambda f: self._after_embed(f, req))
         return fut
@@ -157,7 +190,8 @@ class StageCoScheduler:
             )
         vec = efut.result(timeout=0)
         rfut = self.scheduler.submit(
-            "search", req.tenant_class, self._retrieve, item=(req, vec)
+            "search", req.tenant_class, self._retrieve, item=(req, vec),
+            trace=req.trace,
         )
         rfut.add_done_callback(lambda f: self._after_retrieve(f, req))
 
@@ -167,8 +201,13 @@ class StageCoScheduler:
         dispatch = getattr(self.index, "dispatch", None)
         if self.lookahead and dispatch is not None:
             req.t_dispatch_ns = time.monotonic_ns()
-            return ("handle", dispatch(vec, req.k))
-        return ("hits", self.index.search(vec, req.k))
+            handle = dispatch(vec, req.k)
+            req.t_dispatch_done_ns = time.monotonic_ns()
+            return ("handle", handle)
+        req.t_dispatch_ns = time.monotonic_ns()
+        hits = self.index.search(vec, req.k)
+        req.t_dispatch_done_ns = time.monotonic_ns()
+        return ("hits", hits)
 
     def _after_retrieve(self, rfut: Future, req: _Req) -> None:
         exc = rfut.exception(timeout=0)
@@ -176,6 +215,7 @@ class StageCoScheduler:
             self._fail(req, exc)
             return
         req.payload = rfut.result(timeout=0)
+        req.t_genq_ns = time.monotonic_ns()
         overflow = False
         with self._gen_lock:
             if len(self._gen_q) >= self.gen_queue_cap:
@@ -205,8 +245,15 @@ class StageCoScheduler:
         kind, value = req.payload
         if kind == "hits":
             return value[0] if value else []
-        t_collect = time.monotonic_ns()
-        hits = self.index.collect(value)
+        t_collect = req.t_collect_ns = time.monotonic_ns()
+        # ambient for the index's own spans (collect_segments /
+        # collect_shard parent onto the request trace, not trace 0)
+        prev_ctx = _tracing.set_ambient(req.trace)
+        try:
+            hits = self.index.collect(value)
+        finally:
+            _tracing.set_ambient(prev_ctx)
+        req.t_collect_done_ns = time.monotonic_ns()
         # the probe handle carries shard coverage after collect (identity
         # 1/1 for a single index; real health for a PartitionedIndex)
         req.coverage = (
@@ -222,12 +269,14 @@ class StageCoScheduler:
     def _generate(self, req: _Req) -> None:
         try:
             t_hits_start = req.t_embed_ns or req.t0_ns
+            t_pick = time.monotonic_ns()
             hits = self._resolve_hits(req)
             t_hits = time.monotonic_ns()
             docs = [
                 {"id": key, "score": float(score), "text": self.doc_text(key)}
                 for key, score in hits
             ]
+            t_gen = time.monotonic_ns()
             answer = self.answerer(req.query, docs)
             t_done = time.monotonic_ns()
             if self.probe is not None:
@@ -239,6 +288,36 @@ class StageCoScheduler:
             partial, answered, total = req.coverage
             if partial:
                 self.degraded_responses += 1
+            if _tracing.enabled():
+                # materialize the whole request's spans in ONE call from
+                # the timestamps stamped along the way — per-stage record
+                # calls are measurable at this request rate
+                spans = []
+                if req.t_embed_ns:
+                    spans.append(("serve_embed", req.t0_ns, req.t_embed_ns, None))
+                if req.t_dispatch_done_ns:
+                    stage = "dispatch" if req.payload[0] == "handle" else "search"
+                    spans.append(
+                        (stage, req.t_dispatch_ns, req.t_dispatch_done_ns, None)
+                    )
+                if req.t_collect_done_ns:
+                    spans.append(
+                        ("collect", req.t_collect_ns, req.t_collect_done_ns, None)
+                    )
+                if req.t_genq_ns:
+                    # time parked in the generation queue behind the
+                    # previous request — queue-wait, not service time
+                    spans.append(("gen_queue_wait", req.t_genq_ns, t_pick, None))
+                spans.append(("generate", t_gen, t_done, None))
+                # the whole request as one root-level span, then
+                # tail-keep: a request over the tail threshold survives
+                # head sampling
+                spans.append(
+                    ("serve_e2e", req.t0_ns, t_done,
+                     {"class": req.tenant_class})
+                )
+                _tracing.record_spans(req.trace, spans)
+                _tracing.finish_request(req.trace, t_done)
             if not req.future.done():
                 req.future.set_result(
                     {
@@ -251,6 +330,11 @@ class StageCoScheduler:
                         "partial": partial,
                         "shards_answered": answered,
                         "shards_total": total,
+                        # the causal timeline's key: look this id up in a
+                        # flight-recorder dump / /debug/trace export
+                        "trace_id": (
+                            req.trace.trace_id if req.trace is not None else 0
+                        ),
                     }
                 )
         except BaseException as e:  # noqa: BLE001 — fault goes to the caller

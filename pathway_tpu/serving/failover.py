@@ -55,6 +55,7 @@ from pathway_tpu.engine.cluster import (
     PEER_SUSPECT,
     stable_shard,
 )
+from pathway_tpu.internals import tracing as _tracing
 from pathway_tpu.internals.monitoring import _PyHist
 
 __all__ = [
@@ -459,7 +460,8 @@ class PartitionedIndex:
                 entries.append(("standby" if self.standby else "skip", sid))
                 continue
             try:
-                handle = owner.dispatch(queries, k)
+                with _tracing.span("dispatch_shard", {"shard": sid}):
+                    handle = owner.dispatch(queries, k)
             except Exception as e:  # noqa: BLE001 — degrade, don't die
                 self.health.record_failure(sid, repr(e))
                 entries.append(("standby" if self.standby else "skip", sid))
@@ -532,7 +534,8 @@ class PartitionedIndex:
         for entry in probe.entries:
             if entry[0] == "handle":
                 _tag, sid, incarnation, handle = entry
-                hits = self._collect_one(sid, incarnation, handle, probe)
+                with _tracing.span("collect_shard", {"shard": sid}):
+                    hits = self._collect_one(sid, incarnation, handle, probe)
                 if hits is not None:
                     answered += 1
                     for qi in range(n_q):

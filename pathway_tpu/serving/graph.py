@@ -34,6 +34,7 @@ import numpy as np
 import pathway_tpu as pw
 from pathway_tpu.engine.cluster import WakeupHub
 from pathway_tpu.engine.scheduler import Scheduler
+from pathway_tpu.internals import tracing as _tracing
 from pathway_tpu.internals.parse_graph import G
 from pathway_tpu.io._subscribe import subscribe
 from pathway_tpu.io.python import ConnectorSubject
@@ -348,11 +349,21 @@ class RagServingApp:
 
     def submit_query(self, query: str, tenant: str = "default", k: int | None = None):
         """Admit + co-schedule one query; returns a Future.  Raises
-        ``RetryLater`` when the tenant is over its rate or queue bound."""
+        ``RetryLater`` when the tenant is over its rate or queue bound.
+
+        Tracing starts HERE: the request's trace context is born before
+        admission and rides the request object through every stage — the
+        response dict carries its ``trace_id`` back out."""
+        trace = _tracing.new_trace()
+        t0_ns = _tracing.now_ns()
         ticket = self.admission.admit(tenant, route="/v1/answer")
+        _tracing.record_span(
+            "admit", t0_ns, _tracing.now_ns(), ctx=trace,
+            args={"tenant": tenant},
+        )
         try:
             fut = self.coscheduler.submit(
-                query, tenant_class=ticket.tenant_class, k=k
+                query, tenant_class=ticket.tenant_class, k=k, trace=trace
             )
         except BaseException:
             ticket.release()

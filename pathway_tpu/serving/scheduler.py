@@ -35,6 +35,7 @@ from concurrent.futures import Future
 from typing import Any, Callable
 
 from pathway_tpu.engine.cluster import WakeupHub
+from pathway_tpu.internals import tracing as _tracing
 
 from .admission import DEFAULT_CLASS_WEIGHTS
 
@@ -54,6 +55,7 @@ class _Task:
         "cost",
         "vfinish",
         "enq_ns",
+        "trace",
     )
 
     def __init__(
@@ -67,6 +69,7 @@ class _Task:
         cost: float,
         vfinish: float,
         enq_ns: int,
+        trace: Any = None,
     ):
         self.fn = fn
         self.item = item
@@ -77,6 +80,7 @@ class _Task:
         self.cost = cost
         self.vfinish = vfinish
         self.enq_ns = enq_ns
+        self.trace = trace
 
 
 class SloScheduler:
@@ -114,6 +118,9 @@ class SloScheduler:
         self._ewma_item_ns: dict[str, float] = {}
         self._dispatched: dict[tuple[str, str], int] = {}
         self._last_batch: dict[str, int] = {}
+        # per-lane span args, built once: the queue-wait record is per
+        # request, so a fresh dict per record is measurable overhead
+        self._lane_args: dict[str, dict] = {}
         self._submitted = 0
         self._completed = 0
         self._stop = threading.Event()
@@ -153,13 +160,17 @@ class SloScheduler:
         *,
         coalesce: Any = None,
         cost: float = 1.0,
+        trace: Any = None,
     ) -> Future:
         """Enqueue one unit of lane work; returns its Future.
 
         ``coalesce`` non-None marks the task mergeable: the dispatcher
         may batch same-key neighbors into one ``fn(list_of_items)`` call
         returning one result per item, in order.  ``coalesce=None`` runs
-        ``fn(item)`` alone."""
+        ``fn(item)`` alone.  ``trace`` (a
+        :class:`~pathway_tpu.internals.tracing.TraceContext`) rides the
+        task across the queue: the dispatcher records the lane queue-wait
+        as a span under it and executes single-task work with it ambient."""
         if lane not in self._lanes:
             raise KeyError(f"unknown lane {lane!r} (have {sorted(self._lanes)})")
         fut: Future = Future()
@@ -173,7 +184,8 @@ class SloScheduler:
             vfinish = start + float(cost) / max(weight, 1e-9)
             self._last_vf[qkey] = vfinish
             task = _Task(
-                fn, item, fut, lane, tenant_class, coalesce, cost, vfinish, now_ns
+                fn, item, fut, lane, tenant_class, coalesce, cost, vfinish,
+                now_ns, trace,
             )
             self._queues.setdefault(qkey, deque()).append(task)
             self._submitted += 1
@@ -232,6 +244,28 @@ class SloScheduler:
         if self.probe is not None:
             for t in tasks:
                 self.probe.record("serve_sched", cls, t0 - t.enq_ns)
+        # lane queue-wait, per request: the time between submit and this
+        # dispatch is a span on each task's own trace — tail attribution
+        # can then tell queue-wait from service time
+        if _tracing.enabled():
+            wait_args = self._lane_args.get(lane)
+            if wait_args is None:
+                wait_args = self._lane_args[lane] = {"lane": lane}
+            for t in tasks:
+                if t.trace is not None:
+                    _tracing.record_span(
+                        "serve_sched_wait", t.enq_ns, t0, ctx=t.trace,
+                        args=wait_args,
+                    )
+        # single-task (or single-trace batch) execution adopts the trace
+        # as ambient so spans inside fn — index dispatch/collect — nest;
+        # a mixed-trace coalesced batch has no single owner, so none
+        exec_ctx = tasks[0].trace
+        for t in tasks[1:]:
+            if t.trace is not exec_ctx:
+                exec_ctx = None
+                break
+        prev_ctx = _tracing.set_ambient(exec_ctx)
         try:
             if tasks[0].coalesce is not None:
                 results = tasks[0].fn([t.item for t in tasks])
@@ -246,6 +280,8 @@ class SloScheduler:
             for t in tasks:
                 if not t.future.done():
                     t.future.set_exception(e)
+        finally:
+            _tracing.set_ambient(prev_ctx)
         dt = time.monotonic_ns() - t0
         per_item = dt / len(tasks)
         with self._lock:

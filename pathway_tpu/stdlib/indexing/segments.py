@@ -54,6 +54,8 @@ from typing import Any, Callable, Iterable, Sequence
 
 import numpy as np
 
+from pathway_tpu.internals import tracing as _tracing
+
 __all__ = ["SegmentedIndex"]
 
 
@@ -309,8 +311,10 @@ class SegmentedIndex:
             fetch = min(k + len(mask), n_main)
             main_dispatch = getattr(main, "dispatch", None)
             if main_dispatch is not None:
+                t0_ns = _tracing.now_ns()
                 with self._main_mutex:
                     probe = main_dispatch(queries, fetch)
+                _tracing.record_span("dispatch_segments", t0_ns, _tracing.now_ns())
                 with self._lock:
                     self.probes_dispatched += 1
             elif getattr(main, "concurrent_search", False):
@@ -333,7 +337,9 @@ class SegmentedIndex:
         main_hits = handle.main_hits
         if main_hits is None and handle.probe is not None:
             try:
+                t0_ns = _tracing.now_ns()
                 main_hits = handle.main.collect(handle.probe)
+                _tracing.record_span("collect_segments", t0_ns, _tracing.now_ns())
             except RuntimeError:
                 with self._lock:
                     self.probes_recovered += 1

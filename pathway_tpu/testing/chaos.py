@@ -317,6 +317,12 @@ class chaos:
                 count = epochs_by_rank[my_rank]
             if my_rank == rank and count == at_epoch:
                 if hard:
+                    # the whole point of the flight recorder: the dying
+                    # process's spans survive an os._exit (which skips
+                    # atexit) because we flush the rings right here
+                    from pathway_tpu.internals import tracing as _tracing
+
+                    _tracing.flush("chaos_kill")
                     os._exit(exit_code)
                 raise ChaosError(
                     f"injected worker death: rank {rank} at epoch #{count}"
@@ -361,6 +367,9 @@ class chaos:
         def wrapper(seg: Any) -> Any:
             count = self._bump(key)
             if count == on_nth_merge:
+                from pathway_tpu.internals import tracing as _tracing
+
+                _tracing.flush("chaos_kill")  # os._exit skips atexit
                 os._exit(exit_code)
             return orig(seg)
 
@@ -609,8 +618,13 @@ class ClusterDrill:
         liveness_timeout_s: float = 2.0,
         max_restarts: int = 3,
         timeout_s: float = 180.0,
+        trace: bool = False,
     ) -> None:
         self.workdir = str(workdir)
+        #: when set, the drill run spools flight-recorder dumps per rank
+        #: (PATHWAY_TRACE_DIR) and merges them into one Chrome-trace file
+        #: — the killed rank's spans survive via the pre-os._exit flush
+        self.trace = bool(trace)
         self.seed = seed
         self.rng = random.Random(seed)
         self.processes = processes
@@ -694,6 +708,31 @@ class ClusterDrill:
         )
         return sup.run(timeout=self.timeout_s)
 
+    def _trace_env(self) -> dict[str, str]:
+        """Env for a traced drill run: every rank (and every respawned
+        generation) spools flight-recorder dumps into one directory."""
+        if not self.trace:
+            return {}
+        return {"PATHWAY_TRACE_DIR": os.path.join(self.workdir, "trace")}
+
+    def _merge_trace(self) -> tuple[Any, list[int]]:
+        """Merge the per-rank spool into one Chrome-trace file; returns
+        ``(path_or_None, sorted ranks that contributed spans)``."""
+        if not self.trace:
+            return None, []
+        from pathway_tpu.internals import tracing as _tracing
+
+        trace_file = _tracing.merge_trace_dir(
+            os.path.join(self.workdir, "trace")
+        )
+        if trace_file is None:
+            return None, []
+        import json
+
+        with open(trace_file) as f:
+            events = json.load(f).get("traceEvents", [])
+        return trace_file, sorted({int(e.get("pid", 0)) for e in events})
+
     @staticmethod
     def canonical_output(path: str) -> bytes:
         """Consolidate a jsonlines diff log to its final state and
@@ -728,21 +767,23 @@ class ClusterDrill:
             )
 
         prog, drill_out = self._write_program("drill", corpus)
+        drill_env = {
+            "CHAOS_KILL_RANK": str(self.kill_rank),
+            "CHAOS_KILL_EPOCH": str(self.kill_epoch),
+            "CHAOS_SEED": str(self.seed),
+        }
+        drill_env.update(self._trace_env())
         t0 = _time.monotonic()
-        drill_report = self._run_supervised(
-            prog,
-            {
-                "CHAOS_KILL_RANK": str(self.kill_rank),
-                "CHAOS_KILL_EPOCH": str(self.kill_epoch),
-                "CHAOS_SEED": str(self.seed),
-            },
-        )
+        drill_report = self._run_supervised(prog, drill_env)
         faulted_seconds = _time.monotonic() - t0
+        trace_file, trace_ranks = self._merge_trace()
 
         baseline = self.canonical_output(baseline_out)
         recovered = self.canonical_output(drill_out)
         return {
             "ok": drill_report.returncode == 0 and baseline == recovered,
+            "trace_file": trace_file,
+            "trace_ranks": trace_ranks,
             "identical": baseline == recovered,
             "returncode": drill_report.returncode,
             "kill_rank": self.kill_rank,
@@ -1027,9 +1068,11 @@ class IndexDrill(ClusterDrill):
                 "CHAOS_KILL_RANK": str(self.kill_rank),
                 "CHAOS_KILL_MERGE": str(self.kill_merge),
                 "CHAOS_SEED": str(self.seed),
+                **self._trace_env(),
             },
         )
         faulted_seconds = _time.monotonic() - t0
+        trace_file, trace_ranks = self._merge_trace()
 
         import json
 
@@ -1064,6 +1107,8 @@ class IndexDrill(ClusterDrill):
             "faulted_seconds": faulted_seconds,
             "returncode": drill_report.returncode,
             "failures": list(drill_report.failures),
+            "trace_file": trace_file,
+            "trace_ranks": trace_ranks,
         }
 
 

@@ -818,6 +818,7 @@ DEFAULT_TARGETS = (
     "pathway_tpu/serving/coscheduler.py",
     "pathway_tpu/serving/graph.py",
     "pathway_tpu/serving/loadgen.py",
+    "pathway_tpu/internals/tracing.py",
 )
 
 

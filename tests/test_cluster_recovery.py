@@ -417,3 +417,77 @@ def test_slow_peer_degrades_but_stays_alive():
     finally:
         links0.close()
         links1.close()
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder dumps under chaos (ISSUE 14)
+
+
+def _load_merged_trace(report: dict) -> list[dict]:
+    import json
+
+    trace_file = report["trace_file"]
+    assert trace_file and os.path.exists(trace_file), (
+        f"no merged flight-recorder dump: {report}"
+    )
+    with open(trace_file) as f:
+        return json.load(f)["traceEvents"]
+
+
+@pytest.mark.chaos
+def test_kill_worker_flight_recorder_stitches_all_ranks(tmp_path):
+    """A traced 2-proc kill drill must leave ONE merged Chrome-trace
+    file holding spans from every rank — including the killed one (the
+    chaos kill flushes the ring before ``os._exit``) — with epoch traces
+    stitched across processes on the shared monotonic timebase and
+    exchange spans naming both sides (src + dst)."""
+    from pathway_tpu.analysis import tracecrit
+
+    drill = ClusterDrill(str(tmp_path), seed=3, processes=2, trace=True)
+    report = drill.run()
+    assert report["restarts"] >= 1, report
+    assert report["ok"], f"cluster did not recover: {report['failures']}"
+    events = _load_merged_trace(report)
+    ranks = {int(e.get("pid", -1)) for e in events}
+    assert ranks == {0, 1}, f"merged dump missing ranks: {sorted(ranks)}"
+    assert report["kill_rank"] in ranks
+    assert sorted(report["trace_ranks"]) == [0, 1]
+    # cross-process stitch: at least one epoch trace carries spans
+    # recorded by BOTH ranks under one trace id, and its parent chain
+    # resolves (no orphaned fragments)
+    traces = tracecrit.group_traces(events)
+    multi = [
+        tid for tid, spans in traces.items()
+        if len({s.get("pid") for s in spans}) >= 2
+    ]
+    assert multi, "no trace stitched spans from more than one rank"
+    conn = tracecrit.connected_traces(events)
+    assert any(conn[tid] for tid in multi), (
+        "every cross-rank trace has orphaned parents"
+    )
+    exch = [
+        e for e in events
+        if e["name"] in ("pack", "unpack", "exchange_recv", "status_wait_peer")
+    ]
+    assert exch, "no exchange spans survived into the dump"
+    for e in exch:
+        assert {"src", "dst"} <= set(e["args"]), e
+
+
+@pytest.mark.chaos
+def test_kill_worker_mid_merge_flight_recorder_dump(tmp_path):
+    """The mid-merge kill drill (ISSUE 9 harness) with tracing on: the
+    merged dump must exist and hold spans from every rank including the
+    one hard-killed inside the merge-commit window."""
+    drill = IndexDrill(str(tmp_path), seed=7, processes=2, trace=True)
+    report = drill.run()
+    assert report["restarts"] >= 1, report
+    assert report["returncode"] == 0, report["failures"]
+    events = _load_merged_trace(report)
+    ranks = {int(e.get("pid", -1)) for e in events}
+    assert ranks == {0, 1}, f"merged dump missing ranks: {sorted(ranks)}"
+    assert drill.kill_rank in ranks
+    # the dump is usable for attribution: spans have positive-duration
+    # complete events with span identity in args
+    assert all(e.get("ph") == "X" for e in events)
+    assert all("span_id" in e.get("args", {}) for e in events)

@@ -174,3 +174,160 @@ def test_global_graph_clear_resets_state():
     assert len(G.engine_graph.nodes) > 0
     pw.G.clear()
     assert len(G.engine_graph.nodes) == 0
+
+
+def test_metrics_stage_latency_count_sum_companions():
+    """The quantile gauges gained _count/_sum companion counters so
+    rate(sum)/rate(count) yields true windowed means (ISSUE 14)."""
+    from pathway_tpu.engine.scheduler import Scheduler
+    from pathway_tpu.internals.monitoring_server import _metrics_text
+    from pathway_tpu.internals.parse_graph import G
+
+    pw.G.clear()
+    t = T(
+        """
+    a
+    1
+    2
+    """
+    )
+    out = t.select(b=t.a * 2)
+    out._capture_node()
+    sched = Scheduler(G.engine_graph, autocommit_ms=20)
+    sched.run()
+    # known samples: 2ms + 4ms into the process stage
+    sched.latency.record("process", 2_000_000)
+    sched.latency.record("process", 4_000_000)
+    body = _metrics_text(sched)
+    assert "# TYPE pathway_tpu_stage_latency_ms_count counter" in body
+    assert "# TYPE pathway_tpu_stage_latency_ms_sum counter" in body
+    import re
+
+    counts = {
+        m.group(1): int(m.group(2))
+        for m in re.finditer(
+            r'pathway_tpu_stage_latency_ms_count\{stage="([^"]+)"\} (\d+)',
+            body,
+        )
+    }
+    sums = {
+        m.group(1): float(m.group(2))
+        for m in re.finditer(
+            r'pathway_tpu_stage_latency_ms_sum\{stage="([^"]+)"\} ([\d.]+)',
+            body,
+        )
+    }
+    assert set(counts) == set(sums)
+    assert counts["process"] == 2
+    assert sums["process"] == pytest.approx(6.0, rel=0.01)
+
+
+def test_metrics_serving_latency_companions_carry_tenant_class():
+    from pathway_tpu import serving
+    from pathway_tpu.engine.scheduler import Scheduler
+    from pathway_tpu.internals.monitoring_server import _metrics_text
+    from pathway_tpu.internals.parse_graph import G
+
+    pw.G.clear()
+    probe = serving.serving_probe()
+    probe.record("serve_e2e", "interactive", 5_000_000)
+    probe.record("serve_e2e", "interactive", 7_000_000)
+    t = T(
+        """
+    a
+    1
+    """
+    )
+    t.select(b=t.a)._capture_node()
+    sched = Scheduler(G.engine_graph, autocommit_ms=20)
+    body = _metrics_text(sched)
+    assert (
+        'pathway_tpu_stage_latency_ms_count{stage="serve_e2e",'
+        'tenant_class="interactive"}' in body
+    )
+    import re
+
+    m = re.search(
+        r'pathway_tpu_stage_latency_ms_sum\{stage="serve_e2e",'
+        r'tenant_class="interactive"\} ([\d.]+)',
+        body,
+    )
+    assert m is not None and float(m.group(1)) >= 12.0  # 5ms + 7ms
+
+
+def test_debug_stacks_and_trace_endpoints():
+    """/debug/stacks dumps every thread; /debug/trace?seconds=N returns
+    Chrome-trace JSON windowed to the last N seconds (ISSUE 14)."""
+    from pathway_tpu.engine.scheduler import Scheduler
+    from pathway_tpu.internals import tracing
+    from pathway_tpu.internals.monitoring_server import start_http_server
+    from pathway_tpu.internals.parse_graph import G
+
+    pw.G.clear()
+    tracing.configure(PATHWAY_TRACE="1", PATHWAY_TRACE_SAMPLE="1.0")
+    t = T(
+        """
+    a
+    1
+    """
+    )
+    t.select(b=t.a)._capture_node()
+    sched = Scheduler(G.engine_graph, autocommit_ms=20)
+    port = _free_port()
+    try:
+        start_http_server(sched, port=port)
+        ctx = tracing.new_trace()
+        now = tracing.now_ns()
+        tracing.record_span("debug_probe", now - 1_000_000, now, ctx=ctx)
+        stacks = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/stacks", timeout=5
+        ).read().decode()
+        assert "--- Thread" in stacks
+        assert "pw_monitoring" in stacks  # the server's own thread shows up
+        doc = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/trace?seconds=30", timeout=5
+            ).read()
+        )
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "debug_probe" in names
+        # a window that excludes the span returns without it
+        doc0 = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/trace?seconds=0.0000001",
+                timeout=5,
+            ).read()
+        )
+        assert "debug_probe" not in [e["name"] for e in doc0["traceEvents"]]
+    finally:
+        server = getattr(sched, "_monitoring_server", None)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+
+
+def test_sigusr2_dumps_stacks_and_flushes_flight_recorder(tmp_path, capfd):
+    import os
+    import signal
+
+    from pathway_tpu.internals import tracing
+
+    if not tracing.install_sigusr2():
+        pytest.skip("SIGUSR2 handler not installable here")
+    tracing.configure(
+        PATHWAY_TRACE="1",
+        PATHWAY_TRACE_SAMPLE="1.0",
+        PATHWAY_TRACE_DIR=str(tmp_path),
+    )
+    try:
+        ctx = tracing.new_trace()
+        now = tracing.now_ns()
+        tracing.record_span("pre_kill", now - 1000, now, ctx=ctx)
+        os.kill(os.getpid(), signal.SIGUSR2)
+        time.sleep(0.1)  # handler runs on the main thread at a bytecode edge
+        err = capfd.readouterr().err
+        assert "--- Thread" in err
+        dumps = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+        assert any("sigusr2" in f for f in dumps)
+    finally:
+        tracing.configure(PATHWAY_TRACE_DIR=None)

@@ -1,0 +1,313 @@
+"""Unit tests for the span recorder, flight recorder, and critical-path
+attribution (pathway_tpu/internals/tracing.py + analysis/tracecrit.py)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.analysis import tracecrit
+from pathway_tpu.internals import tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.configure(
+        PATHWAY_TRACE="1",
+        PATHWAY_TRACE_SAMPLE="1.0",
+        PATHWAY_TRACE_TAIL_MS=None,
+        PATHWAY_TRACE_RING=None,
+        PATHWAY_TRACE_DIR=None,
+    )
+    tracing.reset()
+    yield
+    tracing.configure(
+        PATHWAY_TRACE=None,
+        PATHWAY_TRACE_SAMPLE=None,
+        PATHWAY_TRACE_TAIL_MS=None,
+        PATHWAY_TRACE_RING=None,
+        PATHWAY_TRACE_DIR=None,
+    )
+    tracing.reset()
+
+
+def _events(**kw):
+    kw.setdefault("all_spans", True)
+    return tracing.chrome_events(**kw)
+
+
+# ------------------------------------------------------------- record path
+
+
+def test_record_span_lands_in_ring_with_context_identity():
+    ctx = tracing.new_trace()
+    t0 = tracing.now_ns()
+    sid = tracing.record_span("work", t0, t0 + 1000, ctx=ctx, args={"k": 3})
+    assert sid != 0
+    (ev,) = [e for e in _events() if e["name"] == "work"]
+    assert ev["ph"] == "X"
+    assert ev["args"]["trace_id"] == ctx.trace_id
+    assert ev["args"]["parent"] == ctx.span_id
+    assert ev["args"]["span_id"] == sid
+    assert ev["args"]["k"] == 3
+    assert ev["dur"] == pytest.approx(1.0)  # µs
+
+
+def test_record_span_disabled_returns_zero_and_records_nothing():
+    tracing.configure(PATHWAY_TRACE="0")
+    ctx = tracing.TraceContext(1, 1)
+    assert tracing.record_span("off", 0, 1, ctx=ctx) == 0
+    assert _events() == []
+
+
+def test_record_spans_batch_shares_parent_and_orders_ids():
+    ctx = tracing.new_trace()
+    t = tracing.now_ns()
+    tracing.record_spans(
+        ctx,
+        [("a", t, t + 10, None), ("b", t + 10, t + 20, None),
+         ("c", t + 20, t + 30, {"n": 1})],
+    )
+    evs = {e["name"]: e for e in _events() if e["name"] in "abc"}
+    assert set(evs) == {"a", "b", "c"}
+    for ev in evs.values():
+        assert ev["args"]["trace_id"] == ctx.trace_id
+        assert ev["args"]["parent"] == ctx.span_id
+    ids = [evs[n]["args"]["span_id"] for n in "abc"]
+    assert ids == sorted(ids) and len(set(ids)) == 3
+    assert evs["c"]["args"]["n"] == 1
+
+
+def test_span_cm_nests_and_parents_onto_enclosing_span():
+    ctx = tracing.new_trace()
+    with tracing.use(ctx):
+        with tracing.span("outer") as outer:
+            with tracing.span("inner"):
+                pass
+    by_name = {e["name"]: e for e in _events()}
+    assert by_name["outer"]["args"]["parent"] == ctx.span_id
+    assert by_name["inner"]["args"]["parent"] == outer.span_id
+    assert by_name["inner"]["args"]["trace_id"] == ctx.trace_id
+
+
+def test_span_cm_contextless_records_unsampled_zero_trace():
+    with tracing.span("orphan"):
+        pass
+    (ev,) = [e for e in _events() if e["name"] == "orphan"]
+    assert ev["args"]["trace_id"] == 0
+    # context-free spans are flight-recorder noise floor: exported even
+    # without all_spans
+    assert [e["name"] for e in tracing.chrome_events()] == ["orphan"]
+
+
+def test_span_cm_toggle_on_mid_block_records_nothing():
+    tracing.configure(PATHWAY_TRACE="0")
+    cm = tracing.span("flip", ctx=tracing.TraceContext(9, 9))
+    cm.__enter__()
+    tracing.configure(PATHWAY_TRACE="1")
+    cm.__exit__(None, None, None)
+    assert _events() == []
+
+
+def test_set_ambient_swaps_and_restores():
+    ctx = tracing.new_trace()
+    assert tracing.current() is None
+    prev = tracing.set_ambient(ctx)
+    assert prev is None and tracing.current() is ctx
+    assert tracing.set_ambient(prev) is ctx
+    assert tracing.current() is None
+
+
+def test_ring_wraps_keeping_most_recent_spans():
+    tracing.configure(PATHWAY_TRACE_RING="64")
+    tracing.reset()
+    ctx = tracing.new_trace()
+    for i in range(200):
+        tracing.record_span(f"s{i}", i, i + 1, ctx=ctx)
+    names = [e["name"] for e in _events()]
+    assert len(names) == 64
+    assert names[-1] == "s199" and "s0" not in names
+
+
+def test_span_ids_unique_across_threads():
+    ctx = tracing.new_trace()
+    done = []
+
+    def work(tag):
+        for i in range(50):
+            tracing.record_span(f"{tag}", i, i + 1, ctx=ctx)
+        done.append(tag)
+
+    ts = [threading.Thread(target=work, args=(f"t{j}",)) for j in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(done) == 4
+    ids = [e["args"]["span_id"] for e in _events() if e["name"].startswith("t")]
+    assert len(ids) == 200 and len(set(ids)) == 200
+
+
+# -------------------------------------------------- sampling + tail keep
+
+
+def test_head_sampling_governs_export_not_recording():
+    tracing.configure(PATHWAY_TRACE_SAMPLE="0.0")
+    ctx = tracing.new_trace()
+    assert ctx.sampled is False
+    tracing.record_span("hidden", 0, 1000, ctx=ctx)
+    # not exported by default...
+    assert [e for e in tracing.chrome_events() if e["name"] == "hidden"] == []
+    # ...but the flight recorder still holds it
+    assert [e for e in _events() if e["name"] == "hidden"]
+
+
+def test_tail_keep_resurrects_slow_unsampled_request():
+    tracing.configure(PATHWAY_TRACE_SAMPLE="0.0", PATHWAY_TRACE_TAIL_MS="1")
+    ctx = tracing.new_trace()
+    tracing.record_span("slow_req", ctx.t0_ns, ctx.t0_ns + 5_000_000, ctx=ctx)
+    tracing.finish_request(ctx, ctx.t0_ns + 5_000_000)  # 5ms > 1ms threshold
+    assert [e for e in tracing.chrome_events() if e["name"] == "slow_req"]
+
+
+def test_fast_unsampled_request_stays_hidden():
+    tracing.configure(PATHWAY_TRACE_SAMPLE="0.0", PATHWAY_TRACE_TAIL_MS="1")
+    ctx = tracing.new_trace()
+    tracing.record_span("fast_req", ctx.t0_ns, ctx.t0_ns + 10_000, ctx=ctx)
+    tracing.finish_request(ctx, ctx.t0_ns + 10_000)  # 10µs < 1ms threshold
+    assert [e for e in tracing.chrome_events() if e["name"] == "fast_req"] == []
+
+
+# ------------------------------------------------------- context on wire
+
+
+def test_trace_context_wire_roundtrip():
+    ctx = tracing.TraceContext(123, 456, sampled=False)
+    back = tracing.TraceContext.from_wire(ctx.to_wire())
+    assert (back.trace_id, back.span_id, back.sampled) == (123, 456, False)
+    assert tracing.TraceContext.from_wire("garbage") is None
+    assert tracing.TraceContext.from_wire(None) is None
+
+
+# ----------------------------------------------------- dump + merge paths
+
+
+def test_dump_and_merge_trace_dir_stitch_ranks(tmp_path):
+    spool = str(tmp_path)
+    tracing.configure(PATHWAY_TRACE_DIR=spool)
+    ctx = tracing.new_trace()
+    tracing.set_rank(0)
+    tracing.record_span("r0_work", 0, 1000, ctx=ctx)
+    assert tracing.flush("test")
+    # same machine-wide ids, different "process": re-stamp the rank the
+    # way a supervised worker would and flush again
+    tracing.reset()
+    tracing.configure(PATHWAY_TRACE_DIR=spool)
+    tracing.set_rank(1)
+    tracing.record_span("r1_work", 2000, 3000, ctx=ctx)
+    assert tracing.flush("test")
+    merged = tracing.merge_trace_dir(spool)
+    assert merged and os.path.exists(merged)
+    with open(merged) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1}
+    assert {e["name"] for e in evs} == {"r0_work", "r1_work"}
+    tracing.set_rank(0)
+
+
+def test_merge_trace_dir_empty_and_missing(tmp_path):
+    assert tracing.merge_trace_dir(str(tmp_path)) is None
+    assert tracing.merge_trace_dir(str(tmp_path / "nope")) is None
+
+
+def test_flush_without_spool_is_noop():
+    assert tracing.flush("test") is None
+
+
+def test_dump_stacks_names_this_thread():
+    text = tracing.dump_stacks()
+    assert "--- Thread" in text
+    assert "test_dump_stacks_names_this_thread" in text
+
+
+# -------------------------------------------------------------- tracecrit
+
+
+def _synthetic_trace(trace_id=7, base=1000.0):
+    """root(10ms) -> [queue(4ms), work(5ms) -> inner_search(3ms)]"""
+
+    def ev(name, sid, parent, ts, dur):
+        return {
+            "ph": "X", "name": name, "pid": 0, "tid": "t",
+            "ts": ts, "dur": dur,
+            "args": {"trace_id": trace_id, "span_id": sid, "parent": parent},
+        }
+
+    return [
+        ev("serve_e2e", 70, trace_id, base, 10_000.0),
+        ev("serve_sched_wait", 71, 70, base, 4_000.0),
+        ev("generate", 72, 70, base + 4_000.0, 5_000.0),
+        ev("search", 73, 72, base + 4_500.0, 3_000.0),
+    ]
+
+
+def test_attribute_exclusive_times_partition_the_root():
+    info = tracecrit.attribute(_synthetic_trace())
+    by = info["by_stage_ms"]
+    assert by["serve_sched_wait"] == pytest.approx(4.0)
+    assert by["generate"] == pytest.approx(2.0)  # 5ms minus 3ms child
+    assert by["search"] == pytest.approx(3.0)
+    assert by["serve_e2e"] == pytest.approx(1.0)  # 10 - (4 + 5) covered
+    assert sum(by.values()) == pytest.approx(info["wall_ms"])
+    cats = info["by_category_ms"]
+    assert cats["queue_wait"] == pytest.approx(4.0)
+    assert cats["device"] == pytest.approx(5.0)
+
+
+def test_critical_path_descends_into_biggest_child():
+    path = tracecrit.critical_path(_synthetic_trace())
+    assert [p["stage"] for p in path] == ["serve_e2e", "generate", "search"]
+    assert path[0]["ms"] == pytest.approx(10.0)
+
+
+def test_connected_traces_flags_orphaned_parent():
+    good = _synthetic_trace(trace_id=7)
+    bad = _synthetic_trace(trace_id=8)
+    bad[3]["args"]["parent"] = 99999  # points at a span nobody recorded
+    conn = tracecrit.connected_traces(good + bad)
+    assert conn[7] is True and conn[8] is False
+
+
+def test_report_rolls_up_quantiles_and_critical_path():
+    events = []
+    for i in range(10):
+        events += _synthetic_trace(trace_id=100 + i, base=i * 100_000.0)
+    rep = tracecrit.report(events)
+    assert rep["requests"] == 10
+    assert rep["p50"]["wall_ms"] == pytest.approx(10.0)
+    assert rep["p99"]["wall_ms"] == pytest.approx(10.0)
+    assert rep["mean_by_category_ms"]["device"] == pytest.approx(5.0)
+    assert [s["stage"] for s in rep["slowest"]["critical_path"]][0] == "serve_e2e"
+    assert tracecrit.report([]) == {"requests": 0}
+
+
+def test_report_over_real_recorded_spans():
+    """End-to-end: record via the real API, export, attribute."""
+    ctx = tracing.new_trace()
+    with tracing.use(ctx):
+        with tracing.span("serve_e2e"):
+            with tracing.span("serve_sched_wait"):
+                time.sleep(0.002)
+            with tracing.span("generate"):
+                time.sleep(0.003)
+    tracing.finish_request(ctx)
+    rep = tracecrit.report(_events())
+    assert rep["requests"] == 1
+    p50 = rep["p50"]["by_category_ms"]
+    assert p50["queue_wait"] >= 1.0
+    assert p50["device"] >= 2.0
+    conn = tracecrit.connected_traces(_events())
+    assert conn[ctx.trace_id] is True
