@@ -81,8 +81,12 @@ def smoke_analyze(graph_name: str) -> None:
     if not SMOKE:
         return
     from pathway_tpu.analysis import SEV_ERROR, analyze, format_diagnostics
+    from pathway_tpu.analysis.rewrite import resolve_level
 
-    diags = analyze()
+    # plan-aware, like pw.run(strict=...): gate on the view that will
+    # execute, so a rewrite that cures a finding (append-only reducer
+    # specialization, dead columns) also clears the gate
+    diags = analyze(optimize=resolve_level(None))
     errors = [d for d in diags if d.severity == SEV_ERROR]
     if errors:
         log(format_diagnostics(diags))
@@ -999,6 +1003,238 @@ def bench_index_churn(extra: dict) -> None:
         )
 
 
+def bench_capacity(extra: dict) -> None:
+    """Capacity cross-validation (ISSUE 15): the static estimator's
+    predicted steady-state operator bytes (``pw.estimate_memory`` with
+    the ACTUAL run scenario in ``PATHWAY_MEMORY_*``) against the
+    scheduler's sampled operator state (``approx_state_bytes`` over
+    ``ctx.states``, the same numbers /metrics exports as
+    ``pathway_tpu_state_bytes``) on two graphs: the batch wordcount
+    (groupby state keyed by word) and a keyed index-churn pipeline
+    (upsert source + external KNN index under re-upserts).  The ratio
+    predicted/measured per graph lands in ``BENCH_capacity.json``;
+    ``--smoke`` gates it to within 3x both ways — the estimator is a
+    provisioning tool, an order-of-magnitude miss means its constants
+    or growth classes no longer describe the engine."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.monitoring import memory_stats
+    from pathway_tpu.internals.parse_graph import G
+
+    bound = 3.0
+    graphs: dict[str, dict] = {}
+    saved_env: dict[str, str | None] = {}
+
+    def set_scenario(**kv) -> dict:
+        scenario = {}
+        for k, v in kv.items():
+            key = f"PATHWAY_MEMORY_{k.upper()}"
+            saved_env.setdefault(key, os.environ.get(key))
+            os.environ[key] = str(v)
+            scenario[k] = v
+        return scenario
+
+    def compare(tag: str, scenario: dict) -> dict:
+        sched = G.active_scheduler
+        stats = memory_stats(sched) if sched is not None else {}
+        ops = {}
+        pred = meas = 0
+        # only operators with BOTH a static estimate and sampled state
+        # enter the ratio: stateless probes and un-modeled nodes would
+        # turn the gate into a row-count comparison
+        for label, v in sorted(stats.items()):
+            if v["estimated"] > 0 and v["measured"] > 0:
+                pred += v["estimated"]
+                meas += v["measured"]
+                ops[label] = {
+                    "predicted_bytes": v["estimated"],
+                    "measured_bytes": v["measured"],
+                    "growth": v["growth"],
+                    "ratio": round(v["estimated"] / v["measured"], 3),
+                }
+        if not ops:
+            raise RuntimeError(
+                f"capacity {tag}: no operator had both a static estimate "
+                f"and sampled state ({len(stats)} probe(s))"
+            )
+        ratio = pred / meas
+        log(
+            f"capacity {tag}: predicted {pred} B vs measured {meas} B "
+            f"-> {ratio:.2f}x over {len(ops)} stateful op(s)"
+        )
+        return {
+            "scenario": scenario,
+            "predicted_bytes": pred,
+            "measured_bytes": meas,
+            "ratio": round(ratio, 3),
+            "operators": ops,
+        }
+
+    d = tempfile.mkdtemp(prefix="pw_bench_cap_")
+    try:
+        # -- graph 1: batch wordcount, state = one group per word --------
+        n_lines = 20_000 if SMOKE else 100_000
+        fp = os.path.join(d, "lines.jsonl")
+        rng = np.random.default_rng(5)
+        with open(fp, "w") as f:
+            for w in rng.integers(0, WC_WORDS, size=n_lines):
+                f.write('{"word": "w%d"}\n' % w)
+        G.clear()
+        scenario = set_scenario(rows=n_lines, keys=WC_WORDS, str_bytes=8)
+
+        class S(pw.Schema):
+            word: str
+
+        lines = pw.io.jsonlines.read(fp, schema=S, mode="static")
+        counts = lines.groupby(lines.word).reduce(
+            lines.word, n=pw.reducers.count()
+        )
+        cap = counts._capture_node()
+        ctx = pw.run()
+        rows = ctx.state(cap)["rows"]
+        total = sum(v[1] for v in rows.values())
+        assert total == n_lines, f"lost rows: {total} != {n_lines}"
+        graphs["wordcount"] = compare("wordcount", scenario)
+
+        # -- graph 2: keyed upserts through an external KNN index --------
+        # (examples/index_churn.py at bench scale: every key re-upserted
+        # once, so the index holds n_docs live vectors after 1.5x adds)
+        n_docs = 1_000 if SMOKE else 4_000
+        churn = n_docs // 2
+        # the scenario's ``keys`` knob is global (one cardinality for
+        # every upsert source), so the query feed runs at half the doc
+        # count rather than a token handful — otherwise the per-op
+        # breakdown for the query source would be a pure scenario miss
+        n_q = n_docs // 2
+        G.clear()
+        scenario = set_scenario(
+            rows=n_docs + churn + n_q,
+            keys=n_docs,
+            str_bytes=8,
+            array_bytes=160,
+        )
+        from pathway_tpu.io.python import ConnectorSubject
+        from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+        class Doc(pw.Schema):
+            doc_id: str = pw.column_definition(primary_key=True)
+            vx: float
+            vy: float
+            vz: float
+            vw: float
+
+        class Query(pw.Schema):
+            qid: str = pw.column_definition(primary_key=True)
+            qx: float
+            qy: float
+            qz: float
+            qw: float
+
+        vec_rng = np.random.default_rng(6)
+        vecs = vec_rng.standard_normal((n_docs + churn, 4)).astype(float)
+
+        class DocFeed(ConnectorSubject):
+            def run(self) -> None:
+                for i in range(n_docs + churn):
+                    # the tail re-upserts existing keys: delta churn
+                    key = i if i < n_docs else (i - n_docs) * 2
+                    self.next(
+                        doc_id=f"doc{key}",
+                        vx=vecs[i, 0],
+                        vy=vecs[i, 1],
+                        vz=vecs[i, 2],
+                        vw=vecs[i, 3],
+                    )
+                    if i % 512 == 511:
+                        self.commit()
+                self.commit()
+
+        class QueryFeed(ConnectorSubject):
+            def run(self) -> None:
+                for i in range(n_q):
+                    self.next(
+                        qid=f"q{i}", qx=1.0, qy=float(i), qz=0.0, qw=0.0
+                    )
+                self.commit()
+
+        docs = pw.io.python.read(DocFeed("docs"), schema=Doc, name="docs")
+        docs = docs.select(
+            doc_id=pw.this.doc_id,
+            vec=pw.apply(
+                lambda a, b, c, e: (float(a), float(b), float(c), float(e)),
+                pw.this.vx,
+                pw.this.vy,
+                pw.this.vz,
+                pw.this.vw,
+            ),
+        )
+        queries = pw.io.python.read(
+            QueryFeed("queries"), schema=Query, name="queries"
+        )
+        queries = queries.select(
+            qid=pw.this.qid,
+            qvec=pw.apply(
+                lambda a, b, c, e: (float(a), float(b), float(c), float(e)),
+                pw.this.qx,
+                pw.this.qy,
+                pw.this.qz,
+                pw.this.qw,
+            ),
+        )
+        index = BruteForceKnnFactory(
+            dimensions=4, reserved_space=n_docs + n_q
+        ).build_data_index(docs.vec, docs)
+        hits = index.query_as_of_now(queries.qvec, number_of_matches=2)
+        answered: list = []
+        pw.io.subscribe(
+            hits,
+            on_change=lambda key, row, time, is_addition: answered.append(key),
+        )
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        assert answered, "index-churn queries produced no results"
+        graphs["index_churn"] = compare("index_churn", scenario)
+    finally:
+        for key, old in saved_env.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+    for tag, rep in graphs.items():
+        extra[f"capacity_{tag}_ratio"] = rep["ratio"]
+        extra[f"capacity_{tag}_predicted_bytes"] = rep["predicted_bytes"]
+        extra[f"capacity_{tag}_measured_bytes"] = rep["measured_bytes"]
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_capacity.json"
+    )
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "cmd": "JAX_PLATFORMS=cpu python bench.py (bench_capacity)",
+                "estimator": (
+                    "pw.estimate_memory with PATHWAY_MEMORY_* pinned to "
+                    "the run scenario vs approx_state_bytes sampled over "
+                    "ctx.states at run end; ratio over operators with "
+                    "both an estimate and live state"
+                ),
+                "bound_x": bound,
+                "graphs": graphs,
+            },
+            f,
+            indent=2,
+            sort_keys=True,
+        )
+        f.write("\n")
+    log(f"wrote {out}")
+    if SMOKE:
+        for tag, rep in graphs.items():
+            r = rep["ratio"]
+            if not (1.0 / bound <= r <= bound):
+                raise RuntimeError(
+                    f"capacity prediction on {tag} is {r:.2f}x measured — "
+                    f"outside the {bound:g}x cross-validation bound"
+                )
+
+
 def bench_rag_serving(extra: dict) -> None:
     """Multi-tenant RAG serving (``pathway_tpu/serving/``, ISSUE 10):
     per-tenant-class p50/p99 vs offered load, measured open-loop under
@@ -1567,6 +1803,7 @@ def main() -> None:
         (bench_checkpoint_overhead, "checkpoint_overhead"),
         (bench_cluster_recovery, "cluster_recovery"),
         (bench_index_churn, "index_churn"),
+        (bench_capacity, "capacity"),
         (bench_rag_serving, "rag_serving"),
         (bench_failover, "failover"),
         (bench_tracing, "tracing"),

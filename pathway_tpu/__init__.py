@@ -199,7 +199,16 @@ def __getattr__(name: str) -> Any:
         import pathway_tpu.analysis as analysis
 
         return analysis
-    if name in ("analyze", "explain", "Diagnostic", "AnalysisError", "ExecutionPlan"):
+    if name in (
+        "analyze",
+        "explain",
+        "estimate_memory",
+        "MemoryReport",
+        "EstimateParams",
+        "Diagnostic",
+        "AnalysisError",
+        "ExecutionPlan",
+    ):
         from pathway_tpu import analysis
 
         return getattr(analysis, name)
@@ -258,6 +267,9 @@ __all__ = [
     "G",
     "analyze",
     "explain",
+    "estimate_memory",
+    "MemoryReport",
+    "EstimateParams",
     "Diagnostic",
     "AnalysisError",
     "ExecutionPlan",

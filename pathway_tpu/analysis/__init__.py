@@ -36,6 +36,11 @@ from pathway_tpu.analysis.diagnostics import (
     sort_diagnostics,
 )
 from pathway_tpu.analysis.graph_facts import GraphFacts
+from pathway_tpu.analysis.memory import (
+    EstimateParams,
+    MemoryReport,
+    estimate_memory,
+)
 from pathway_tpu.analysis.passes import ALL_PASSES
 from pathway_tpu.analysis.plan import ExecutionPlan
 from pathway_tpu.analysis.rewrite import optimize_graph, resolve_level
@@ -44,6 +49,9 @@ __all__ = [
     "analyze",
     "explain",
     "lint_file",
+    "estimate_memory",
+    "EstimateParams",
+    "MemoryReport",
     "Diagnostic",
     "AnalysisError",
     "CODES",
@@ -61,15 +69,25 @@ __all__ = [
 ]
 
 
-def analyze(graph: Any = None) -> list[Diagnostic]:
+def analyze(graph: Any = None, optimize: "int | None" = None) -> list[Diagnostic]:
     """Statically analyze a captured graph (default: the global parse
     graph) and return sorted diagnostics.  Never raises on exotic
-    graphs: a pass that cannot reason about a node skips it."""
+    graphs: a pass that cannot reason about a node skips it.
+
+    ``optimize`` (plan-aware mode) runs every pass over the
+    ``optimize_graph`` rewritten view at that level — what the scheduler
+    will actually execute — so rewrites that remove work (dead columns,
+    append-only reducer specialization) also remove the findings they
+    cure.  ``None`` (the default) analyzes the captured graph as built."""
     if graph is None:
         from pathway_tpu.internals.parse_graph import G
 
         graph = G.engine_graph
     engine_graph = getattr(graph, "engine_graph", graph)
+    if optimize is not None:
+        level = resolve_level(optimize)
+        if level > 0:
+            engine_graph, _plan = optimize_graph(engine_graph, level)
     facts = GraphFacts(engine_graph)
     diags: list[Diagnostic] = []
     for p in ALL_PASSES:

@@ -85,6 +85,23 @@ CODE_INFO: dict[str, tuple[str, str]] = {
         "until recovery completes (an availability hole degraded serving "
         "cannot cover)",
     ),
+    "PW-M001": (
+        SEV_ERROR,
+        "linear-in-stream operator state on an unbounded streaming path "
+        "that reaches a sink: memory use grows with every row ingested, "
+        "so the deployment dies by OOM schedule, not by load",
+    ),
+    "PW-M002": (
+        SEV_WARNING,
+        "estimated steady-state footprint exceeds PATHWAY_MEMORY_BUDGET "
+        "(per-operator breakdown in details): provision more memory, "
+        "shard wider, or bound retention",
+    ),
+    "PW-M003": (
+        SEV_WARNING,
+        "checkpoint bytes grow with stream length (stream-linear state is "
+        "snapshotted): recovery-time targets degrade as the run ages",
+    ),
 }
 
 #: every code the analyzer can emit, with its fixed severity (derived —

@@ -347,6 +347,7 @@ def check_dead_columns(
 
 
 from pathway_tpu.analysis.distribution import check_distribution  # noqa: E402
+from pathway_tpu.analysis.memory import check_memory  # noqa: E402
 
 ALL_PASSES = (
     check_types,
@@ -355,4 +356,5 @@ ALL_PASSES = (
     check_append_only,
     check_dead_columns,
     check_distribution,
+    check_memory,
 )

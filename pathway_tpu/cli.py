@@ -52,13 +52,18 @@ def lint(
     *,
     werror: bool = False,
     plan: bool = False,
+    memory: bool = False,
     baseline: str | None = None,
 ) -> int:
     """Build ``program``'s dataflow graph without running it and print
     the pre-flight analyzer's findings (``pathway_tpu/analysis/``).
     With ``plan=True`` also print the optimizer's execution plan for the
     built graph (``pw.explain()`` textual form, at the PATHWAY_OPTIMIZE
-    level).  ``baseline`` names a JSON file mapping program basenames to
+    level); with ``memory=True`` also print the plan-aware capacity
+    report (``pw.estimate_memory()``; scenario and budget come from the
+    PATHWAY_MEMORY_* environment — a blown PATHWAY_MEMORY_BUDGET
+    surfaces as a PW-M002 finding above, not a separate exit path).
+    ``baseline`` names a JSON file mapping program basenames to
     ACCEPTED warning codes: baselined warnings are still printed but do
     not fail ``--werror`` (errors are never baselined — an accepted
     hazard belongs in the config, not silenced in code).  Exit 1 on
@@ -83,6 +88,11 @@ def lint(
         from pathway_tpu.analysis import explain
 
         print(explain().format())
+    if memory:
+        # same built graph: the plan-aware capacity report
+        from pathway_tpu.analysis import estimate_memory
+
+        print(estimate_memory().format())
     errors = sum(1 for d in diags if d.severity == SEV_ERROR)
     warnings = len(diags) - errors
     gating = [
@@ -130,6 +140,11 @@ def main(argv: list[str] | None = None) -> int:
         help="also print the optimizer's execution plan",
     )
     lp.add_argument(
+        "--memory",
+        action="store_true",
+        help="also print the plan-aware memory capacity report",
+    )
+    lp.add_argument(
         "--baseline",
         default=None,
         help="JSON file of accepted warning codes per program basename",
@@ -154,6 +169,7 @@ def main(argv: list[str] | None = None) -> int:
             args.program,
             werror=args.werror,
             plan=args.plan,
+            memory=args.memory,
             baseline=args.baseline,
         )
     return 2

@@ -289,6 +289,37 @@ class ProberStats:
     #: co-scheduler overlap, per-(stage, tenant_class) latency); empty
     #: when no serving component is live in this process
     serving: dict[str, Any] = field(default_factory=dict)
+    #: capacity cross-validation per stateful operator
+    #: ({operator: {"estimated": bytes, "measured": bytes, "growth"}};
+    #: estimated from analysis/memory.py over the executing plan view,
+    #: measured sampled by the scheduler into the operator probes)
+    memory: dict[str, Any] = field(default_factory=dict)
+
+
+def memory_stats(sched: Any) -> dict[str, Any]:
+    """Estimated vs measured state bytes, joined per operator label."""
+    out: dict[str, Any] = {}
+    est = getattr(sched, "memory_estimate", None)
+    if est is not None and getattr(est, "operators", None):
+        for o in est.operators:
+            out[f"{o.name}#{o.node_id}"] = {
+                "estimated": o.total_bytes,
+                "growth": o.growth,
+                "measured": 0,
+            }
+    try:
+        probes = sched.snapshot_operator_probes()
+    except Exception:
+        probes = {}
+    for p in probes.values():
+        measured = p.get("state_bytes", 0)
+        if not measured:
+            continue
+        entry = out.setdefault(
+            p["name"], {"estimated": 0, "growth": None, "measured": 0}
+        )
+        entry["measured"] = measured
+    return out
 
 
 def collect_stats(sched: Any) -> ProberStats:
@@ -327,6 +358,7 @@ def collect_stats(sched: Any) -> ProberStats:
         analysis=dict(getattr(sched, "analysis_findings", {}) or {}),
         checkpoint=checkpoint_stats(sched),
         serving=serving_stats(),
+        memory=memory_stats(sched),
     )
 
 

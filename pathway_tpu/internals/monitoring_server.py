@@ -68,6 +68,7 @@ def _metrics_text(sched: Any) -> str:
         lines.append("# TYPE pathway_tpu_operator_rows_in_total counter")
         lines.append("# TYPE pathway_tpu_operator_rows_out_total counter")
         lines.append("# TYPE pathway_tpu_operator_latency_ms_total counter")
+        lines.append("# TYPE pathway_tpu_state_bytes gauge")
         for p in probes.values():
             label = p["name"].replace('"', "'")
             lines.append(
@@ -81,6 +82,21 @@ def _metrics_text(sched: Any) -> str:
             lines.append(
                 f'pathway_tpu_operator_latency_ms_total{{operator="{label}"}} '
                 f"{p['total_ms']:.3f}"
+            )
+            lines.append(
+                f'pathway_tpu_state_bytes{{operator="{label}"}} '
+                f"{p.get('state_bytes', 0)}"
+            )
+    # static capacity predictions next to the measured gauges above —
+    # the cross-validation pair (analysis/memory.py); same operator label
+    est = getattr(sched, "memory_estimate", None)
+    if est is not None and getattr(est, "operators", None):
+        lines.append("# TYPE pathway_tpu_state_bytes_estimated gauge")
+        for o in est.operators:
+            label = f"{o.name}#{o.node_id}".replace('"', "'")
+            lines.append(
+                f'pathway_tpu_state_bytes_estimated{{operator="{label}"}} '
+                f"{o.total_bytes}"
             )
     # per-stage streaming latency histograms (ISSUE 4 tentpole c): the
     # scheduler's LatencyProbe reduced to quantile gauges per stage
@@ -293,6 +309,12 @@ def _serving_snapshot() -> dict[str, Any]:
     return serving_stats()
 
 
+def _memory_snapshot(sched: Any) -> dict[str, Any]:
+    from pathway_tpu.internals.monitoring import memory_stats
+
+    return memory_stats(sched)
+
+
 def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
     if port is None:
         base = int(os.environ.get("PATHWAY_MONITORING_HTTP_PORT", "20000"))
@@ -331,6 +353,10 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         # live index maintenance per index operator:
                         # delta/tombstones/merges (segments.py)
                         "index": _index_snapshot(sched),
+                        # capacity cross-validation: statically estimated
+                        # vs runtime-sampled state bytes per operator
+                        # (analysis/memory.py + scheduler sampling)
+                        "memory": _memory_snapshot(sched),
                         # multi-tenant serving layer: admission counters
                         # per tenant class, scheduler lane stats, and
                         # per-(stage, tenant_class) latency (ISSUE 10)

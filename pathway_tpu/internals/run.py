@@ -65,7 +65,12 @@ def run(
             count_by_severity,
         )
 
-        diags = analyze(G.engine_graph)
+        from pathway_tpu.analysis.rewrite import resolve_level as _rl
+
+        # plan-aware: analyze the view the scheduler will execute, so
+        # rewrites that cure a finding (dead-column elimination,
+        # append-only reducer specialization) also clear its diagnostic
+        diags = analyze(G.engine_graph, optimize=_rl(optimize))
         analysis_counts = count_by_severity(diags)
     except ImportError:
         diags = []
@@ -164,6 +169,17 @@ def _run_inner(
     #: optimizer audit trail + rewrite counters (monitoring//status)
     sched.execution_plan = plan
     sched.plan_counters = plan.counters() if plan is not None else {}
+    #: static capacity estimate of the EXECUTING view, read by
+    #: monitoring//status and /metrics next to the measured state bytes
+    try:
+        from pathway_tpu.analysis.memory import estimate_memory
+
+        sched.memory_estimate = estimate_memory(
+            exec_graph if exec_graph is not None else G.engine_graph,
+            optimize=0,  # exec_graph is already the rewritten view
+        )
+    except Exception:
+        sched.memory_estimate = None
     if with_http_server or cfg.pathway_config.monitoring_http_port:
         from pathway_tpu.internals.monitoring_server import start_http_server
 

@@ -1,0 +1,38 @@
+"""``shard_map`` across jax versions.
+
+Newer jax exposes ``jax.shard_map`` with a ``check_vma`` kwarg; older
+releases only have ``jax.experimental.shard_map.shard_map`` whose
+equivalent kwarg is ``check_rep``.  Callers use the new spelling and
+this shim translates when running on the old API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(
+    f: Callable[..., Any],
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    check_vma: bool = True,
+) -> Callable[..., Any]:
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    return _legacy(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )

@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from pathway_tpu.ops.bucketing import bucket_size, pad_rows
 from pathway_tpu.ops.distances import dot_scores, l2sq_distances, normalize
+from pathway_tpu.ops.shard_map_compat import shard_map
 from pathway_tpu.ops.topk import NEG_INF
 
 __all__ = ["ShardedKnnIndex"]
@@ -361,7 +362,7 @@ class ShardedKnnIndex:
             vals, pos = jax.lax.top_k(gs, k)
             return vals, jnp.take_along_axis(gi, pos, axis=1)
 
-        shmapped = jax.shard_map(
+        shmapped = shard_map(
             local,
             mesh=mesh,
             in_specs=(P(), P(self.data_axis, None), P(self.data_axis)),

@@ -41,6 +41,14 @@ AST-based checks over ``engine/cluster.py`` and ``engine/scheduler.py``
   same-module calls) — while holding A.  Any cycle is a potential
   deadlock and is reported once with the full lock-order path and the
   call chain witnessing each edge.
+- **LK008** — unbounded in-memory growth: a ``queue.Queue()`` /
+  ``deque()`` instance member constructed without ``maxsize``/``maxlen``
+  that some method inserts into while no method in the class ever
+  drains it (``get``/``popleft``/``pop``/``clear``/``del``/swap), or a
+  dict/list/set member whose name admits it is a cache (*cache*,
+  *memo*, *history*, *dedup*) with inserts but no eviction.  Either one
+  is operator state that grows with the stream — the runtime
+  counterpart of the analyzer's PW-M001.
 - **LK006** — serving-path wait discipline: in files under ``serving/``
   (override with ``serving_path=``) every queue handoff must ride the
   WakeupHub and every admission-path wait must be finite.  Flags bare
@@ -408,6 +416,170 @@ def _check_serving_discipline(
             )
 
 
+#: substrings marking an instance dict/list/set as a cache (LK008's
+#: second arm only fires on members whose name admits they accumulate)
+CACHE_NAME_HINTS = ("cache", "memo", "history", "dedup")
+
+#: call methods that add entries to a container
+_GROW_METHODS = {
+    "append",
+    "appendleft",
+    "add",
+    "setdefault",
+    "put",
+    "put_nowait",
+    "extend",
+    "insert",
+    "update",
+}
+
+#: call methods that remove entries from a queue-like container
+_QUEUE_DRAIN_METHODS = {"get", "get_nowait", "pop", "popleft", "clear"}
+
+#: call methods that evict entries from a cache-like container
+_CACHE_EVICT_METHODS = {"pop", "popitem", "popleft", "clear", "remove", "discard"}
+
+
+def _self_attr(expr: ast.expr) -> str | None:
+    """``x`` for a plain ``self.x`` expression, else None."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def _unbounded_container(value: ast.expr) -> str | None:
+    """Classify an assigned value as an unbounded long-lived container.
+
+    Returns ``"queue"`` for ``queue.Queue()`` with no maxsize /
+    ``deque()`` with no maxlen, ``"dict"``/``"list"``/``"set"`` for the
+    corresponding empty literals or zero-arg constructors, None for
+    anything bounded or unrecognised."""
+    if isinstance(value, ast.Dict) and not value.keys:
+        return "dict"
+    if isinstance(value, (ast.List, ast.Set)) and not value.elts:
+        return "list" if isinstance(value, ast.List) else "set"
+    if not isinstance(value, ast.Call):
+        return None
+    func = value.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else None
+    )
+    if name == "Queue":
+        # maxsize is the first positional; Queue(0) is explicitly infinite
+        bounded = any(kw.arg == "maxsize" for kw in value.keywords)
+        if value.args:
+            a = value.args[0]
+            bounded = not (isinstance(a, ast.Constant) and a.value == 0)
+        return None if bounded else "queue"
+    if name == "deque":
+        # deque(iterable, maxlen) — second positional or keyword bounds it
+        bounded = len(value.args) >= 2 or any(
+            kw.arg == "maxlen" for kw in value.keywords
+        )
+        return None if bounded else "queue"
+    if name in ("dict", "list", "set") and not value.args and not value.keywords:
+        return name
+    return None
+
+
+def _check_unbounded_growth(
+    tree: ast.AST, filename: str, findings: list[Finding]
+) -> None:
+    """LK008: long-lived instance state that only ever grows.
+
+    Two arms, both scoped to a class (the unit of object lifetime):
+
+    - an unbounded ``queue.Queue()`` / ``deque()`` member that some
+      method inserts into while **no** method in the class ever drains
+      it (``get``/``popleft``/``pop``/``clear``, ``del``, or swapping
+      the attribute out) — producer-only queues grow with the stream;
+    - a dict/list/set member whose name admits it is a cache
+      (``CACHE_NAME_HINTS``) that is inserted into with no eviction
+      anywhere in the class and no bound at construction.
+
+    A drained queue or an evicted cache is flow control's problem
+    (LK005/LK006 police the blocking side); LK008 is purely about
+    accumulation with no consumer."""
+    for cls in (n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)):
+        # first construction site per attribute, with its container kind
+        containers: dict[str, tuple[str, int]] = {}
+        assigns: dict[str, int] = {}
+        grows: set[str] = set()
+        drains: set[str] = set()
+        for node in ast.walk(cls):
+            targets: list[ast.expr] = []
+            value: ast.expr | None = None
+            if isinstance(node, ast.Assign):
+                value = node.value
+                for t in node.targets:
+                    targets.extend(t.elts if isinstance(t, ast.Tuple) else [t])
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value, targets = node.value, [node.target]
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                assigns[attr] = assigns.get(attr, 0) + 1
+                if value is not None and attr not in containers:
+                    kind = _unbounded_container(value)
+                    if kind is not None:
+                        containers[attr] = (kind, node.lineno)
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                attr = _self_attr(node.func.value)
+                if attr is not None:
+                    if node.func.attr in _GROW_METHODS:
+                        grows.add(attr)
+                    if node.func.attr in (
+                        _QUEUE_DRAIN_METHODS | _CACHE_EVICT_METHODS
+                    ):
+                        drains.add(attr)
+            if isinstance(node, ast.Subscript):
+                attr = _self_attr(node.value)
+                if attr is not None and isinstance(node.ctx, ast.Store):
+                    grows.add(attr)  # self.cache[k] = v
+                if attr is not None and isinstance(node.ctx, ast.Del):
+                    drains.add(attr)  # del self.cache[k]
+            if isinstance(node, ast.AugAssign):
+                attr = _self_attr(node.target)
+                if attr is not None:
+                    grows.add(attr)  # self.buf += [...]
+        for attr, (kind, lineno) in sorted(
+            containers.items(), key=lambda kv: kv[1][1]
+        ):
+            # a second assignment swaps the container out (the
+            # batch, self._q = self._q, [] drain idiom)
+            evicted = attr in drains or assigns.get(attr, 0) >= 2
+            if attr not in grows or evicted:
+                continue
+            if kind == "queue":
+                findings.append(
+                    Finding(
+                        filename,
+                        lineno,
+                        "LK008",
+                        f"self.{attr} is an unbounded queue that "
+                        f"{cls.name} inserts into but never drains; "
+                        "state grows with the stream — pass maxsize/"
+                        "maxlen or consume it",
+                    )
+                )
+            elif any(h in attr.lower() for h in CACHE_NAME_HINTS):
+                findings.append(
+                    Finding(
+                        filename,
+                        lineno,
+                        "LK008",
+                        f"self.{attr} is a {kind} cache with inserts "
+                        f"but no eviction anywhere in {cls.name}; "
+                        "bound it or evict (pop/clear/del) on a policy",
+                    )
+                )
+
+
 def check_source(
     source: str,
     filename: str,
@@ -425,6 +597,7 @@ def check_source(
 
     _FunctionScanner(filename, findings).visit(tree)
     _check_notify_discipline(tree, filename, findings)
+    _check_unbounded_growth(tree, filename, findings)
 
     if cluster_path is None:
         cluster_path = "cluster" in os.path.basename(filename)

@@ -36,8 +36,14 @@ if [ -z "$PYTHON" ]; then
 else
     echo "lint_repo: self-lint stage" >&2
     SELF_FAIL=0
+    # capacity gate: the plan-aware memory report runs per example with a
+    # concrete per-worker budget — a blown budget is a PW-M002 warning
+    # (baselineable), O(stream) state reaching a sink is a PW-M001 error
+    # (never baselineable)
     for ex in "$REPO"/examples/*.py; do
-        if ! JAX_PLATFORMS=cpu "$PYTHON" -m pathway_tpu.cli lint --werror \
+        if ! JAX_PLATFORMS=cpu \
+            PATHWAY_MEMORY_BUDGET="${PATHWAY_MEMORY_BUDGET:-4GiB}" \
+            "$PYTHON" -m pathway_tpu.cli lint --werror --memory \
             --baseline "$REPO/scripts/lint_baseline.json" "$ex"; then
             SELF_FAIL=1
         fi

@@ -66,6 +66,15 @@ def test_bench_smoke_emits_wellformed_metrics():
     for stage in ("ingest", "cut", "process", "sink", "e2e"):
         assert stages[stage]["count"] > 0, stage
         assert stages[stage]["p50_ms"] <= stages[stage]["p99_ms"]
+    # the capacity cross-validation ran and held (ISSUE 15: the static
+    # estimator's prediction must land within 3x of the sampled operator
+    # state on both graphs; a breach raises inside bench.py and would
+    # surface here as capacity_error)
+    assert "capacity_error" not in extra, extra.get("capacity_error")
+    for graph in ("wordcount", "index_churn"):
+        ratio = extra[f"capacity_{graph}_ratio"]
+        assert 1.0 / 3.0 <= ratio <= 3.0, (graph, ratio)
+        assert extra[f"capacity_{graph}_measured_bytes"] > 0, graph
     # the tracing-overhead gate ran and held (ISSUE 14: the always-on
     # flight recorder must cost <=2% on both workloads; a gate trip
     # raises inside bench.py and surfaces here as tracing_error)

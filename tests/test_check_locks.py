@@ -278,6 +278,120 @@ def test_lk006_not_applied_outside_serving_paths(cl):
     assert [f.code for f in findings] == ["LK006"]
 
 
+def test_lk008_producer_only_queue_flagged(cl):
+    src = (
+        "from collections import deque\n"
+        "class Tap:\n"
+        "    def __init__(self):\n"
+        "        self._backlog = deque()\n"
+        "    def feed(self, item):\n"
+        "        self._backlog.append(item)\n"
+    )
+    findings = cl.check_source(src, "x.py")
+    assert [f.code for f in findings] == ["LK008"]
+    assert "_backlog" in findings[0].message
+
+
+def test_lk008_unbounded_queue_queue_flagged(cl):
+    src = (
+        "import queue\n"
+        "class Tap:\n"
+        "    def __init__(self):\n"
+        "        self._inbox = queue.Queue()\n"
+        "    def feed(self, item):\n"
+        "        self._inbox.put(item)\n"
+    )
+    findings = cl.check_source(src, "x.py")
+    assert [f.code for f in findings] == ["LK008"]
+
+
+def test_lk008_bounded_deque_clean(cl):
+    # maxlen caps the container: append-only is fine
+    src = (
+        "from collections import deque\n"
+        "class Tap:\n"
+        "    def __init__(self):\n"
+        "        self._backlog = deque(maxlen=1024)\n"
+        "    def feed(self, item):\n"
+        "        self._backlog.append(item)\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk008_drained_queue_clean(cl):
+    # a consumer anywhere in the class bounds steady-state occupancy
+    src = (
+        "from collections import deque\n"
+        "class Tap:\n"
+        "    def __init__(self):\n"
+        "        self._backlog = deque()\n"
+        "    def feed(self, item):\n"
+        "        self._backlog.append(item)\n"
+        "    def drain(self):\n"
+        "        while self._backlog:\n"
+        "            yield self._backlog.popleft()\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk008_swap_drain_idiom_clean(cl):
+    # the batch, self._q = self._q, [] handoff counts as eviction
+    src = (
+        "class Tap:\n"
+        "    def __init__(self):\n"
+        "        self._q = []\n"
+        "    def feed(self, item):\n"
+        "        self._q.append(item)\n"
+        "    def drain(self):\n"
+        "        batch, self._q = self._q, []\n"
+        "        return batch\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk008_cache_without_eviction_flagged(cl):
+    src = (
+        "class Resolver:\n"
+        "    def __init__(self):\n"
+        "        self._cache = {}\n"
+        "    def lookup(self, k):\n"
+        "        if k not in self._cache:\n"
+        "            self._cache[k] = self._slow(k)\n"
+        "        return self._cache[k]\n"
+    )
+    findings = cl.check_source(src, "x.py")
+    assert [f.code for f in findings] == ["LK008"]
+    assert "_cache" in findings[0].message
+
+
+def test_lk008_cache_with_eviction_clean(cl):
+    src = (
+        "class Resolver:\n"
+        "    def __init__(self):\n"
+        "        self._cache = {}\n"
+        "    def lookup(self, k):\n"
+        "        if k not in self._cache:\n"
+        "            self._cache[k] = self._slow(k)\n"
+        "        return self._cache[k]\n"
+        "    def invalidate(self):\n"
+        "        self._cache.clear()\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk008_non_cache_named_dict_ignored(cl):
+    # bounded-by-construction members (keyed by peer/worker id) don't
+    # get flagged just for lacking eviction — only confessed caches do
+    src = (
+        "class Tracker:\n"
+        "    def __init__(self):\n"
+        "        self._last_seen_at = {}\n"
+        "    def mark(self, peer, now):\n"
+        "        self._last_seen_at[peer] = now\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
 _LK007_CYCLE = (
     "import threading\n"
     "class Store:\n"

@@ -16,6 +16,7 @@ from pathway_tpu.analysis import (
     AnalysisError,
     analyze,
 )
+from pathway_tpu.analysis import memory as mem
 from pathway_tpu.engine import graph as eg
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals.parse_graph import G
@@ -308,6 +309,9 @@ def test_package_exports():
     assert pw.analyze is analyze
     assert pw.Diagnostic is not None
     assert pw.AnalysisError is AnalysisError
+    assert pw.estimate_memory is mem.estimate_memory
+    assert pw.MemoryReport is mem.MemoryReport
+    assert pw.EstimateParams is mem.EstimateParams
 
 
 # ------------------------------------------------- distribution helpers
@@ -581,6 +585,259 @@ def test_r002_sharded_serving_graph_clean_single_owner_flagged():
         assert r002 and r002[0].severity == SEV_WARNING
     finally:
         app2.close()
+
+
+# ------------------------------------- M001 / M002 / M003 (memory pass)
+
+
+def _keyed_streaming_events():
+    """Upsert-keyed stream: live cardinality is O(keys), not O(stream)."""
+
+    class S(pw.Schema):
+        k: str = pw.column_definition(primary_key=True)
+        t: int
+        v: int
+
+    return pw.io.python.read(_Subject(), schema=S)
+
+
+def _stream_join(sink: bool):
+    a = _streaming_events()
+    b = _streaming_events()
+    j = a.join(b, a.k == b.k).select(k=pw.left.k, v=pw.right.v)
+    if sink:
+        j._capture_node()
+
+
+def test_m001_stream_linear_state_reaching_sink():
+    _stream_join(sink=True)
+    diags = analyze()
+    m1 = [d for d in diags if d.code == "PW-M001"]
+    assert m1 and all(d.severity == SEV_ERROR for d in m1)
+    assert m1[0].details["growth"] == mem.G_STREAM
+    assert m1[0].details["estimated_bytes"] > 0
+
+
+def test_m001_needs_sink_but_m003_still_warns():
+    """Same join, nothing captured: not an M001 error (no sink pays the
+    cost at read time), but snapshot bytes still grow -> M003."""
+    _stream_join(sink=False)
+    diags = analyze()
+    assert "PW-M001" not in codes(diags)
+    m3 = [d for d in diags if d.code == "PW-M003"]
+    assert m3 and all(d.severity == SEV_WARNING for d in m3)
+    assert m3[0].details["growth"] == mem.G_STREAM
+
+
+def test_m001_m003_upsert_keyed_join_clean():
+    """The fix the M001 message recommends: key the sources and the same
+    join shape retains O(keys), even with a sink attached."""
+    a = _keyed_streaming_events()
+    b = _keyed_streaming_events()
+    a.join(b, a.k == b.k).select(
+        k=pw.left.k, v=pw.right.v
+    )._capture_node()
+    got = codes(analyze())
+    assert "PW-M001" not in got
+    assert "PW-M003" not in got
+
+
+def test_m003_bounded_temporal_join_clean():
+    from pathway_tpu.stdlib import temporal
+
+    a = _streaming_events()
+    b = _streaming_events()
+    temporal.interval_join(
+        a, b, a.t, b.t, temporal.interval(-1, 1), pw.left.k == pw.right.k
+    ).select(k=pw.left.k, v=pw.left.v)
+    got = codes(analyze())
+    assert "PW-M003" not in got
+    assert "PW-M001" not in got
+
+
+def test_m002_budget_breach_carries_breakdown(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MEMORY_BUDGET", "64K")
+    t = _streaming_table()
+    t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    diags = analyze()
+    m2 = [d for d in diags if d.code == "PW-M002"]
+    assert m2 and m2[0].severity == SEV_WARNING
+    det = m2[0].details
+    assert det["budget_bytes"] == 64 * 1024
+    assert det["estimated_bytes"] > det["budget_bytes"]
+    sizes = [b for _label, b in det["breakdown"]]
+    assert sizes and sizes == sorted(sizes, reverse=True)
+
+
+def test_m002_ample_budget_clean(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MEMORY_BUDGET", "1TiB")
+    t = _streaming_table()
+    t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    assert "PW-M002" not in codes(analyze())
+
+
+# ------------------------------------------------ estimator unit tests
+
+
+def test_growth_lattice_total_order():
+    order = (mem.G_CONSTANT, mem.G_BOUNDED, mem.G_KEYS, mem.G_STREAM)
+    for i, lo in enumerate(order):
+        for hi in order[i:]:
+            assert mem.growth_join(lo, hi) == hi
+            assert mem.growth_meet(lo, hi) == lo
+    assert mem.growth_join() == mem.G_CONSTANT
+    assert mem.growth_meet() == mem.G_STREAM
+
+
+def test_dtype_width_from_annotations():
+    assert mem.dtype_width(dt.INT) == 8
+    assert mem.dtype_width(dt.DATE_TIME_UTC) == 8
+    assert mem.dtype_width(dt.STR, str_bytes=40) == 40
+    assert mem.dtype_width(dt.JSON, str_bytes=10) == 40  # nested payload
+    assert mem.dtype_width(dt.ANY) == 24  # unannotated boxed object
+    assert mem.dtype_width(dt.Optional(dt.INT)) == 8  # optionality is free
+
+
+def test_parse_budget_suffixes():
+    assert mem.parse_budget(None) is None
+    assert mem.parse_budget("") is None
+    assert mem.parse_budget("4096") == 4096
+    assert mem.parse_budget("64K") == 64 * 1024
+    assert mem.parse_budget("64KB") == 64 * 1024
+    assert mem.parse_budget("4GiB") == 4 * (1 << 30)
+    assert mem.parse_budget("1.5M") == int(1.5 * (1 << 20))
+    assert mem.parse_budget("2T") == 2 * (1 << 40)
+    assert mem.parse_budget("lots") is None
+
+
+def test_estimate_params_env_and_overrides(monkeypatch):
+    monkeypatch.setenv("PATHWAY_MEMORY_ROWS", "123")
+    monkeypatch.setenv("PATHWAY_MEMORY_KEYS", "7")
+    monkeypatch.setenv("PATHWAY_MEMORY_STR_BYTES", "not-a-number")
+    p = mem.EstimateParams.from_env(workers=3)
+    assert p.rows == 123
+    assert p.distinct_keys == 7
+    assert p.str_bytes == mem.EstimateParams.str_bytes  # bad env -> default
+    assert p.workers == 3  # explicit override beats env
+    assert p.cardinality(mem.G_STREAM) == 123
+    assert p.cardinality(mem.G_KEYS) == 7
+    assert p.cardinality(mem.G_BOUNDED) == p.window_rows
+    assert p.cardinality(mem.G_CONSTANT) == 0
+
+
+def test_split_bytes_placement_lattice():
+    assert mem._split_bytes(("single",), 100, 4) == 100
+    assert mem._split_bytes(("repl",), 100, 4) == 100  # every rank holds it
+    assert mem._split_bytes(("key", "word"), 100, 4) == 25
+    assert mem._split_bytes(("key", "word"), 101, 4) == 26  # ceil, not floor
+    assert mem._split_bytes(("key", "word"), 100, 1) == 100
+
+
+def test_window_bounds_join_retention_not_stream_length():
+    from pathway_tpu.stdlib import temporal
+
+    a = _streaming_events()
+    b = _streaming_events()
+    temporal.interval_join(
+        a, b, a.t, b.t, temporal.interval(-1, 1), pw.left.k == pw.right.k
+    ).select(k=pw.left.k)
+    small = pw.estimate_memory(optimize=0, window_rows=16)
+    big = pw.estimate_memory(optimize=0, window_rows=4096)
+    j_small = next(o for o in small.operators if o.kind == "IntervalJoinNode")
+    j_big = next(o for o in big.operators if o.kind == "IntervalJoinNode")
+    assert j_small.growth == mem.G_BOUNDED
+    assert j_small.total_bytes < j_big.total_bytes
+    # a 100x longer stream must not move a window-bounded buffer
+    longer = pw.estimate_memory(optimize=0, window_rows=16, rows=100_000_000)
+    j_longer = next(
+        o for o in longer.operators if o.kind == "IntervalJoinNode"
+    )
+    assert j_longer.total_bytes == j_small.total_bytes
+
+
+def test_per_worker_split_with_partitioned_source(tmp_path):
+    t = _files_table(tmp_path)
+    t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    one = pw.estimate_memory(optimize=0, workers=1)
+    four = pw.estimate_memory(optimize=0, workers=4)
+    assert four.workers == 4
+    assert 0 < four.max_worker_bytes < one.max_worker_bytes
+    assert four.total_bytes == one.total_bytes  # split, not shrunk
+
+
+def test_memory_report_surfaces():
+    t = _streaming_table()
+    t.groupby(t.word).reduce(t.word, c=pw.reducers.count())
+    rep = pw.estimate_memory()
+    assert rep.total_bytes > 0
+    assert rep.by_id()  # node-keyed view
+    txt = rep.format()
+    assert "TOTAL" in txt and "groupby" in txt
+
+
+# --------------------------------- golden: plan-aware estimates (sat 3)
+
+
+def test_golden_dead_column_elided_from_optimized_estimate():
+    """The estimate must price the graph that RUNS: a join side's dead
+    column is nulled by the plan rewriter, so the optimize=2 report is
+    strictly cheaper than the raw optimize=0 one."""
+    a = _streaming_events()
+    sel = a.select(a.k, dead=a.k)  # str-width ballast, never used
+    b = _streaming_events()
+    sel.join(b, sel.k == b.k).select(
+        k=pw.left.k, v=pw.right.v
+    )._capture_node()
+    r0 = pw.estimate_memory(optimize=0)
+    r2 = pw.estimate_memory(optimize=2)
+    assert r0.level == 0 and r2.level == 2
+    j0 = next(o for o in r0.operators if o.kind == "JoinNode")
+    j2 = next(o for o in r2.operators if o.kind == "JoinNode")
+    assert j2.total_bytes < j0.total_bytes
+    assert r2.total_bytes < r0.total_bytes
+
+
+# ----------------------- predicted vs measured (runtime cross-check)
+
+
+def test_predicted_vs_measured_operator_state(monkeypatch):
+    """End-to-end cross-validation in miniature: run a real streaming
+    groupby, then join the static estimate against the scheduler's
+    sampled ``approx_state_bytes`` via ``memory_stats`` — same label
+    join and same loose-bound contract ``bench_capacity`` enforces."""
+    n_rows, n_keys = 600, 40
+    monkeypatch.setenv("PATHWAY_MEMORY_ROWS", str(n_rows))
+    monkeypatch.setenv("PATHWAY_MEMORY_KEYS", str(n_keys))
+    monkeypatch.setenv("PATHWAY_MEMORY_STR_BYTES", "8")
+
+    class Feed(pw.io.python.ConnectorSubject):
+        def run(self) -> None:
+            for i in range(n_rows):
+                self.next(word=f"w{i % n_keys}", n=i)
+            self.commit()
+
+    class S(pw.Schema):
+        word: str
+        n: int
+
+    t = pw.io.python.read(Feed(), schema=S)
+    t.groupby(t.word).reduce(t.word, c=pw.reducers.count())._capture_node()
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+    from pathway_tpu.internals.monitoring import memory_stats
+
+    sched = G.active_scheduler
+    assert sched is not None
+    stats = memory_stats(sched)
+    joined = {
+        label: v
+        for label, v in stats.items()
+        if v["estimated"] > 0 and v["measured"] > 0
+    }
+    assert joined, stats  # estimate and probe agree on operator labels
+    predicted = sum(v["estimated"] for v in joined.values())
+    measured = sum(v["measured"] for v in joined.values())
+    assert 0.1 <= predicted / measured <= 10.0, stats
 
 
 # ---------------------------------------------- registry + docs (sat 1)
