@@ -1765,6 +1765,379 @@ def bench_failover(extra: dict) -> None:
         part.close()
 
 
+def _vm_rss_bytes() -> int:
+    """Resident set size of this process, from /proc (no psutil)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
+_SIGSTOP_PEER_PROGRAM = """\
+import sys, time
+
+port, n_frames = int(sys.argv[1]), int(sys.argv[2])
+from pathway_tpu.engine.cluster import _ProcessLinks
+
+links = _ProcessLinks(1, 2, port, heartbeat_s=0.2, liveness_timeout_s=30.0)
+try:
+    for i in range(n_frames):
+        links.recv_from_all(("s", i))
+        time.sleep(0.05)
+finally:
+    links.close()
+print("drained", flush=True)
+"""
+
+
+def bench_overload(extra: dict) -> None:
+    """End-to-end backpressure drill (ISSUE 16): offered load vs
+    goodput/shed-rate/p99/max-RSS at 1x/2x/5x of measured serving
+    capacity, then a SIGSTOP'd (alive, not dead) exchange peer to show
+    the credit window capping sender-side backlog, with the stall time
+    attributed by ``analysis/tracecrit.py`` as ``credit_wait`` spans.
+
+    The ladder runs the full pressure chain for real: a small
+    PATHWAY_INGEST_BUFFER_BYTES makes the bulk tenant's upserts fill the
+    ingest credit ledger, the engine scheduler pushes that occupancy to
+    serving, and brownout tightens the batch class while interactive
+    keeps flowing — the ``--smoke`` gates are bounded RSS at 5x and
+    interactive p99(5x) <= 5x the 1x-load p99."""
+    import socket
+    import subprocess
+    import sys as _sys
+    import threading
+
+    from pathway_tpu.analysis import tracecrit
+    from pathway_tpu.engine.cluster import _ProcessLinks
+    from pathway_tpu.internals import tracing
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.serving import LoadGen, RagServingApp, TenantLoad, TenantPolicy
+    from pathway_tpu.testing.chaos import chaos
+
+    duration = 1.2 if SMOKE else 5.0
+    ingest_cap = 32 * 1024  # small on purpose: overload must FILL it
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("PATHWAY_INGEST_BUFFER_BYTES", "PATHWAY_EXCHANGE_CREDIT_BYTES")
+    }
+    saved_trace = os.environ.get("PATHWAY_TRACE")
+    saved_sample = os.environ.get("PATHWAY_TRACE_SAMPLE")
+    os.environ["PATHWAY_INGEST_BUFFER_BYTES"] = str(ingest_cap)
+
+    rng = np.random.default_rng(31)
+    vocab = ["solar", "merge", "slab", "tail", "bucket", "chunk", "probe", "lane"]
+    n_docs = 48
+    docs = [
+        (f"doc{i}", " ".join(rng.choice(vocab) for _ in range(30)))
+        for i in range(n_docs)
+    ]
+
+    def build_app(cap: float) -> "RagServingApp":
+        # policies are provisioned for 1x CAPACITY and frozen across the
+        # ladder — overload means the offer outgrows the provision, so
+        # shed must rise with the multiplier instead of the caps
+        # silently stretching to absorb it
+        G.clear()
+        pols = {
+            "live": TenantPolicy(
+                "interactive",
+                rate_per_s=cap * 4,
+                burst=max(cap, 16.0),
+                queue_cap=256,
+            ),
+            "bulk": TenantPolicy(
+                "batch", rate_per_s=max(cap / 2, 2.0), burst=8, queue_cap=16
+            ),
+        }
+        app = RagServingApp(pols, embed_dim=64, delta_cap=64, autocommit_ms=10)
+        app.start()
+        for doc_id, text in docs:
+            app.upsert(doc_id, text, tenant="live")
+        if not app.wait_indexed(n_docs, timeout=30.0):
+            raise RuntimeError(f"ingest stalled: {app.stats()}")
+        for _ in range(3):
+            app.answer("bucket probe lane", tenant="live", timeout=30)
+        return app
+
+    # --- calibrate 1x: closed-loop service rate of one interactive lane
+    # (clamped to what a single open-loop pacing thread can honestly
+    # offer at 5x — attempted qps is recorded per point regardless) ---
+    app = build_app(50.0)
+    try:
+        n_cal = 24 if SMOKE else 60
+        t0 = time.perf_counter()
+        for i in range(n_cal):
+            app.answer("bucket probe " + vocab[i % 8], tenant="live", timeout=30)
+        cap_qps = min(max(n_cal / (time.perf_counter() - t0), 10.0), 150.0)
+    finally:
+        app.close()
+    log(f"overload: calibrated serving capacity ~{cap_qps:.0f} qps/tenant")
+
+    rows = []
+    for mult in (1, 2, 5):
+        qps = cap_qps * mult
+        app = build_app(cap_qps)
+        try:
+            rss0 = _vm_rss_bytes()
+            peak = {"rss": rss0, "pressure": 0.0}
+            stop_sampler = threading.Event()
+
+            def sample() -> None:
+                while not stop_sampler.is_set():
+                    peak["rss"] = max(peak["rss"], _vm_rss_bytes())
+                    st = app.admission.stats()
+                    peak["pressure"] = max(
+                        peak["pressure"], st["pressure"]["level"]
+                    )
+                    stop_sampler.wait(0.05)
+
+            sampler = threading.Thread(target=sample, daemon=True)
+            sampler.start()
+            try:
+                rep = LoadGen(
+                    app,
+                    [
+                        TenantLoad("live", qps=qps),
+                        # heavy writes with fat docs: the upsert stream is
+                        # what loads the engine's ingest credit ledger
+                        TenantLoad(
+                            "bulk", qps=qps, write_fraction=0.5, doc_words=160
+                        ),
+                    ],
+                    duration_s=duration,
+                    seed=41 + mult,
+                ).run()
+            finally:
+                stop_sampler.set()
+                sampler.join(2.0)
+            adm = app.admission.stats()
+            cls = rep["classes"]
+            inter = cls.get("interactive", {})
+            batch = cls.get("batch", {})
+            sent = max(1, inter.get("sent", 0) + batch.get("sent", 0))
+            shed = inter.get("shed", 0) + batch.get("shed", 0)
+            wall = max(rep.get("wall_s", duration), 1e-6)
+            rows.append(
+                {
+                    "mult": mult,
+                    "offered_qps_per_tenant": round(qps, 1),
+                    # what the pacing threads actually fired (the nominal
+                    # offer saturates thread timer resolution at high mult)
+                    "attempted_qps": round(
+                        (
+                            inter.get("sent", 0)
+                            + batch.get("sent", 0)
+                            + batch.get("writes", 0)
+                        )
+                        / wall,
+                        1,
+                    ),
+                    "goodput_rps": round(
+                        inter.get("achieved_qps", 0.0)
+                        + batch.get("achieved_qps", 0.0),
+                        2,
+                    ),
+                    "shed_rate": round(shed / sent, 4),
+                    "interactive": inter,
+                    "batch": batch,
+                    "pressure_level_max": round(peak["pressure"], 3),
+                    "brownout_shed_total": adm["pressure"]["brownout_shed_total"],
+                    "max_rss_bytes": peak["rss"],
+                    "rss_growth_frac": round(
+                        (peak["rss"] - rss0) / max(rss0, 1), 4
+                    ),
+                }
+            )
+            log(
+                f"overload @ {mult}x ({qps:.0f} qps/tenant): goodput "
+                f"{rows[-1]['goodput_rps']:.0f} rps, shed rate "
+                f"{rows[-1]['shed_rate']:.1%}, interactive p99 "
+                f"{inter.get('p99_ms', 0.0):.2f}ms, pressure max "
+                f"{peak['pressure']:.2f}, rss +{rows[-1]['rss_growth_frac']:.1%}"
+            )
+        finally:
+            app.close()
+
+    # --- SIGSTOP'd peer: credit window caps sender backlog; the stall is
+    # visible to tracecrit as credit_wait spans on the producer's trace ---
+    credit = 8192
+    os.environ["PATHWAY_EXCHANGE_CREDIT_BYTES"] = str(credit)
+    tracing.configure(PATHWAY_TRACE="1", PATHWAY_TRACE_SAMPLE="1.0")
+    port = None
+    for base in range(29200, 29900, 2):
+        try:
+            for off in range(2):
+                s = socket.socket()
+                s.bind(("127.0.0.1", base + off))
+                s.close()
+            port = base
+            break
+        except OSError:
+            continue
+    if port is None:
+        raise RuntimeError("no free port pair for the exchange drill")
+    d = tempfile.mkdtemp(prefix="pw_bench_overload_")
+    peer_py = os.path.join(d, "peer.py")
+    with open(peer_py, "w") as f:
+        f.write(_SIGSTOP_PEER_PROGRAM)
+    n_frames = 24 if SMOKE else 60
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    child_env = dict(os.environ)
+    child_env["PYTHONPATH"] = repo_root + (
+        os.pathsep + child_env["PYTHONPATH"] if child_env.get("PYTHONPATH") else ""
+    )
+    child = subprocess.Popen(
+        [_sys.executable, peer_py, str(port), str(n_frames)],
+        cwd=repo_root,
+        env=child_env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    links0 = None
+    try:
+        links0 = _ProcessLinks(
+            0, 2, port, heartbeat_s=0.2, liveness_timeout_s=30.0
+        )
+        boxes = [[[(i, ("v" * 40,), 1) for i in range(60)]]]
+        t_mark = time.monotonic_ns()
+        sent: list = []
+
+        def producer() -> None:
+            with tracing.use(tracing.new_trace(sampled=True)):
+                for i in range(n_frames):
+                    links0.send_updates_async(1, ("s", i), boxes)
+                    sent.append(i)
+
+        prod = threading.Thread(target=producer, daemon=True)
+        prod.start()
+        deadline = time.monotonic() + 10.0
+        while len(sent) < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if len(sent) < 3:
+            raise RuntimeError("exchange drill never started moving frames")
+        max_backlog = 0
+        states = set()
+        with chaos(seed=7) as ch:
+            ch.pause_resume(child.pid, pause_s=2.0)
+            t_end = time.monotonic() + 2.0
+            while time.monotonic() < t_end:
+                pr = links0.exchange_pressure()
+                max_backlog = max(max_backlog, pr["peers"][1]["backlog_bytes"])
+                states.add(pr["peers"][1]["state"])
+                time.sleep(0.05)
+        prod.join(45.0)
+        rcode = child.wait(timeout=45.0)
+        events = tracing.chrome_events(since_ns=t_mark, all_spans=True)
+        credit_wait_ms = round(
+            sum(e["dur"] for e in events if e["name"] == "credit_wait") / 1e3, 3
+        )
+        crit = tracecrit.report(events)
+        with links0.stats_lock:
+            stalls = links0.stats["credit_stalls"]
+            stall_ms = round(links0.stats["credit_stall_ms"], 3)
+        sigstop = {
+            "credit_bytes": credit,
+            "n_frames": n_frames,
+            "frames_sent": len(sent),
+            "pause_s": 2.0,
+            "max_backlog_bytes": max_backlog,
+            "peer_states_seen": sorted(states),
+            "peer_exit_code": rcode,
+            "producer_done": not prod.is_alive(),
+            "credit_stalls": stalls,
+            "credit_stall_ms": stall_ms,
+            "credit_wait_ms": credit_wait_ms,
+        }
+        log(
+            f"overload sigstop drill: backlog max {max_backlog}B "
+            f"(cap {credit}B), states {sorted(states)}, credit_wait "
+            f"{credit_wait_ms:.0f}ms over {stalls} stalls"
+        )
+    finally:
+        if links0 is not None:
+            links0.close()
+        if child.poll() is None:
+            child.kill()
+        tracing.configure(
+            PATHWAY_TRACE=saved_trace, PATHWAY_TRACE_SAMPLE=saved_sample
+        )
+        for key, old in saved_env.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+    extra["overload_capacity_qps"] = round(cap_qps, 1)
+    extra["overload_interactive_p99_ms_1x"] = rows[0]["interactive"].get("p99_ms")
+    extra["overload_interactive_p99_ms_5x"] = rows[-1]["interactive"].get("p99_ms")
+    extra["overload_goodput_rps_5x"] = rows[-1]["goodput_rps"]
+    extra["overload_shed_rate_5x"] = rows[-1]["shed_rate"]
+    extra["overload_rss_growth_frac_5x"] = rows[-1]["rss_growth_frac"]
+    extra["overload_sigstop_max_backlog_bytes"] = max_backlog
+    extra["overload_credit_wait_ms"] = credit_wait_ms
+
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_overload.json"
+    )
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "cmd": "JAX_PLATFORMS=cpu python bench.py (bench_overload)",
+                "config": {
+                    "capacity_qps_per_tenant": round(cap_qps, 1),
+                    "duration_s": duration,
+                    "ingest_buffer_bytes": ingest_cap,
+                    "write_fraction_bulk": 0.5,
+                    "smoke": SMOKE,
+                },
+                "ladder": rows,
+                "sigstop_peer": sigstop,
+                "tracecrit": crit,
+            },
+            f,
+            indent=2,
+            sort_keys=True,
+        )
+        f.write("\n")
+    log(f"wrote {out}")
+    if SMOKE:
+        p99_1x = max(rows[0]["interactive"].get("p99_ms", 0.0), 0.5)
+        p99_5x = rows[-1]["interactive"].get("p99_ms", 0.0)
+        if p99_5x > 5.0 * p99_1x:
+            raise RuntimeError(
+                f"interactive p99 under 5x overload is {p99_5x:.2f}ms > 5x "
+                f"the 1x-load p99 ({p99_1x:.2f}ms) — brownout is not "
+                "holding the interactive class"
+            )
+        growth = rows[-1]["rss_growth_frac"]
+        if growth > 0.10:
+            raise RuntimeError(
+                f"RSS grew {growth:.1%} during the 5x point — a queue is "
+                "unbounded somewhere in the pressure chain"
+            )
+        if "dead" in states:
+            raise RuntimeError(
+                "SIGSTOP'd peer was declared dead — a stalled-but-alive "
+                "peer must be throttled, not isolated"
+            )
+        if max_backlog > 2 * credit:
+            raise RuntimeError(
+                f"sender backlog reached {max_backlog}B against a "
+                f"{credit}B credit window — flow control is not capping "
+                "the SIGSTOP'd peer"
+            )
+        if credit_wait_ms <= 0.0 or stalls <= 0:
+            raise RuntimeError(
+                "no credit_wait spans recorded during the SIGSTOP drill — "
+                "the stall is invisible to tracecrit attribution"
+            )
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -1807,6 +2180,7 @@ def main() -> None:
         (bench_rag_serving, "rag_serving"),
         (bench_failover, "failover"),
         (bench_tracing, "tracing"),
+        (bench_overload, "overload"),
     ]
     if not SMOKE:
         sections += [

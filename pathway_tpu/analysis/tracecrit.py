@@ -49,6 +49,7 @@ __all__ = [
 #: stage-name prefix → attribution category (first match wins; checked
 #: in declaration order, most specific first)
 CATEGORY_OF: tuple[tuple[str, str], ...] = (
+    ("credit_wait", "exchange"),
     ("serve_sched", "queue_wait"),
     ("gen_queue", "queue_wait"),
     ("admit", "queue_wait"),

@@ -9,6 +9,7 @@ Capability parity with reference ``python/pathway/debug/__init__.py``:
 from __future__ import annotations
 
 import re
+import threading
 from typing import Any, Iterable, Mapping
 
 from pathway_tpu.engine import graph as eg
@@ -128,24 +129,112 @@ def _infer_dtypes(cols: list[str], rows: list[tuple], schema: Any) -> dict[str, 
     return dtypes
 
 
+class _StreamClock:
+    """Deterministic replay order for every markdown stream subject built
+    on one graph.  Reader threads replay concurrently, so without
+    coordination the epoch a row lands in depends on thread scheduling —
+    two ``__time__`` tables only line up by luck.  The clock serializes
+    the replay into one global schedule: every (time, subject) batch in
+    ascending ``__time__`` order, registration (= construction) order
+    within a time, each batch committed as its own epoch.  That is the
+    interleaving the unsynchronized replay produced when the race went
+    the expected way — now it is the only interleaving."""
+
+    #: a reader that never starts (its node pruned from the run, or the
+    #: run cancelled mid-replay) stalls the schedule; after this wait the
+    #: remaining readers proceed unserialized rather than hang
+    _STEP_TIMEOUT_S = 5.0
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._batches: list[tuple[int, int]] = []  # (time, subject id)
+        self._n_subjects = 0
+        self._steps: dict[tuple[int, int], int] | None = None
+        self._counter = 0
+
+    def register(self, times: Iterable[int]) -> int:
+        """Called at graph-build time; returns the subject's id."""
+        with self._cond:
+            sid = self._n_subjects
+            self._n_subjects += 1
+            self._batches.extend((t, sid) for t in sorted(set(times)))
+            return sid
+
+    def reset(self) -> None:
+        """Rewind for a fresh scheduler run: the same graph re-runs every
+        subject from scratch, so the schedule replays from slot 0."""
+        with self._cond:
+            self._counter = 0
+            self._steps = None  # pick up subjects registered since the freeze
+            self._cond.notify_all()
+
+    def _schedule(self) -> dict[tuple[int, int], int]:
+        # first reader in freezes membership (graph construction is done
+        # before the scheduler starts any reader thread)
+        if self._steps is None:
+            self._batches.sort()
+            self._steps = {b: i for i, b in enumerate(self._batches)}
+        return self._steps
+
+    def step(self, t: int, sid: int, emit: Any) -> None:
+        """Run ``emit`` (enqueue rows + commit) at this batch's slot in
+        the global schedule."""
+        with self._cond:
+            # a subject built AFTER the first replay froze the schedule
+            # (tables added to an already-run graph) has no slot: emit
+            # unserialized rather than renumber a live schedule
+            idx = self._schedule().get((t, sid))
+            if idx is not None:
+                self._cond.wait_for(
+                    lambda: self._counter >= idx, timeout=self._STEP_TIMEOUT_S
+                )
+        try:
+            emit()
+        finally:
+            if idx is not None:
+                with self._cond:
+                    self._counter = max(self._counter, idx + 1)
+                    self._cond.notify_all()
+
+
 class _StreamSubject:
     """Replays timed rows through the connector interface so ``__time__`` /
-    ``__diff__`` markdown columns become a genuine update stream."""
+    ``__diff__`` markdown columns become a genuine update stream.  With a
+    :class:`_StreamClock` every batch lands at its deterministic slot in
+    the graph-wide replay schedule."""
 
-    def __init__(self, timed_rows: list[tuple[int, K.Pointer, tuple, int]]):
+    def __init__(
+        self,
+        timed_rows: list[tuple[int, K.Pointer, tuple, int]],
+        clock: _StreamClock | None = None,
+    ):
         self.timed_rows = sorted(timed_rows, key=lambda r: r[0])
+        self.clock = clock
+        self.sid = (
+            clock.register({t for t, _k, _v, _d in self.timed_rows})
+            if clock is not None
+            else 0
+        )
 
-    def run(self, events: Any) -> None:
-        current_time: int | None = None
-        for t, key, vals, diff in self.timed_rows:
-            if current_time is not None and t != current_time:
-                events.commit()
-            current_time = t
+    def _emit(self, events: Any, batch: list) -> None:
+        for key, vals, diff in batch:
             if diff >= 0:
                 events.add(key, vals)
             else:
                 events.remove(key, vals)
         events.commit()
+
+    def run(self, events: Any) -> None:
+        by_time: dict[int, list] = {}
+        for t, key, vals, diff in self.timed_rows:
+            by_time.setdefault(t, []).append((key, vals, diff))
+        for t in sorted(by_time):
+            if self.clock is not None:
+                self.clock.step(
+                    t, self.sid, lambda b=by_time[t]: self._emit(events, b)
+                )
+            else:
+                self._emit(events, by_time[t])
 
 
 def _occurrence_key(tag: str, row: tuple, diff: int, occupancy: dict) -> K.Pointer:
@@ -185,10 +274,14 @@ def _stream_table_from_rows(
             key = _occurrence_key("__md_stream__", row, diff, occupancy)
         timed.append((t, key, row, diff))
     dtypes = _infer_dtypes(data_cols, [v for _, _, v, _ in timed], schema)
+    graph = G.engine_graph
+    clock = getattr(graph, "_md_stream_clock", None)
+    if clock is None:
+        clock = graph._md_stream_clock = _StreamClock()
     node = eg.InputNode(
-        G.engine_graph,
+        graph,
         n_cols=len(data_cols),
-        subject=_StreamSubject(timed),
+        subject=_StreamSubject(timed, clock),
         name="markdown_stream",
     )
     return Table(node, data_cols, dtypes, name="markdown_stream")
@@ -283,6 +376,9 @@ def table_to_parquet(table: Table, filename: Any) -> None:
 
 def _run_capture(*tables: Table) -> list[tuple[dict, list]]:
     captures = [t._capture_node() for t in tables]
+    clock = getattr(G.engine_graph, "_md_stream_clock", None)
+    if clock is not None:
+        clock.reset()
     sched = Scheduler(G.engine_graph)
     ctx = sched.run()
     G.last_run_ctx = ctx

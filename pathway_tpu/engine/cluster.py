@@ -127,6 +127,29 @@ DEFAULT_LIVENESS_TIMEOUT_S = 10.0
 #: the bytes themselves are the signal.
 _HEARTBEAT = struct.pack("<QI", 4, 0)
 
+#: default per-peer cap on unacknowledged exchange data bytes
+#: (PATHWAY_EXCHANGE_CREDIT_BYTES; <= 0 disables flow control).  A
+#: producer with this much data outstanding to one peer waits for a
+#: credit grant instead of queueing more — a slow-but-alive peer
+#: throttles its upstream instead of growing its mailbox without bound.
+DEFAULT_EXCHANGE_CREDIT_BYTES = 64 << 20
+
+#: magic slot for credit grants, piggybacked on ordinary transmissions
+#: the way ``round_statuses`` piggybacks trace wires on "#tc": payload is
+#: the receiver's cumulative consumed-bytes counter for this link.  The
+#: reader intercepts it before the inbox — workers never see the slot.
+_CREDIT_SLOT = "#cr"
+
+
+def _est_boxes_bytes(boxes: list) -> int:
+    """Cheap wire-size estimate of an update-box frame at enqueue time
+    (exact sizes replace it once the sender thread encodes)."""
+    n = 0
+    for row in boxes:
+        for box in row:
+            n += len(box)
+    return 96 + 56 * n
+
 
 class WakeupHub:
     """Shared wakeup channel for the event-driven scheduler loops.
@@ -200,21 +223,42 @@ class _PeerSender(threading.Thread):
         #: which incarnation of this peer's link the sender serves; a
         #: replaced link's sender dying must not kill the replacement
         self.link_version = 0
-        self._q: deque = deque()
+        self._q: deque = deque()  # lk009: bounded by exchange credit accounting
         self._cv = threading.Condition()
         # NB: not "_stop" — that shadows threading.Thread._stop(),
         # which join() calls internally on CPython 3.10
         self._stopped = False
+        #: close() sets this for a non-ALIVE peer: exit WITHOUT sending
+        #: the backlog (bounded teardown must not drain into a stalled
+        #: socket — sendall to a suspect peer can block for the full grace)
+        self._drop = False
+        #: grant nudge from the consuming side (see _ProcessLinks._kick)
+        self._kicked = False
+        #: estimated bytes of enqueued-but-not-yet-encoded data frames;
+        #: part of the producer's outstanding-credit arithmetic
+        self.queued_bytes = 0
         self._buf = bytearray()
 
-    def enqueue(self, slot: Any, kind: int, payload: Any) -> None:
+    def enqueue(
+        self, slot: Any, kind: int, payload: Any, est: int = 0
+    ) -> None:
         with self._cv:
             self._q.append((slot, kind, payload))
+            self.queued_bytes += est
             self._cv.notify()
 
-    def stop(self) -> None:
+    def stop(self, drop_backlog: bool = False) -> None:
         with self._cv:
             self._stopped = True
+            if drop_backlog:
+                self._drop = True
+            self._cv.notify()
+
+    def kick(self) -> None:
+        """Wake the sender even with an empty mailbox, so a pending
+        credit grant ships now instead of riding the next heartbeat."""
+        with self._cv:
+            self._kicked = True
             self._cv.notify()
 
     def run(self) -> None:
@@ -223,28 +267,58 @@ class _PeerSender(threading.Thread):
         try:
             while True:
                 idle = False
+                dropped = -1
                 with self._cv:
-                    while not self._q and not self._stopped:
+                    while (
+                        not self._q and not self._stopped and not self._kicked
+                    ):
                         if not self._cv.wait(heartbeat_s):
                             idle = True
                             break
-                    if self._q:
+                    if self._stopped and self._drop:
+                        # bounded teardown for a suspect/dead peer: the
+                        # backlog is undeliverable — drop it instead of
+                        # blocking close() behind a stalled sendall
+                        dropped = len(self._q)
+                        self._q.clear()
+                        self.queued_bytes = 0
+                    elif self._q:
                         idle = False
                     elif self._stopped:
                         return  # stopped and drained
+                    self._kicked = False
                     items = list(self._q)
                     self._q.clear()
-                if idle:
-                    # link idle past the heartbeat period: ship an empty
-                    # transmission so the peer's liveness clock advances
-                    self._transmit(_HEARTBEAT, 0)
+                    self.queued_bytes = 0
+                if dropped >= 0:
+                    if dropped:
+                        with links.stats_lock:
+                            links.stats["frames_dropped_on_close"] += dropped
+                    return
+                # credit grant piggyback: whatever we owe this peer rides
+                # the transmission we were about to make anyway
+                grant = links._take_grant(self.peer)
+                if not items:
+                    if grant is not None:
+                        # kicked (or idle) with a pending grant: ship it
+                        # alone; n_frames=0 keeps the data-transmission
+                        # stats invariant (it is liveness+credit, not data)
+                        body, _db = self._encode([(_CREDIT_SLOT, _K_OBJ, grant)])
+                        self._transmit(body, 0)
+                    elif idle:
+                        # link idle past the heartbeat period: ship an
+                        # empty transmission so the peer's liveness clock
+                        # advances
+                        self._transmit(_HEARTBEAT, 0)
                     continue
+                if grant is not None:
+                    items.append((_CREDIT_SLOT, _K_OBJ, grant))
                 # thread_time, not perf_counter: wall time in a helper
                 # thread mostly measures GIL waits while the workers run;
                 # this thread's own CPU is the compute it displaces
                 t0 = _time.thread_time()
                 t0_ns = _time.monotonic_ns()
-                body = self._encode(items)
+                body, data_bytes = self._encode(items)
                 t1 = _time.thread_time()
                 with links.stats_lock:
                     links.stats["pack_ms"] += (t1 - t0) * 1e3
@@ -252,6 +326,10 @@ class _PeerSender(threading.Thread):
                     "pack", t0_ns, _time.monotonic_ns(),
                     args={"src": links.process_id, "dst": self.peer},
                 )
+                if data_bytes:
+                    # account BEFORE the send: outstanding must never
+                    # under-count while bytes are on the wire
+                    links._note_data_sent(self.peer, data_bytes)
                 self._transmit(body, len(items))
         except Exception as e:  # socket OR encode failure: fail loudly
             links._fail_peer(
@@ -285,15 +363,23 @@ class _PeerSender(threading.Thread):
             st["send_ms"] += (t1 - t0) * 1e3
 
     # ------------------------------------------------------------------
-    def _encode(self, items: list) -> bytearray:
+    def _encode(self, items: list) -> tuple[bytearray, int]:
+        """Encode one transmission; also returns the wire bytes of the
+        DATA (update-box) messages in it — the unit the credit protocol
+        accounts in on both sides (the receiver measures the identical
+        spans while decoding)."""
         buf = self._buf
         del buf[:]  # reset length, keep capacity across epochs
         buf += b"\x00" * 12  # u64 body_len + u32 n_msgs, patched below
         native = _native_mod.load()
+        data_bytes = 0
         for slot, kind, payload in items:
+            before = len(buf)
             self._encode_msg(buf, slot, kind, payload, native)
+            if kind == _K_UPDATES:
+                data_bytes += len(buf) - before
         struct.pack_into("<QI", buf, 0, len(buf) - 8, len(items))
-        return buf
+        return buf, data_bytes
 
     @staticmethod
     def _encode_msg(
@@ -418,6 +504,23 @@ class _ProcessLinks:
         #: is replaced — readers/senders tag themselves with it so frames
         #: and errors from a superseded link are rejected, not believed
         self._link_version: dict[int, int] = {}
+        #: per-peer cap on unacknowledged outbound data bytes (credit
+        #: flow control); <= 0 disables the producer wait entirely
+        self.credit_bytes = _env_int(
+            "PATHWAY_EXCHANGE_CREDIT_BYTES", DEFAULT_EXCHANGE_CREDIT_BYTES
+        )
+        #: credit ledgers, all under _cv.  Outbound: wire data bytes sent
+        #: to peer vs. the peer's cumulative consumed-grant.  Inbound:
+        #: data bytes we consumed from peer vs. the grant value already
+        #: shipped back.  _inbox_bytes mirrors _inbox with wire sizes so
+        #: consumption is measured when a worker POPS the payload, not
+        #: when the reader deposits it — a slow worker, not a fast
+        #: socket, is what must throttle the remote producer.
+        self._data_sent: dict[int, int] = {}
+        self._data_granted: dict[int, int] = {}
+        self._consumed_from: dict[int, int] = {}
+        self._granted_sent: dict[int, int] = {}
+        self._inbox_bytes: dict[Any, dict[int, int]] = {}
         self.stats: dict[str, Any] = {
             "transmissions": 0,
             "frames_sent": 0,
@@ -428,6 +531,9 @@ class _ProcessLinks:
             "stale_frames_dropped": 0,
             "peers_declared_dead": 0,
             "peers_rejoined": 0,
+            "credit_stalls": 0,
+            "credit_stall_ms": 0.0,
+            "frames_dropped_on_close": 0,
             "pack_ms": 0.0,
             "send_ms": 0.0,
             "unpack_ms": 0.0,
@@ -563,6 +669,7 @@ class _ProcessLinks:
             # frames must not satisfy a wait meant for the replacement
             for deposits in self._inbox.values():
                 deposits.pop(peer, None)
+            self._reset_credit_locked(peer)
             self._link_version[peer] = self._link_version.get(peer, -1) + 1
             self._peer_incarnation[peer] = peer_inc
             self._peer_state[peer] = PEER_ALIVE
@@ -694,13 +801,17 @@ class _ProcessLinks:
                 deposits.pop(peer, None)
             for arrivals in self._arrival_ns.values():
                 arrivals.pop(peer, None)
+            # release producers parked on this peer's credit: a dead
+            # peer's outstanding bytes are void (rejoin restarts at zero)
+            self._reset_credit_locked(peer)
             sender = self._senders.pop(peer, None)
             sock = self._socks.pop(peer, None)
             self._cv.notify_all()
         with self.stats_lock:
             self.stats["peers_declared_dead"] += 1
         if sender is not None:
-            sender.stop()
+            # the backlog is undeliverable — drop, don't drain
+            sender.stop(drop_backlog=True)
         if sock is not None:
             try:
                 sock.close()
@@ -736,8 +847,25 @@ class _ProcessLinks:
                 with self.stats_lock:
                     self.stats["bytes_recv"] += 8 + body_len
                     self.stats["unpack_ms"] += dt
-                if not deposits:
-                    continue  # heartbeat: the bytes already did their job
+                # credit grants are link-control, not data: apply them
+                # (monotonic max — grants are cumulative counters) and
+                # keep them out of the inbox
+                grant = None
+                data = []
+                for slot, payload, nbytes in deposits:
+                    if slot == _CREDIT_SLOT:
+                        if grant is None or payload > grant:
+                            grant = payload
+                    else:
+                        data.append((slot, payload, nbytes))
+                if grant is not None:
+                    with self._cv:
+                        if grant > self._data_granted.get(peer, 0):
+                            self._data_granted[peer] = grant
+                            # wake producers parked in _wait_for_credit
+                            self._cv.notify_all()
+                if not data:
+                    continue  # heartbeat/grant: bytes already did their job
                 _tracing.record_span(
                     "unpack", t0_ns, now_ns,
                     args={"src": peer, "dst": self.process_id},
@@ -751,15 +879,17 @@ class _ProcessLinks:
                         # superseded or dead incarnation are dropped, not
                         # deposited — a zombie cannot corrupt the mesh
                         with self.stats_lock:
-                            self.stats["stale_frames_dropped"] += len(
-                                deposits
-                            )
+                            self.stats["stale_frames_dropped"] += len(data)
                         return
                     box = self._inbox
                     arrivals = self._arrival_ns
-                    for slot, payload in deposits:
+                    for slot, payload, nbytes in data:
                         box.setdefault(slot, {})[peer] = payload
                         arrivals.setdefault(slot, {})[peer] = now_ns
+                        if nbytes:
+                            self._inbox_bytes.setdefault(slot, {})[
+                                peer
+                            ] = nbytes
                     self._cv.notify_all()
                 if self._hub is not None:
                     # frame arrival is a scheduler-relevant event: wake any
@@ -776,13 +906,18 @@ class _ProcessLinks:
 
     @staticmethod
     def _decode(mv: memoryview, native: Any) -> list:
-        """Decode one transmission into [(slot, payload)]; update payloads
-        come out as fully-built ``Update`` lists (deserialization happens
-        here on the reader thread, overlapping worker compute)."""
+        """Decode one transmission into [(slot, payload, nbytes)]; update
+        payloads come out as fully-built ``Update`` lists (deserialization
+        happens here on the reader thread, overlapping worker compute).
+        ``nbytes`` is the wire size of DATA messages (update boxes, plain
+        or binary) and 0 for control objects — measured over the same
+        byte spans the sender charged against the peer's credit, so the
+        two ledgers agree exactly."""
         (n_msgs,) = struct.unpack_from("<I", mv, 0)
         off = 4
         out = []
         for _ in range(n_msgs):
+            msg_start = off
             (slot_len,) = struct.unpack_from("<I", mv, off)
             off += 4
             slot = pickle.loads(mv[off : off + slot_len])
@@ -810,7 +945,7 @@ class _ProcessLinks:
                         row.append(unpack(mv[off : off + blen]))
                         off += blen
                     boxes.append(row)
-                out.append((slot, boxes))
+                out.append((slot, boxes, off - msg_start))
                 continue
             (dlen,) = struct.unpack_from("<Q", mv, off)
             off += 8
@@ -827,25 +962,142 @@ class _ProcessLinks:
                     ]
                     for row in obj
                 ]
-            out.append((slot, obj))
+            out.append(
+                (slot, obj, (off - msg_start) if kind == _K_PLAIN else 0)
+            )
         return out
+
+    # ------------------------------------------------------------------
+    # credit flow control (exchange data only; control frames are exempt
+    # so collectives can never deadlock on a full data window)
+
+    def _reset_credit_locked(self, peer: int) -> None:
+        """Void a peer's credit ledgers (link replaced or declared dead);
+        caller holds ``_cv`` — its notify_all releases parked producers."""
+        self._data_sent.pop(peer, None)
+        self._data_granted.pop(peer, None)
+        self._consumed_from.pop(peer, None)
+        self._granted_sent.pop(peer, None)
+        for sizes in self._inbox_bytes.values():
+            sizes.pop(peer, None)
+
+    def _note_data_sent(self, peer: int, nbytes: int) -> None:
+        with self._cv:
+            self._data_sent[peer] = self._data_sent.get(peer, 0) + nbytes
+
+    def _take_grant(self, peer: int) -> int | None:
+        """Grant value owed to ``peer`` (our cumulative consumed-bytes
+        counter), or None if the last sent grant is still current.  The
+        caller (its sender thread) ships it; marking it sent here is safe
+        because there is exactly one sender per link."""
+        with self._cv:
+            consumed = self._consumed_from.get(peer, 0)
+            if consumed > self._granted_sent.get(peer, 0):
+                self._granted_sent[peer] = consumed
+                return consumed
+            return None
+
+    def _outstanding_locked(self, peer: int) -> int:
+        """Unacknowledged data bytes to ``peer``: encoded-and-sent minus
+        granted, plus the mailbox's enqueue-time estimate."""
+        sender = self._senders.get(peer)
+        queued = sender.queued_bytes if sender is not None else 0
+        return (
+            self._data_sent.get(peer, 0)
+            - self._data_granted.get(peer, 0)
+            + queued
+        )
+
+    def _wait_for_credit(self, peer: int, est: int) -> None:
+        """Producer-side throttle: park until ``est`` more bytes fit in
+        the peer's credit window.  Finite wait slices; escapes on grant
+        arrival, link failure/close, peer death (isolate quiesces the
+        route), or an empty window (one oversized frame always passes —
+        the window bounds *accumulation*, not frame size).  This is what
+        distinguishes SLOW from DEAD: a slow peer parks us (bounded
+        memory), a dead one releases us (frames to it are dropped)."""
+        t0_ns = None
+        with self._cv:
+            while True:
+                if self._closed or self._failed is not None:
+                    break
+                if self._peer_state.get(peer) == PEER_DEAD:
+                    break
+                if peer not in self._senders:
+                    break
+                outstanding = self._outstanding_locked(peer)
+                if outstanding <= 0 or outstanding + est <= self.credit_bytes:
+                    break
+                if t0_ns is None:
+                    t0_ns = _time.monotonic_ns()
+                    with self.stats_lock:
+                        self.stats["credit_stalls"] += 1
+                self._cv.wait(0.05)
+        if t0_ns is not None:
+            t1_ns = _time.monotonic_ns()
+            with self.stats_lock:
+                self.stats["credit_stall_ms"] += (t1_ns - t0_ns) / 1e6
+            _tracing.record_span(
+                "credit_wait", t0_ns, t1_ns,
+                args={"src": self.process_id, "dst": peer, "bytes": est},
+            )
+
+    def exchange_pressure(self) -> dict[str, Any]:
+        """Per-peer credit backlog snapshot for /metrics + /status."""
+        with self._cv:
+            peers = {}
+            for p in range(self.n_processes):
+                if p == self.process_id:
+                    continue
+                peers[p] = {
+                    "backlog_bytes": max(0, self._outstanding_locked(p)),
+                    "state": self._peer_state.get(p, PEER_ALIVE),
+                }
+        with self.stats_lock:
+            stalls = self.stats["credit_stalls"]
+            stall_ms = self.stats["credit_stall_ms"]
+        return {
+            "credit_bytes": self.credit_bytes,
+            "peers": peers,
+            "credit_stalls_total": stalls,
+            "credit_stall_ms_total": round(stall_ms, 3),
+        }
+
+    def pressure_level(self) -> float:
+        """Worst per-peer window occupancy in [0, 1] (0 when disabled)."""
+        if self.credit_bytes <= 0:
+            return 0.0
+        with self._cv:
+            worst = 0
+            for p in range(self.n_processes):
+                if p != self.process_id:
+                    worst = max(worst, self._outstanding_locked(p))
+        return min(1.0, worst / self.credit_bytes)
 
     # ------------------------------------------------------------------
     def send_async(self, peer: int, slot: Any, obj: Any) -> None:
         """Queue a pickled-object message; the sender thread coalesces it
         with whatever else is outbound to this peer.  A frame addressed
         to a dead peer (isolate policy) is dropped — its route is
-        quiesced, and the rejoin handshake re-opens it."""
+        quiesced, and the rejoin handshake re-opens it.  Control objects
+        are credit-exempt: statuses, gathers, and barriers must flow even
+        with the data window full, or the mesh would deadlock."""
         sender = self._senders.get(peer)
         if sender is not None:
             sender.enqueue(slot, _K_OBJ, obj)
 
     def send_updates_async(self, peer: int, slot: Any, boxes: list) -> None:
         """Queue an update-box frame (``boxes[src_tid][dst_tid]`` lists of
-        Updates); serialization happens on the sender thread."""
+        Updates); serialization happens on the sender thread.  With credit
+        flow control on, first waits for window room — backpressure
+        propagates to the calling worker, which stops cutting epochs,
+        which fills the ingest buffer, which pauses the readers."""
+        est = _est_boxes_bytes(boxes)
+        if self.credit_bytes > 0:
+            self._wait_for_credit(peer, est)
         sender = self._senders.get(peer)
         if sender is not None:
-            sender.enqueue(slot, _K_UPDATES, boxes)
+            sender.enqueue(slot, _K_UPDATES, boxes, est=est)
 
     def recv_from_all(self, slot: Any) -> dict[int, Any]:
         """Block until every *live* peer delivered a payload for ``slot``.
@@ -863,6 +1115,7 @@ class _ProcessLinks:
                 if self._failed is not None:
                     raise RuntimeError(f"cluster failure: {self._failed}")
                 got = self._inbox.get(slot)
+                out = None
                 if self.fail_policy == "isolate":
                     live = [
                         p
@@ -875,10 +1128,42 @@ class _ProcessLinks:
                         out = {p: have.pop(p) for p in live}
                         if not have:
                             self._inbox.pop(slot, None)
-                        return out
                 elif got is not None and len(got) == self.n_processes - 1:
-                    return self._inbox.pop(slot)
+                    out = self._inbox.pop(slot)
+                if out is not None:
+                    kick = self._consume_slot_locked(slot, out)
+                    break
                 self._cv.wait(1.0)
+        for p in kick:
+            sender = self._senders.get(p)
+            if sender is not None:
+                sender.kick()
+        return out
+
+    def _consume_slot_locked(self, slot: Any, out: dict[int, Any]) -> list:
+        """Account a satisfied slot's wire bytes as CONSUMED (this is the
+        moment a worker actually took delivery); returns the peers whose
+        pending grant grew large enough to ship eagerly rather than ride
+        the next round's piggyback."""
+        kick = []
+        sizes = self._inbox_bytes.get(slot)
+        if sizes is None:
+            return kick
+        eager = self.credit_bytes // 8 if self.credit_bytes > 0 else None
+        for p in out:
+            nb = sizes.pop(p, 0)
+            if not nb:
+                continue
+            consumed = self._consumed_from.get(p, 0) + nb
+            self._consumed_from[p] = consumed
+            if (
+                eager is not None
+                and consumed - self._granted_sent.get(p, 0) >= eager
+            ):
+                kick.append(p)
+        if not sizes:
+            self._inbox_bytes.pop(slot, None)
+        return kick
 
     def pop_arrivals(self, slot: Any) -> dict[int, int]:
         """Consume the per-peer deposit timestamps (monotonic ns) the
@@ -928,9 +1213,16 @@ class _ProcessLinks:
         unbounded join anywhere, so teardown cannot hang."""
         with self._cv:
             self._closed = True
+            states = dict(self._peer_state)
+            self._cv.notify_all()  # release producers in _wait_for_credit
         senders = list(self._senders.values())
         for sender in senders:
-            sender.stop()
+            # a suspect/dead peer's backlog is undeliverable and its
+            # socket may be stalled: DROP it — draining would park the
+            # sender in sendall for the whole teardown grace
+            sender.stop(
+                drop_backlog=states.get(sender.peer, PEER_ALIVE) != PEER_ALIVE
+            )
         for sender in senders:
             sender.join(0.5)
         for sock in list(self._socks.values()):
@@ -1028,6 +1320,14 @@ class Cluster:
 
     def membership(self) -> dict[int, dict[str, Any]]:
         return {} if self._links is None else self._links.membership()
+
+    def exchange_pressure(self) -> dict[str, Any]:
+        """Per-peer credit backlog (``{}`` for a single-process cluster)."""
+        return {} if self._links is None else self._links.exchange_pressure()
+
+    def pressure_level(self) -> float:
+        """Worst peer credit-window occupancy in [0, 1]."""
+        return 0.0 if self._links is None else self._links.pressure_level()
 
     def exchange_stats(self) -> dict[str, Any]:
         """Snapshot of the exchange-overhead probe: collective counts and

@@ -101,6 +101,199 @@ def approx_state_bytes(obj: Any, depth: int = 5) -> int:
     return base
 
 
+#: default bound on bytes buffered between the connector readers and the
+#: epoch drain (PATHWAY_INGEST_BUFFER_BYTES); <= 0 disables accounting
+DEFAULT_INGEST_BUFFER_BYTES = 256 << 20
+
+#: per-connector overflow policies (input_table(on_overflow=...))
+INGEST_OVERFLOW_MODES = ("pause", "shed_oldest", "fail")
+
+
+class IngestOverflow(RuntimeError):
+    """Raised into the reader thread when its source overflows the ingest
+    buffer under ``on_overflow="fail"`` (the supervisor applies the
+    connector's recovery policy to it like any other reader failure)."""
+
+
+def _approx_event_bytes(kind: str, key: Any, values: Any) -> int:
+    """Cheap buffered-size estimate of one queue item.  Batch items hold
+    the built Update list in ``key``; sampled sizing extrapolates, so a
+    million-row chunk costs a bounded probe, not a deep walk."""
+    if kind == "batch":
+        return approx_state_bytes(key, depth=3) + 64
+    return approx_state_bytes(values, depth=2) + 96
+
+
+class IngestCredit:
+    """Bytes-accounted admission for the connector -> scheduler queue.
+
+    One instance per scheduler, shared by every source: readers *charge*
+    each data item before enqueueing it and the drain loops *consume* it
+    when it leaves the queue, so the un-drained backlog is bounded by
+    ``capacity_bytes`` end to end.  Overflow behaviour is per source:
+
+    - ``"pause"`` (default): the reader thread parks in finite wait
+      slices until the drain frees room — native backpressure, no loss.
+      A paused source is flagged in its connector stats so the
+      supervisor's watchdog does not mistake backpressure for a hang.
+    - ``"shed_oldest"``: the source's oldest *buffered* items are
+      uncharged immediately (a shed floor advances past them) and the
+      drain discards them when it reaches them — counted shed, never
+      silent loss.
+    - ``"fail"``: raises :class:`IngestOverflow` into the reader.
+
+    All waits are finite condition slices re-checking the stop event, so
+    shutdown always interrupts a paused reader."""
+
+    _WAIT_SLICE_S = 0.05
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = int(capacity_bytes)
+        self._cv = threading.Condition()
+        #: per-source FIFO of (seq, bytes, rows) still in the queue
+        self._entries: dict[int, deque] = {}
+        self._next_seq: dict[int, int] = {}
+        #: items with seq < floor were shed; the drain skips them
+        self._floor: dict[int, int] = {}
+        self._bytes: dict[int, int] = {}
+        self._rows: dict[int, int] = {}
+        self._total = 0
+        self.stalls_total = 0
+        self.stall_ms_total = 0.0
+        self.shed_rows: dict[int, int] = {}
+        self.shed_bytes: dict[int, int] = {}
+        self._paused: set[int] = set()
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def level(self) -> float:
+        """Buffer occupancy in [0, 1] — the engine's ingest-pressure
+        signal (pushed to serving brownout when the gap is material)."""
+        if self.capacity <= 0:
+            return 0.0
+        return min(1.0, self._total / self.capacity)
+
+    def charge(
+        self,
+        node_id: int,
+        nbytes: int,
+        nrows: int,
+        on_overflow: str,
+        stop_event: threading.Event | None,
+        stats: dict | None = None,
+    ) -> int:
+        """Admit one data item; returns its sequence number.  May block
+        (pause), advance the shed floor (shed_oldest), or raise
+        (:class:`IngestOverflow`, fail)."""
+        t0 = _time.monotonic()
+        stalled = False
+        with self._cv:
+            while (
+                self._total > 0
+                and self._total + nbytes > self.capacity
+                and not (stop_event is not None and stop_event.is_set())
+            ):
+                if on_overflow == "fail":
+                    raise IngestOverflow(
+                        f"source {node_id} overflowed the ingest buffer "
+                        f"({self._total + nbytes} > {self.capacity} bytes; "
+                        f"PATHWAY_INGEST_BUFFER_BYTES)"
+                    )
+                if on_overflow == "shed_oldest":
+                    if not self._shed_locked(node_id, nbytes):
+                        break  # nothing of ours left to shed: admit over
+                    continue
+                # pause: finite slices; the drain's consume notifies
+                if not stalled:
+                    stalled = True
+                    self.stalls_total += 1
+                    self._paused.add(node_id)
+                    if stats is not None:
+                        stats["paused"] = True
+                        stats["pauses"] = stats.get("pauses", 0) + 1
+                self._cv.wait(self._WAIT_SLICE_S)
+            if stalled:
+                self._paused.discard(node_id)
+                if stats is not None:
+                    stats["paused"] = False
+                self.stall_ms_total += (_time.monotonic() - t0) * 1e3
+            seq = self._next_seq.get(node_id, 0)
+            self._next_seq[node_id] = seq + 1
+            self._entries.setdefault(node_id, deque()).append(
+                (seq, nbytes, nrows)
+            )
+            self._bytes[node_id] = self._bytes.get(node_id, 0) + nbytes
+            self._rows[node_id] = self._rows.get(node_id, 0) + nrows
+            self._total += nbytes
+            return seq
+
+    def _shed_locked(self, node_id: int, need: int) -> bool:
+        """Uncharge this source's oldest buffered items until ``need``
+        bytes fit (or nothing of ours is left); the floor marks them for
+        the drain to discard.  Returns True if anything was shed."""
+        entries = self._entries.get(node_id)
+        if not entries:
+            return False
+        shed_any = False
+        while entries and self._total + need > self.capacity:
+            seq, nbytes, nrows = entries.popleft()
+            self._floor[node_id] = seq + 1
+            self._bytes[node_id] -= nbytes
+            self._rows[node_id] -= nrows
+            self._total -= nbytes
+            self.shed_rows[node_id] = self.shed_rows.get(node_id, 0) + nrows
+            self.shed_bytes[node_id] = (
+                self.shed_bytes.get(node_id, 0) + nbytes
+            )
+            shed_any = True
+        return shed_any
+
+    def consume(self, node_id: int, seq: int) -> bool:
+        """Called by the drain when an item leaves the queue; False means
+        the item was shed (the drain discards it without processing)."""
+        with self._cv:
+            if seq < self._floor.get(node_id, 0):
+                return False  # shed: bytes already uncharged
+            entries = self._entries.get(node_id)
+            if entries and entries[0][0] == seq:
+                _s, nbytes, nrows = entries.popleft()
+                self._bytes[node_id] -= nbytes
+                self._rows[node_id] -= nrows
+                self._total -= nbytes
+                self._cv.notify_all()  # room freed: wake paused readers
+            return True
+
+    def snapshot(self) -> dict[int, dict]:
+        """Per-source occupancy + shed counters (node-id keyed; the
+        scheduler maps ids to input names for /metrics)."""
+        with self._cv:
+            out: dict[int, dict] = {}
+            for nid in set(self._bytes) | set(self.shed_rows):
+                out[nid] = {
+                    "rows": self._rows.get(nid, 0),
+                    "bytes": self._bytes.get(nid, 0),
+                    "shed_rows": self.shed_rows.get(nid, 0),
+                    "shed_bytes": self.shed_bytes.get(nid, 0),
+                    "paused": nid in self._paused,
+                }
+            return out
+
+    def totals(self) -> dict[str, Any]:
+        with self._cv:
+            return {
+                "capacity_bytes": self.capacity,
+                "buffered_bytes": self._total,
+                "buffered_rows": sum(self._rows.values()),
+                "stalls_total": self.stalls_total,
+                "stall_ms_total": round(self.stall_ms_total, 3),
+                "shed_rows_total": sum(self.shed_rows.values()),
+                "paused_sources": len(self._paused),
+                "level": self.level(),
+            }
+
+
 class ConnectorEvents:
     """Callback bundle handed to a connector subject's reader thread.
 
@@ -123,12 +316,16 @@ class ConnectorEvents:
         stats: dict | None = None,
         now_ns: Callable[[], int] | None = None,
         wake: Callable[[], None] | None = None,
+        credit: "IngestCredit | None" = None,
+        on_overflow: str | None = None,
     ):
         self._q = q
         self._node_id = node_id
         self._stop_event = stop_event
         self._now_ns = now_ns if now_ns is not None else _time.monotonic_ns
         self._wake = wake
+        self._credit = credit if credit is not None and credit.enabled else None
+        self._on_overflow = on_overflow or "pause"
         #: per-connector counters (reference src/connectors/monitoring.rs);
         #: approximate under concurrent readers — monitoring only
         self.stats = stats if stats is not None else {}
@@ -143,7 +340,20 @@ class ConnectorEvents:
         return self._stop_event is not None and self._stop_event.is_set()
 
     def _put(self, kind: str, key: Any, values: Any) -> None:
-        self._q.put((self._node_id, kind, key, values, self._now_ns()))
+        seq = None
+        if self._credit is not None and kind in ("add", "remove", "batch"):
+            nrows = len(key) if kind == "batch" else 1
+            seq = self._credit.charge(
+                self._node_id,
+                _approx_event_bytes(kind, key, values),
+                nrows,
+                self._on_overflow,
+                self._stop_event,
+                self.stats,
+            )
+        self._q.put(
+            (self._node_id, kind, key, values, self._now_ns(), seq)
+        )
         if self._wake is not None:
             self._wake()
 
@@ -240,6 +450,21 @@ class Scheduler:
         #: PATHWAY_WORKER_RESTARTS; internals.run copies it here) — feeds
         #: the pathway_tpu_worker_restarts_total gauge
         self.worker_restarts = 0
+        #: bounded, bytes-accounted connector ingest buffer (backpressure):
+        #: readers charge it before enqueueing, the drain loops consume;
+        #: PATHWAY_INGEST_BUFFER_BYTES <= 0 disables the accounting
+        try:
+            cap = int(
+                _os.environ.get(
+                    "PATHWAY_INGEST_BUFFER_BYTES",
+                    str(DEFAULT_INGEST_BUFFER_BYTES),
+                )
+            )
+        except ValueError:
+            cap = DEFAULT_INGEST_BUFFER_BYTES
+        self.ingest_credit = IngestCredit(cap)
+        #: last pressure level pushed to serving (rate-limits the push)
+        self._last_pressure_pushed = 0.0
 
     # ------------------------------------------------------------------
     def snapshot_connector_stats(self) -> dict[str, dict]:
@@ -259,6 +484,51 @@ class Scheduler:
                 nid: dict(p)
                 for nid, p in ctx.stats.get("operators", {}).items()
             }
+
+    def ingest_pressure(self) -> dict[str, Any]:
+        """Ingest-buffer pressure snapshot with sources keyed by input
+        NAME (monitoring surfaces; node ids are internal).  Shape:
+        ``{"totals": {...}, "sources": {name: {rows, bytes, shed_rows,
+        shed_bytes, paused}}}``."""
+        by_id = self.ingest_credit.snapshot()
+        names: dict[int, str] = {}
+        for node in self.graph.nodes:
+            if isinstance(node, InputNode):
+                names[node.id] = getattr(node, "name", str(node.id))
+        return {
+            "totals": self.ingest_credit.totals(),
+            "sources": {
+                names.get(nid, str(nid)): snap for nid, snap in by_id.items()
+            },
+        }
+
+    def pressure_level(self) -> float:
+        """Engine pressure in [0, 1]: the max of ingest-buffer occupancy
+        and exchange credit backlog — the signal brownout acts on."""
+        level = self.ingest_credit.level()
+        cluster = self._active_cluster
+        if cluster is not None:
+            level = max(level, cluster.pressure_level())
+        return level
+
+    def _push_serving_pressure(self) -> None:
+        """Propagate engine pressure to serving admission (brownout).
+        Cheap no-op unless serving is imported; pushes only on material
+        change (>= 0.05) or full release so the epoch loop stays hot."""
+        import sys
+
+        serving = sys.modules.get("pathway_tpu.serving")
+        if serving is None:
+            return
+        level = self.pressure_level()
+        last = self._last_pressure_pushed
+        if abs(level - last) < 0.05 and not (level == 0.0 and last > 0.0):
+            return
+        self._last_pressure_pushed = level
+        try:
+            serving.push_pressure("engine", level)
+        except Exception:
+            pass  # monitoring-path best effort; never kill the epoch loop
 
     def _settle_s(self, last_epoch_s: float) -> float:
         """Adaptive micro-batch settle window (seconds): after the last
@@ -769,7 +1039,7 @@ class Scheduler:
                 self._finish()
                 return self.ctx
 
-        q: "queue.Queue" = queue.Queue()
+        q: "queue.Queue" = queue.Queue()  # lk009: bytes-bounded by IngestCredit.charge
         threads: list[threading.Thread] = []
         wrappers: dict[int, Any] = {}
         for node in live_inputs:
@@ -793,13 +1063,14 @@ class Scheduler:
         buffers: dict[int, list[Update]] = defaultdict(list)
         lat = self.latency
         now_ns = lat.now_ns
+        credit = self.ingest_credit
         self._live_queues.append(q)
         autocommit_s = self.autocommit_ms / 1000.0
         commit_requested = False
         rows_buffered = 0
         #: remainder of a batch item split at the epoch row budget; it
         #: re-enters the drain ahead of the queue, preserving source order
-        carry: deque = deque()
+        carry: deque = deque()  # lk009: holds at most one split batch item
         #: monotonic instants of the oldest / newest buffered arrival
         first_arrival: float | None = None
         last_arrival = 0.0
@@ -846,7 +1117,9 @@ class Scheduler:
             data_drained = False
             drain_ns = now_ns()
             while item is not None:
-                nid, kind, key, values, enq_ns = item
+                nid, kind, key, values, enq_ns, seq = item
+                if seq is not None and not credit.consume(nid, seq):
+                    kind = "shed"  # uncharged by shed_oldest: discard
                 if kind == "add":
                     buffers[nid].append(Update(key, values, 1))
                     rows_buffered += 1
@@ -855,10 +1128,11 @@ class Scheduler:
                     if 0 < room < len(key):
                         # budget-split: the remainder re-enters the drain
                         # first next pass, preserving per-source order
+                        # (already consumed from the credit: seq=None)
                         buffers[nid].extend(key[:room])
                         rows_buffered += room
                         carry.appendleft(
-                            (nid, "batch", key[room:], values, enq_ns)
+                            (nid, "batch", key[room:], values, enq_ns, None)
                         )
                     else:
                         buffers[nid].extend(key)
@@ -893,6 +1167,11 @@ class Scheduler:
                 if first_arrival is None:
                     first_arrival = now
             have_data = rows_buffered > 0
+            if commit_requested and not have_data:
+                # an empty commit is a no-op, not a standing order —
+                # latched, it would chop the NEXT batch at its first row
+                # instead of at that batch's own commit boundary
+                commit_requested = False
             settle = self._settle_s(last_epoch_s)
             should_cut = have_data and (
                 commit_requested
@@ -936,6 +1215,7 @@ class Scheduler:
                 origin_ns = None
                 if self.gc_tick is not None:
                     self.gc_tick()
+                self._push_serving_pressure()
                 if (
                     self.persistence is not None
                     and self.persistence.operator_mode
@@ -1085,9 +1365,10 @@ class Scheduler:
         hub = cluster.wakeup
         lat = self.latency
         now_ns = lat.now_ns
+        credit = self.ingest_credit
         if tid == 0:
             cluster.latency = lat  # exchange recv waits feed the probe
-        q: "queue.Queue" = queue.Queue()
+        q: "queue.Queue" = queue.Queue()  # lk009: bytes-bounded by IngestCredit.charge
         wrappers: dict[int, Any] = {}
         for node, subject in my_inputs:
             self._spawn_supervised(
@@ -1112,7 +1393,7 @@ class Scheduler:
         autocommit_s = self.autocommit_ms / 1000.0
         rows_buffered = 0
         #: remainder of a batch item split at the epoch row budget
-        carry: deque = deque()
+        carry: deque = deque()  # lk009: holds at most one split batch item
         first_arrival: float | None = None
         last_arrival = 0.0
         origin_ns: int | None = None
@@ -1140,8 +1421,10 @@ class Scheduler:
                         break
                 if item is None:
                     continue  # wake sentinel from stop()
-                nid, kind, key, values, enq_ns = item
+                nid, kind, key, values, enq_ns, seq = item
                 drained += 1
+                if seq is not None and not credit.consume(nid, seq):
+                    kind = "shed"  # uncharged by shed_oldest: discard
                 if kind == "add":
                     buffers[nid].append(Update(key, values, 1))
                     rows_buffered += 1
@@ -1151,7 +1434,7 @@ class Scheduler:
                         buffers[nid].extend(key[:room])
                         rows_buffered += room
                         carry.appendleft(
-                            (nid, "batch", key[room:], values, enq_ns)
+                            (nid, "batch", key[room:], values, enq_ns, None)
                         )
                     else:
                         buffers[nid].extend(key)
@@ -1296,6 +1579,8 @@ class Scheduler:
                 origin_ns = None
                 if tid == 0 and self.gc_tick is not None:
                     self.gc_tick()  # gc is process-wide: one thread sweeps
+                if tid == 0:
+                    self._push_serving_pressure()
                 if (
                     self.persistence is not None
                     and self.persistence.operator_mode
@@ -1488,6 +1773,8 @@ class Scheduler:
                     stats=cstats,
                     now_ns=self.latency.now_ns,
                     wake=wake,
+                    credit=self.ingest_credit,
+                    on_overflow=getattr(node, "on_overflow", None),
                 )
             if self.persistence is not None:
                 events = self.persistence.wrap_events(

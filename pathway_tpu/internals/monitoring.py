@@ -294,6 +294,10 @@ class ProberStats:
     #: estimated from analysis/memory.py over the executing plan view,
     #: measured sampled by the scheduler into the operator probes)
     memory: dict[str, Any] = field(default_factory=dict)
+    #: backpressure snapshot ({"ingest": per-source buffer occupancy +
+    #: shed counters, "exchange": per-peer credit backlog, "serving":
+    #: brownout level + sheds}; sections empty where not applicable)
+    pressure: dict[str, Any] = field(default_factory=dict)
 
 
 def memory_stats(sched: Any) -> dict[str, Any]:
@@ -359,7 +363,38 @@ def collect_stats(sched: Any) -> ProberStats:
         checkpoint=checkpoint_stats(sched),
         serving=serving_stats(),
         memory=memory_stats(sched),
+        pressure=pressure_stats(sched),
     )
+
+
+def pressure_stats(sched: Any) -> dict[str, Any]:
+    """Backpressure snapshot across the three bounded hops: connector
+    ingest buffer (per source), exchange credit windows (per peer), and
+    serving brownout.  Every section degrades to absent/empty when the
+    layer is not running — the schema is stable either way."""
+    out: dict[str, Any] = {}
+    ip = getattr(sched, "ingest_pressure", None)
+    if ip is not None:
+        try:
+            out["ingest"] = ip()
+        except Exception:
+            pass
+    cluster = getattr(sched, "_active_cluster", None)
+    if cluster is not None:
+        try:
+            ex = cluster.exchange_pressure()
+            if ex:
+                out["exchange"] = ex
+        except Exception:
+            pass
+    srv = serving_stats().get("admission")
+    if srv:
+        out["serving"] = {
+            "pressure_level": srv.get("pressure_level", 0.0),
+            "brownout_shed_total": srv.get("brownout_shed_total", {}),
+            "shed_total": srv.get("shed_total", {}),
+        }
+    return out
 
 
 def serving_stats() -> dict[str, Any]:

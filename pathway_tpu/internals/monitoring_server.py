@@ -282,6 +282,84 @@ def _metrics_text(sched: Any) -> str:
                 f"pathway_tpu_failover_seconds_sum "
                 f"{hist.get('sum_ns', 0) / 1e9:.6f}"
             )
+    # backpressure (ISSUE 16): bounded ingest buffer occupancy per source,
+    # exchange credit backlog per peer, brownout level + sheds — the
+    # panels that explain "slow but alive" before it becomes an OOM
+    pressure = _pressure_snapshot(sched)
+    ing = pressure.get("ingest", {})
+    if ing:
+        tot = ing.get("totals", {})
+        lines.append("# TYPE pathway_tpu_ingest_buffer_capacity_bytes gauge")
+        lines.append(
+            f"pathway_tpu_ingest_buffer_capacity_bytes "
+            f"{tot.get('capacity_bytes', 0)}"
+        )
+        lines.append("# TYPE pathway_tpu_ingest_credit_stalls_total counter")
+        lines.append(
+            f"pathway_tpu_ingest_credit_stalls_total "
+            f"{tot.get('stalls_total', 0)}"
+        )
+        srcs = ing.get("sources", {})
+        if srcs:
+            lines.append("# TYPE pathway_tpu_ingest_queue_rows gauge")
+            lines.append("# TYPE pathway_tpu_ingest_queue_bytes gauge")
+            lines.append("# TYPE pathway_tpu_ingest_shed_rows_total counter")
+            lines.append("# TYPE pathway_tpu_ingest_paused gauge")
+            for name, s in sorted(srcs.items()):
+                label = str(name).replace('"', "'")
+                lines.append(
+                    f'pathway_tpu_ingest_queue_rows{{input="{label}"}} '
+                    f"{s.get('rows', 0)}"
+                )
+                lines.append(
+                    f'pathway_tpu_ingest_queue_bytes{{input="{label}"}} '
+                    f"{s.get('bytes', 0)}"
+                )
+                lines.append(
+                    f'pathway_tpu_ingest_shed_rows_total{{input="{label}"}} '
+                    f"{s.get('shed_rows', 0)}"
+                )
+                lines.append(
+                    f'pathway_tpu_ingest_paused{{input="{label}"}} '
+                    f"{1 if s.get('paused') else 0}"
+                )
+    ex = pressure.get("exchange", {})
+    if ex:
+        lines.append("# TYPE pathway_tpu_exchange_credit_bytes gauge")
+        lines.append(
+            f"pathway_tpu_exchange_credit_bytes {ex.get('credit_bytes', 0)}"
+        )
+        lines.append("# TYPE pathway_tpu_exchange_credit_stalls_total counter")
+        lines.append(
+            f"pathway_tpu_exchange_credit_stalls_total "
+            f"{ex.get('credit_stalls_total', 0)}"
+        )
+        peers = ex.get("peers", {})
+        if peers:
+            lines.append("# TYPE pathway_tpu_exchange_backlog_bytes gauge")
+            for p, s in sorted(peers.items()):
+                lines.append(
+                    f'pathway_tpu_exchange_backlog_bytes{{peer="{p}"}} '
+                    f"{s.get('backlog_bytes', 0)}"
+                )
+    srv_p = pressure.get("serving", {})
+    if srv_p:
+        lines.append("# TYPE pathway_tpu_serving_brownout_level gauge")
+        lines.append(
+            f"pathway_tpu_serving_brownout_level "
+            f"{srv_p.get('pressure_level', 0.0):.4f}"
+        )
+        bshed = srv_p.get("brownout_shed_total", {})
+        if bshed:
+            lines.append(
+                "# TYPE pathway_tpu_serving_brownout_shed_total counter"
+            )
+            for cls, n in sorted(bshed.items()):
+                label = str(cls).replace('"', "'")
+                lines.append(
+                    f"pathway_tpu_serving_brownout_shed_total"
+                    f'{{tenant_class="{label}"}} {n}'
+                )
     return "\n".join(lines) + "\n# EOF\n"
 
 
@@ -313,6 +391,12 @@ def _memory_snapshot(sched: Any) -> dict[str, Any]:
     from pathway_tpu.internals.monitoring import memory_stats
 
     return memory_stats(sched)
+
+
+def _pressure_snapshot(sched: Any) -> dict[str, Any]:
+    from pathway_tpu.internals.monitoring import pressure_stats
+
+    return pressure_stats(sched)
 
 
 def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
@@ -361,6 +445,10 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         # per tenant class, scheduler lane stats, and
                         # per-(stage, tenant_class) latency (ISSUE 10)
                         "serving": srv,
+                        # backpressure across the bounded hops: ingest
+                        # buffer, exchange credit windows, brownout
+                        # (ISSUE 16)
+                        "pressure": _pressure_snapshot(sched),
                         # degraded-mode summary (ISSUE 13): one glance says
                         # whether answers are currently partial and why
                         "degraded": {

@@ -408,6 +408,10 @@ class ConnectorSupervisor:
                 timeout is not None
                 and t.is_alive()
                 and _time.monotonic() - att.last_activity > timeout
+                # a reader parked by ingest backpressure (IngestCredit
+                # pause) is waiting, not hung — fencing it would turn
+                # overload into a spurious restart storm
+                and not self.stats.get("paused")
             ):
                 att.fence()  # the zombie may never die; cut its sink
                 return WatchdogTimeout(

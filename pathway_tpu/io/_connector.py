@@ -202,8 +202,17 @@ def input_table(
     auxiliary: bool = False,
     persistent_id: str | None = None,
     recovery_policy: Any = None,
+    on_overflow: str | None = None,
 ) -> Table:
     cols = schema.column_names()
+    if on_overflow is not None:
+        from pathway_tpu.engine.scheduler import INGEST_OVERFLOW_MODES
+
+        if on_overflow not in INGEST_OVERFLOW_MODES:
+            raise ValueError(
+                f"on_overflow must be one of {INGEST_OVERFLOW_MODES}, "
+                f"got {on_overflow!r}"
+            )
     node = eg.InputNode(
         G.engine_graph,
         n_cols=len(cols),
@@ -224,6 +233,10 @@ def input_table(
     # pathway_tpu.internals.resilience); None keeps the historical
     # one-failure-drops-the-source behaviour
     node.recovery_policy = recovery_policy
+    # ingest-buffer overflow policy ("pause" | "shed_oldest" | "fail");
+    # None defaults to "pause" — the reader parks until the drain frees
+    # credit (see engine.scheduler.IngestCredit)
+    node.on_overflow = on_overflow
     # distribution-safety facts for the analyzer: static tables live on
     # every worker identically; live sources advertise how they split and
     # whether per-key order survives the split (analysis/distribution.py)

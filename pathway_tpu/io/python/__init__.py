@@ -120,6 +120,7 @@ def read(
     name: str = "python",
     persistent_id: str | None = None,
     recovery_policy: Any = None,
+    on_overflow: str | None = None,
     **kwargs: Any,
 ) -> Table:
     """Read a stream produced by a :class:`ConnectorSubject`.
@@ -127,7 +128,9 @@ def read(
     ``recovery_policy`` (a
     :class:`~pathway_tpu.internals.resilience.ConnectorRecoveryPolicy`)
     opts the source into supervised restart with backoff; without one a
-    reader failure closes the stream after a single attempt."""
+    reader failure closes the stream after a single attempt.
+    ``on_overflow`` picks this source's full-ingest-buffer behaviour
+    (``"pause"``/``"shed_oldest"``/``"fail"``)."""
     adapter = _SubjectAdapter(subject, schema)
     upsert = bool(schema.primary_key_columns())
     return input_table(
@@ -137,4 +140,5 @@ def read(
         upsert=upsert,
         persistent_id=persistent_id,
         recovery_policy=recovery_policy,
+        on_overflow=on_overflow,
     )

@@ -81,6 +81,26 @@ def serving_probe() -> Any:
         return _probe
 
 
+def push_pressure(source: str, level: float) -> None:
+    """Propagate an engine pressure signal (0..1) to every live
+    :class:`~pathway_tpu.serving.admission.AdmissionController` — the
+    brownout actuator.  Called by the scheduler's epoch loop; safe with
+    no controllers live (no-op)."""
+    with _registry_lock:
+        admissions = list(_admissions)
+        schedulers = list(_schedulers)
+    for a in admissions:
+        try:
+            a.set_pressure(source, level)
+        except Exception:
+            pass  # one controller's failure must not starve the rest
+    for s in schedulers:
+        try:
+            s.set_pressure(level)
+        except Exception:
+            pass
+
+
 def serving_snapshot() -> dict[str, Any]:
     """Aggregate snapshot across every live serving component: admission
     counters per tenant class, scheduler lane/class stats, co-scheduler
@@ -95,6 +115,8 @@ def serving_snapshot() -> dict[str, Any]:
     admitted: dict[str, int] = {}
     shed: dict[str, int] = {}
     inflight: dict[str, int] = {}
+    brownout_shed: dict[str, int] = {}
+    pressure_level = 0.0
     for a in admissions:
         s = a.stats()
         for cls, n in s.get("admitted_total", {}).items():
@@ -103,12 +125,18 @@ def serving_snapshot() -> dict[str, Any]:
             shed[cls] = shed.get(cls, 0) + n
         for cls, n in s.get("inflight", {}).items():
             inflight[cls] = inflight.get(cls, 0) + n
+        pr = s.get("pressure", {})
+        pressure_level = max(pressure_level, pr.get("level", 0.0))
+        for cls, n in pr.get("brownout_shed_total", {}).items():
+            brownout_shed[cls] = brownout_shed.get(cls, 0) + n
     out: dict[str, Any] = {}
     if admissions:
         out["admission"] = {
             "admitted_total": admitted,
             "shed_total": shed,
             "inflight": inflight,
+            "pressure_level": pressure_level,
+            "brownout_shed_total": brownout_shed,
         }
     if schedulers:
         out["schedulers"] = [s.stats() for s in schedulers]

@@ -18,6 +18,15 @@ and every release notifies the shared :class:`WakeupHub` so a parked
 :meth:`wait_admit` re-checks immediately — all waits on the admission
 path are finite generation-waits, never unbounded blocks (lint LK006,
 ``scripts/check_locks.py``).
+
+**Brownout mode**: the engine pushes its pressure level (ingest-buffer
+occupancy, exchange credit backlog) via :meth:`set_pressure`.  Under
+pressure the controller tightens each class's effective token rate by a
+weight-graded power law — best-effort classes collapse first, the
+interactive class degrades last — and computes ``Retry-After`` from the
+*measured* drain rate (EWMA of ticket-release gaps) instead of the
+configured rate, so clients back off proportionally to how slow the
+system actually is.
 """
 
 from __future__ import annotations
@@ -145,9 +154,68 @@ class AdmissionController:
         self._inflight: dict[str, int] = {}
         self.admitted_total: dict[str, int] = {}
         self.shed_total: dict[str, int] = {}
+        #: brownout inputs: pressure level in [0, 1] per source (e.g.
+        #: "engine"); the effective level is the max across sources
+        self._pressure: dict[str, float] = {}
+        #: sheds attributable to brownout (also counted in shed_total)
+        self.brownout_shed_total: dict[str, int] = {}
+        #: EWMA of ticket-release gaps (seconds) — the measured service
+        #: time brownout Retry-After is derived from
+        self._drain_ewma_s: float | None = None
+        self._last_release_t: float | None = None
         from pathway_tpu import serving as _serving
 
         _serving._register_admission(self)
+
+    # ------------------------------------------------------------- brownout
+
+    def set_pressure(self, source: str, level: float) -> None:
+        """Record a pressure signal in [0, 1]; ``level <= 0`` clears the
+        source.  Notifies the hub so parked ``wait_admit`` calls re-check
+        (pressure easing may admit them; pressure rising re-derives their
+        shed verdict)."""
+        level = min(1.0, float(level))
+        with self._lock:
+            if level <= 0.0:
+                if self._pressure.pop(source, None) is None:
+                    return
+            else:
+                self._pressure[source] = level
+            # re-arm every bucket: effective rates change with pressure
+            self._buckets.clear()
+        self.hub.notify()
+
+    def pressure_level(self) -> float:
+        with self._lock:
+            return max(self._pressure.values(), default=0.0)
+
+    def _brownout_mult_locked(self, pol: TenantPolicy) -> float:
+        """Rate multiplier in [0, 1] for this policy under the current
+        pressure.  Weight-graded power law: with headroom ``h = 1 -
+        level``, a class keeps ``h ** (w_max / w)`` of its rate — the
+        heaviest class degrades linearly while lighter (best-effort)
+        classes collapse polynomially faster, freeing the drain for
+        interactive traffic."""
+        if not self._pressure:
+            return 1.0
+        level = max(self._pressure.values())
+        if level >= 1.0:
+            return 0.0
+        w_max = max(
+            [p.weight for p in self._policies.values()]
+            + [self._default.weight]
+            + list(DEFAULT_CLASS_WEIGHTS.values())
+        )
+        return (1.0 - level) ** (w_max / max(pol.weight, 0.001))
+
+    def _brownout_retry_after_locked(self) -> float:
+        """Retry-After from the measured drain rate: roughly the time to
+        drain everything currently in flight, clamped to [0.05, 30]."""
+        ewma = self._drain_ewma_s
+        if ewma is None:
+            ewma = 0.1  # no releases observed yet: conservative default
+        backlog = sum(self._inflight.values()) + 1
+        return min(max(backlog * ewma, 0.05), 30.0)
 
     # ------------------------------------------------------------- policies
 
@@ -167,16 +235,29 @@ class AdmissionController:
     ) -> tuple[AdmissionTicket | None, float, str]:
         """(ticket, retry_after_s, reason); ticket None means shed."""
         pol = self._policies.get(tenant, self._default)
+        mult = self._brownout_mult_locked(pol)
+        if mult < 0.05:
+            # this class's share has collapsed: shed outright, with a
+            # Retry-After derived from the measured drain rate
+            return None, self._brownout_retry_after_locked(), "brownout"
         bucket = self._buckets.get(tenant)
         if bucket is None:
             bucket = self._buckets[tenant] = _TokenBucket(
-                pol.rate_per_s, pol.burst, now
+                pol.rate_per_s * mult, pol.burst, now
             )
         inflight = self._inflight.get(tenant, 0)
         if inflight >= pol.queue_cap:
             # ETA heuristic: one service turn at the tenant's rate
             return None, max(1.0 / pol.rate_per_s, 0.05), "tenant queue full"
         if not bucket.take(now):
+            if mult < 1.0:
+                # browned-out rate limit: back off at the DRAIN rate, not
+                # the configured token rate the class no longer gets
+                return (
+                    None,
+                    max(bucket.eta(now), self._brownout_retry_after_locked()),
+                    "brownout rate limited",
+                )
             return None, max(bucket.eta(now), 0.01), "rate limited"
         self._inflight[tenant] = inflight + 1
         cls = pol.tenant_class
@@ -191,6 +272,10 @@ class AdmissionController:
             if ticket is None:
                 cls = self._policies.get(tenant, self._default).tenant_class
                 self.shed_total[cls] = self.shed_total.get(cls, 0) + 1
+                if reason.startswith("brownout"):
+                    self.brownout_shed_total[cls] = (
+                        self.brownout_shed_total.get(cls, 0) + 1
+                    )
         if ticket is None:
             suffix = f" ({route})" if route else ""
             raise _retry_later(retry_after, f"{reason}: tenant {tenant!r}{suffix}")
@@ -224,12 +309,23 @@ class AdmissionController:
             self.hub.wait(seen, min(remaining, 0.05))
 
     def _release(self, tenant: str) -> None:
+        now = self._clock()
         with self._lock:
             n = self._inflight.get(tenant, 0)
             if n > 1:
                 self._inflight[tenant] = n - 1
             else:
                 self._inflight.pop(tenant, None)
+            # drain-rate EWMA over release gaps (capped: an idle stretch
+            # is not a slow drain) — feeds brownout Retry-After
+            last = self._last_release_t
+            self._last_release_t = now
+            if last is not None:
+                gap = min(max(now - last, 0.0), 5.0)
+                ewma = self._drain_ewma_s
+                self._drain_ewma_s = (
+                    gap if ewma is None else 0.8 * ewma + 0.2 * gap
+                )
         self.hub.notify()
 
     # -------------------------------------------------------------- metrics
@@ -245,4 +341,10 @@ class AdmissionController:
                 "shed_total": dict(self.shed_total),
                 "inflight": inflight_by_class,
                 "tenants": len(self._policies),
+                "pressure": {
+                    "level": max(self._pressure.values(), default=0.0),
+                    "sources": dict(self._pressure),
+                    "brownout_shed_total": dict(self.brownout_shed_total),
+                    "drain_s": self._drain_ewma_s,
+                },
             }

@@ -124,7 +124,7 @@ class StageCoScheduler:
         self._idle_wait_s = idle_wait_s
         self.scheduler = scheduler if scheduler is not None else SloScheduler(probe=probe)
         self.hub = self.scheduler.hub
-        self._gen_q: deque[_Req] = deque()
+        self._gen_q: deque[_Req] = deque()  # lk009: capped at gen_queue_cap
         self._gen_lock = threading.Lock()
         self._stop = threading.Event()
         # lookahead accounting: how often the probe was already in

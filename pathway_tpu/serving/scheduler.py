@@ -123,6 +123,10 @@ class SloScheduler:
         self._lane_args: dict[str, dict] = {}
         self._submitted = 0
         self._completed = 0
+        #: brownout pressure in [0, 1]: under pressure, lighter classes
+        #: accrue virtual time faster (see submit), deferring batch work
+        #: behind interactive work harder than steady-state WFQ does
+        self._pressure = 0.0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=name
@@ -176,6 +180,15 @@ class SloScheduler:
         fut: Future = Future()
         now_ns = time.monotonic_ns()
         weight = self._class_weights.get(tenant_class, 1.0)
+        pressure = self._pressure
+        if pressure > 0.0:
+            # brownout: stretch the weight spread — the heaviest class
+            # keeps its share, lighter ones fall behind proportionally
+            # harder, so interactive queue-wait holds while batch defers
+            w_max = max(self._class_weights.values(), default=1.0)
+            weight = weight / (
+                1.0 + pressure * (w_max / max(weight, 1e-9) - 1.0)
+            )
         with self._lock:
             if self._stop.is_set():
                 raise RuntimeError("scheduler closed")
@@ -348,7 +361,13 @@ class SloScheduler:
                 "classes": classes,
                 "submitted": self._submitted,
                 "completed": self._completed,
+                "pressure": self._pressure,
             }
+
+    def set_pressure(self, level: float) -> None:
+        """Brownout input (see :meth:`submit`); clamped to [0, 1]."""
+        self._pressure = min(1.0, max(0.0, float(level)))
+        self.hub.notify()
 
     def close(self, timeout: float = 5.0) -> None:
         self._stop.set()

@@ -48,6 +48,22 @@ misdiagnose):
 - :meth:`chaos.slow_peer` — every outbound transmission from one rank is
   slowed (seeded jitter): a degraded-but-alive peer that drags epochs
   without ever missing a liveness deadline.
+Overload primitives (drive ``tests/test_overload.py`` and
+``bench.py bench_overload`` — sustained pressure rather than failure):
+
+- :meth:`chaos.firehose_source` — a seedable synthetic source pushing
+  rows at a target rate (or flat-out); when the ingest credit buffer
+  fills, its ``next()`` calls park inside the connector queue's
+  ``charge`` — the backpressure path under test.
+- :meth:`chaos.stall_sink` — every sink delivery
+  (``OutputNode.process``) with data sleeps: a wedged downstream
+  writer.  Sinks are synchronous with the epoch cut, so the stall
+  holds the drain loop and pressure propagates back to the sources.
+- :meth:`chaos.slow_consumer` — one worker rank's epochs take
+  ``factor``× their real time: a degraded-but-alive *consumer* whose
+  exchange mailboxes back up, exercising sender-side credit
+  (``PATHWAY_EXCHANGE_CREDIT_BYTES``) instead of liveness isolation.
+
 - :class:`ClusterDrill` — seedable end-to-end drill: run a wordcount
   cluster fault-free, re-run it with a worker killed at a random epoch
   under :class:`~pathway_tpu.internals.resilience.ClusterSupervisor`,
@@ -529,6 +545,126 @@ class chaos:
         self.delay_exchange_frames(
             delay_s=delay_s, jitter_s=jitter_s, process_id=process_id
         )
+
+    # -- overload primitives ---------------------------------------------
+    def stall_sink(
+        self,
+        seconds: float,
+        limit: int | None = None,
+        name: str | None = None,
+    ) -> None:
+        """Every sink delivery that carries data sleeps ``seconds`` — a
+        wedged downstream writer (full disk, throttled API, dead
+        consumer).  Patches :meth:`OutputNode.process`, the synchronous
+        sink dispatch: the stall holds the epoch cut, the drain loop
+        stops taking from the connector queues, the ingest credit buffer
+        fills, and the readers park — end-to-end pressure propagation
+        with zero data loss under ``on_overflow="pause"``.
+
+        ``limit`` bounds how many deliveries stall (then the sink
+        recovers); ``name`` scopes the fault to sinks whose node name
+        contains it (default: every sink)."""
+        from pathway_tpu.engine.graph import OutputNode
+
+        orig = OutputNode.process
+        key = self._counter_key(OutputNode, "process")
+
+        @functools.wraps(orig)
+        def wrapper(node: Any, ctx: Any, time: int, inbatches: Any) -> Any:
+            count = self._bump(key)
+            mine = name is None or name in getattr(node, "name", "")
+            if mine and inbatches and inbatches[0]:
+                if limit is None or count <= limit:
+                    _time.sleep(seconds)
+            return orig(node, ctx, time, inbatches)
+
+        self._patch(OutputNode, "process", wrapper)
+
+    def firehose_source(
+        self,
+        rows_per_sec: float | None,
+        total_rows: int,
+        vocab: int = 32,
+        payload_bytes: int = 64,
+        commit_every: int = 64,
+        row_factory: Callable[[random.Random, int], dict] | None = None,
+    ) -> Any:
+        """A seedable synthetic source pushing ``total_rows`` rows at
+        ``rows_per_sec`` (``None`` or ``<= 0``: flat-out, the true
+        firehose).  Returns a :class:`~pathway_tpu.io.python.ConnectorSubject`
+        for ``pw.io.python.read``; default rows are
+        ``{"word": "w<k>", "payload": "<payload_bytes of x>"}`` with the
+        word drawn from a per-source seeded RNG, or supply
+        ``row_factory(rng, i)`` for a custom shape.
+
+        When the source outruns the pipeline and the ingest credit
+        buffer (``PATHWAY_INGEST_BUFFER_BYTES``) fills, ``next()`` parks
+        inside the connector queue's byte accounting — the reader slows
+        to the drain rate instead of growing RSS.  Cuts an epoch every
+        ``commit_every`` rows and polls ``stopped`` so shutdown is
+        prompt even mid-burst."""
+        from pathway_tpu.io.python import ConnectorSubject
+
+        rng = random.Random(self.rng.randrange(2**31))
+        interval = (
+            1.0 / rows_per_sec if rows_per_sec and rows_per_sec > 0 else 0.0
+        )
+
+        class _Firehose(ConnectorSubject):
+            def run(subject) -> None:
+                start = _time.monotonic()
+                for i in range(total_rows):
+                    if subject.stopped:
+                        return
+                    if row_factory is not None:
+                        subject.next(**row_factory(rng, i))
+                    else:
+                        subject.next(
+                            word=f"w{rng.randrange(vocab)}",
+                            payload="x" * payload_bytes,
+                        )
+                    if (i + 1) % commit_every == 0:
+                        subject.commit()
+                    if interval:
+                        # pace against the wall clock, not per-row sleeps:
+                        # a backpressure pause already "paid" the wait
+                        lag = start + (i + 1) * interval - _time.monotonic()
+                        if lag > 0:
+                            _time.sleep(lag)
+                subject.commit()
+
+        return _Firehose(datasource_name="firehose")
+
+    def slow_consumer(self, rank: int, factor: float = 3.0) -> None:
+        """Worker ``rank``'s epochs take ``factor``× their real time
+        (each :meth:`Scheduler.run_epoch` is followed by a sleep of
+        ``elapsed * (factor - 1)``) — a degraded-but-alive *consumer*:
+        it keeps heartbeating and acking rounds, but drains its exchange
+        mailboxes slowly, so producers sending to it back up against the
+        sender-side credit cap (``PATHWAY_EXCHANGE_CREDIT_BYTES``) and
+        throttle instead of buffering without bound.  The slow-vs-dead
+        distinction under test: this rank must be *backpressured*, never
+        isolated."""
+        if factor < 1.0:
+            raise ValueError(f"factor must be >= 1.0, got {factor}")
+        from pathway_tpu.engine.scheduler import Scheduler
+
+        orig = Scheduler.run_epoch
+        key = self._counter_key(Scheduler, "run_epoch")
+
+        @functools.wraps(orig)
+        def wrapper(sched: Any, time: int, inject: Any, **kwargs: Any) -> Any:
+            self._bump(key)
+            ctx = kwargs.get("ctx") or sched.ctx
+            if getattr(ctx, "worker_id", 0) != rank:
+                return orig(sched, time, inject, **kwargs)
+            t0 = _time.monotonic()
+            try:
+                return orig(sched, time, inject, **kwargs)
+            finally:
+                _time.sleep((_time.monotonic() - t0) * (factor - 1.0))
+
+        self._patch(Scheduler, "run_epoch", wrapper)
 
 
 class _ResumeOnRestore:

@@ -49,6 +49,17 @@ AST-based checks over ``engine/cluster.py`` and ``engine/scheduler.py``
   *memo*, *history*, *dedup*) with inserts but no eviction.  Either one
   is operator state that grows with the stream — the runtime
   counterpart of the analyzer's PW-M001.
+- **LK009** — backpressure discipline in producer-consumer paths: in
+  files under ``engine/``, ``io/``, or ``serving/`` (override with
+  ``pressure_path=``) every ``queue.Queue()`` / ``deque()`` constructed
+  without ``maxsize``/``maxlen`` is flagged at its assignment site —
+  an unbounded handoff queue is a backpressure hole: the producer
+  never feels a slow consumer, memory does.  Unlike LK008 this fires
+  even when the queue *is* drained (a drained-but-unbounded queue
+  still grows whenever the producer outruns the consumer).  Queues
+  whose bound lives elsewhere (byte-credit accounting, an epoch
+  budget) are allowlisted with an ``# lk009: <why it is bounded>``
+  comment on the construction line.
 - **LK006** — serving-path wait discipline: in files under ``serving/``
   (override with ``serving_path=``) every queue handoff must ride the
   WakeupHub and every admission-path wait must be finite.  Flags bare
@@ -580,6 +591,47 @@ def _check_unbounded_growth(
                 )
 
 
+def _check_pressure_queues(
+    tree: ast.AST, source: str, filename: str, findings: list[Finding]
+) -> None:
+    """LK009: unbounded handoff queues in producer-consumer paths.
+
+    Every ``queue.Queue()`` / ``deque()`` constructed without
+    ``maxsize``/``maxlen`` and assigned (instance member or local) is a
+    backpressure hole — a producer that outruns its consumer grows the
+    queue instead of slowing down.  Fires regardless of drain analysis
+    (that is LK008's axis: accumulation with *no* consumer); the remedy
+    here is a bound — ``maxsize``/``maxlen``, or an external accounting
+    scheme declared on the construction line with an ``# lk009:``
+    comment (the allowlist marker doubles as documentation of where the
+    bound actually lives)."""
+    lines = source.splitlines()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value = node.value
+        else:
+            continue
+        if _unbounded_container(value) != "queue":
+            continue
+        line_src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if "lk009:" in line_src:
+            continue  # allowlisted: the bound lives elsewhere (documented)
+        findings.append(
+            Finding(
+                filename,
+                node.lineno,
+                "LK009",
+                "unbounded handoff queue in a producer-consumer path; "
+                "a producer that outruns its consumer grows memory "
+                "instead of slowing down — pass maxsize/maxlen, or "
+                "document the external bound with an '# lk009: ...' "
+                "comment on this line",
+            )
+        )
+
+
 def check_source(
     source: str,
     filename: str,
@@ -587,17 +639,28 @@ def check_source(
     scheduler_path: bool | None = None,
     cluster_path: bool | None = None,
     serving_path: bool | None = None,
+    pressure_path: bool | None = None,
 ) -> list[Finding]:
     """Lint one file's source.  ``scheduler_path`` controls LK003
     (default: filename contains 'scheduler'); ``cluster_path`` controls
     LK005 (default: filename contains 'cluster'); ``serving_path``
-    controls LK006 (default: the path contains 'serving')."""
+    controls LK006 (default: the path contains 'serving');
+    ``pressure_path`` controls LK009 (default: the path contains an
+    ``engine/``, ``io/``, or ``serving/`` segment)."""
     findings: list[Finding] = []
     tree = ast.parse(source, filename=filename)
 
     _FunctionScanner(filename, findings).visit(tree)
     _check_notify_discipline(tree, filename, findings)
     _check_unbounded_growth(tree, filename, findings)
+
+    if pressure_path is None:
+        p = "/" + filename.replace(os.sep, "/").lstrip("/")
+        pressure_path = any(
+            seg in p for seg in ("/engine/", "/io/", "/serving/")
+        )
+    if pressure_path:
+        _check_pressure_queues(tree, source, filename, findings)
 
     if cluster_path is None:
         cluster_path = "cluster" in os.path.basename(filename)

@@ -392,6 +392,94 @@ def test_lk008_non_cache_named_dict_ignored(cl):
     assert cl.check_source(src, "x.py") == []
 
 
+def test_lk009_drained_but_unbounded_deque_flagged(cl):
+    # drained ⇒ LK008 stays quiet, but the queue is still a backpressure
+    # hole in an engine path: the producer never feels a slow consumer
+    src = (
+        "from collections import deque\n"
+        "class Pipe:\n"
+        "    def __init__(self):\n"
+        "        self._q = deque()\n"
+        "    def feed(self, item):\n"
+        "        self._q.append(item)\n"
+        "    def drain(self):\n"
+        "        while self._q:\n"
+        "            yield self._q.popleft()\n"
+    )
+    findings = cl.check_source(src, "pathway_tpu/engine/x.py")
+    assert [f.code for f in findings] == ["LK009"]
+    assert "maxsize/maxlen" in findings[0].message
+
+
+def test_lk009_local_handoff_queue_flagged(cl):
+    # local (non-self) producer-consumer queues count too — LK008 is
+    # class-member-scoped, LK009 is not
+    src = (
+        "import queue\n"
+        "def pump(rows):\n"
+        "    q = queue.Queue()\n"
+        "    for r in rows:\n"
+        "        q.put(r)\n"
+        "    while not q.empty():\n"
+        "        yield q.get()\n"
+    )
+    findings = cl.check_source(src, "pathway_tpu/io/x.py")
+    assert [f.code for f in findings] == ["LK009"]
+
+
+def test_lk009_bounded_queue_clean(cl):
+    src = (
+        "import queue\n"
+        "from collections import deque\n"
+        "class Pipe:\n"
+        "    def __init__(self):\n"
+        "        self._q = queue.Queue(maxsize=1024)\n"
+        "        self._d = deque(maxlen=64)\n"
+        "    def feed(self, item):\n"
+        "        self._q.put(item)\n"
+        "        self._d.append(item)\n"
+        "    def drain(self):\n"
+        "        self._d.clear()\n"
+        "        return self._q.get(timeout=1.0)\n"
+    )
+    assert cl.check_source(src, "pathway_tpu/serving/x.py") == []
+
+
+def test_lk009_allowlist_comment_clean(cl):
+    # the external-bound confession on the construction line allowlists
+    # it — the marker doubles as documentation of where the bound lives
+    src = (
+        "from collections import deque\n"
+        "class Pipe:\n"
+        "    def __init__(self):\n"
+        "        self._q = deque()  # lk009: bytes-bounded by credit accounting\n"
+        "    def feed(self, item):\n"
+        "        self._q.append(item)\n"
+        "    def drain(self):\n"
+        "        return self._q.popleft()\n"
+    )
+    assert cl.check_source(src, "pathway_tpu/engine/x.py") == []
+
+
+def test_lk009_outside_pressure_paths_clean(cl):
+    # same source, non-producer-consumer path: LK009 does not apply
+    # (the drained queue also satisfies LK008)
+    src = (
+        "from collections import deque\n"
+        "class Pipe:\n"
+        "    def __init__(self):\n"
+        "        self._q = deque()\n"
+        "    def feed(self, item):\n"
+        "        self._q.append(item)\n"
+        "    def drain(self):\n"
+        "        return self._q.popleft()\n"
+    )
+    assert cl.check_source(src, "pathway_tpu/internals/x.py") == []
+    assert cl.check_source(
+        src, "pathway_tpu/engine/x.py", pressure_path=False
+    ) == []
+
+
 _LK007_CYCLE = (
     "import threading\n"
     "class Store:\n"

@@ -816,3 +816,48 @@ def test_py_object_wrapper_through_pipeline():
     # custom serializer is honored
     w2 = pw.wrap_py_object(Blob("q"), serializer=_BlobSer)
     assert pickle.loads(pickle.dumps(w2)).value.tag == "q!"
+
+
+def test_markdown_stream_replay_is_deterministic_across_tables():
+    """Two ``__time__`` markdown tables replay on separate reader
+    threads; the shared replay clock must serialize their batches into
+    one deterministic epoch schedule (ascending time, construction order
+    within a time) — without it, which epoch a row lands in is a thread
+    race and any cross-table time assertion flakes."""
+    from tests.utils import run_tables
+
+    def one_run() -> list[tuple]:
+        pw.G.clear()
+        left = T(
+            """
+            a | __time__ | __diff__
+            1 | 2        | 1
+            2 | 4        | 1
+            """
+        )
+        right = T(
+            """
+            b | __time__ | __diff__
+            9 | 2        | 1
+            8 | 6        | 1
+            """
+        )
+        (_, ls), (_, rs) = run_tables(left, right)
+        return sorted(
+            (tag, vals, time, diff)
+            for tag, stream in (("l", ls), ("r", rs))
+            for _k, vals, time, diff in stream
+        )
+
+    first = one_run()
+    assert first, "replay emitted nothing"
+    # the serialized schedule: left@2, right@2, left@4, right@6 — each
+    # batch its own epoch, so the four epochs are 0, 2, 4, 6
+    assert sorted((tag, time) for tag, _v, time, _d in first) == [
+        ("l", 0),
+        ("l", 4),
+        ("r", 2),
+        ("r", 6),
+    ]
+    for _ in range(4):
+        assert one_run() == first
