@@ -584,9 +584,14 @@ def bench_wordcount_multiprocess(extra: dict) -> None:
     n_cores = os.cpu_count() or 1
     extra["host_cpu_cores"] = n_cores
     log(f"wordcount multiprocess: {WC_LINES} lines, host has {n_cores} core(s)")
-    keys = {1: "wordcount_1proc", 2: "wordcount_multiprocess", 4: "wordcount_4proc"}
+    keys = {
+        1: "wordcount_1proc",
+        2: "wordcount_multiprocess",
+        4: "wordcount_4proc",
+        8: "wordcount_8proc",
+    }
     cpu_by_n: dict[int, float] = {}
-    for n_procs in (1, 2) if SMOKE else (1, 2, 4):
+    for n_procs in (1, 2) if SMOKE else (1, 2, 4, 8):
         dt, cpu, xstats = _run_wc_cluster(n_procs, fp, d)
         rps = WC_LINES / dt
         cpu_by_n[n_procs] = cpu
@@ -609,12 +614,167 @@ def bench_wordcount_multiprocess(extra: dict) -> None:
                 k: round(v, 1) if isinstance(v, float) else v
                 for k, v in xstats.items()
             }
-    for n in (2, 4):
+    for n in (2, 4, 8):
         if n in cpu_by_n and cpu_by_n[n] > 0:
             extra[f"wordcount_cpu_normalized_efficiency_{n}proc"] = round(
                 cpu_by_n[1] / cpu_by_n[n], 3
             )
     extra["wordcount_multiprocess_n_procs"] = 2
+
+
+def bench_columnar(extra: dict) -> None:
+    """Columnar-vs-row differential on the SAME wordcount corpus, plus
+    the zero-copy exchange before/after — the evidence artifact for the
+    batch-execution work (``BENCH_columnar.json``).
+
+    Four measurements:
+
+    - single-core wordcount at optimize=2 with frames (default) and with
+      ``PATHWAY_DISABLE_COLUMNAR=1`` (row path) — the kernel speedup;
+    - ``columnar_rows`` path attribution from the run context (how many
+      rows actually took the fast path);
+    - 2-process cluster exchange stats row vs columnar — per-stage
+      pack/send/unpack milliseconds and the string-pool hit rate of the
+      ``_K_FRAME`` wire format;
+    - the cluster scaling numbers (1/2/4/8-proc rows/s and
+      CPU-normalized efficiency) copied from the multiprocess section.
+
+    ``--smoke`` gates that the columnar path is no slower than the row
+    path it replaces."""
+    import pathway_tpu as pw
+    from pathway_tpu.internals.parse_graph import G
+
+    d = tempfile.mkdtemp(prefix="pw_bench_col_")
+    fp = _write_wc_input(d)
+
+    def _run_single(disable: bool) -> tuple[float, dict]:
+        saved = os.environ.pop("PATHWAY_DISABLE_COLUMNAR", None)
+        if disable:
+            os.environ["PATHWAY_DISABLE_COLUMNAR"] = "1"
+        try:
+            G.clear()
+            t0 = time.perf_counter()
+            cap = _wc_graph(pw, fp)
+            ctx = pw.run(optimize=2)
+            dt = time.perf_counter() - t0
+            rows = ctx.state(cap)["rows"]
+            total = sum(v[1] for v in rows.values())
+            assert total == WC_LINES, f"lost rows: {total} != {WC_LINES}"
+            return WC_LINES / dt, dict(ctx.stats.get("columnar_rows", {}))
+        finally:
+            if saved is None:
+                os.environ.pop("PATHWAY_DISABLE_COLUMNAR", None)
+            else:
+                os.environ["PATHWAY_DISABLE_COLUMNAR"] = saved
+
+    rps_row, colrows_row = _run_single(disable=True)
+    rps_col, colrows_col = _run_single(disable=False)
+    speedup = rps_col / rps_row if rps_row > 0 else 0.0
+    log(
+        f"columnar wordcount: {rps_col:.0f} rows/s columnar vs "
+        f"{rps_row:.0f} rows/s row path ({speedup:.2f}x), "
+        f"path attribution {colrows_col}"
+    )
+
+    # exchange before/after: the same 2-proc cluster, row wire format
+    # (PATHWAY_DISABLE_COLUMNAR=1 → _K_UPDATES) vs columnar (_K_FRAME)
+    os.environ["PATHWAY_DISABLE_COLUMNAR"] = "1"
+    try:
+        dt2_row, cpu2_row, xstats_row = _run_wc_cluster(2, fp, d)
+    finally:
+        os.environ.pop("PATHWAY_DISABLE_COLUMNAR", None)
+    dt2_col, cpu2_col, xstats_col = _run_wc_cluster(2, fp, d)
+
+    def _overhead(xstats: dict, cpu: float) -> float:
+        busy = sum(xstats.get(k, 0.0) for k in ("pack_ms", "send_ms", "unpack_ms"))
+        return busy / (cpu * 1000.0) * 100.0 if cpu > 0 else 0.0
+
+    ov_row, ov_col = _overhead(xstats_row, cpu2_row), _overhead(xstats_col, cpu2_col)
+    pool_hits = xstats_col.get("strpool_hits", 0)
+    pool_misses = xstats_col.get("strpool_misses", 0)
+    pool_rate = (
+        pool_hits / (pool_hits + pool_misses) if pool_hits + pool_misses else 0.0
+    )
+    log(
+        f"columnar exchange 2-proc: {WC_LINES / dt2_col:.0f} rows/s "
+        f"(overhead {ov_col:.1f}% vs row-wire {ov_row:.1f}%), "
+        f"string pool hit rate {pool_rate:.0%}"
+    )
+
+    extra["columnar_rows_per_sec"] = round(rps_col)
+    extra["columnar_row_path_rows_per_sec"] = round(rps_row)
+    extra["columnar_speedup_single_core"] = round(speedup, 2)
+    extra["columnar_exchange_overhead_pct"] = round(ov_col, 2)
+    extra["columnar_strpool_hit_rate"] = round(pool_rate, 3)
+
+    def _round(xs: dict) -> dict:
+        return {
+            k: round(v, 1) if isinstance(v, float) else v for k, v in xs.items()
+        }
+
+    cluster_keys = (
+        "wordcount_1proc_rows_per_sec",
+        "wordcount_multiprocess_rows_per_sec",
+        "wordcount_4proc_rows_per_sec",
+        "wordcount_8proc_rows_per_sec",
+        "wordcount_cpu_normalized_efficiency_2proc",
+        "wordcount_cpu_normalized_efficiency_4proc",
+        "wordcount_cpu_normalized_efficiency_8proc",
+        "wordcount_exchange_overhead_pct",
+        "host_cpu_cores",
+    )
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_columnar.json"
+    )
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "cmd": "JAX_PLATFORMS=cpu python bench.py (bench_columnar)",
+                "config": {
+                    "wc_lines": WC_LINES,
+                    "wc_words": WC_WORDS,
+                    "optimize": 2,
+                    "smoke": SMOKE,
+                },
+                "single_core": {
+                    "wordcount_rows_per_sec": round(rps_col),
+                    "wordcount_rows_per_sec_row_path": round(rps_row),
+                    "columnar_speedup": round(speedup, 2),
+                    "columnar_rows": colrows_col,
+                    "columnar_rows_row_path": colrows_row,
+                },
+                "exchange_2proc": {
+                    "row_wire": {
+                        "rows_per_sec": round(WC_LINES / dt2_row),
+                        "worker_cpu_seconds": round(cpu2_row, 2),
+                        "overhead_pct": round(ov_row, 2),
+                        "stats": _round(xstats_row),
+                    },
+                    "columnar_wire": {
+                        "rows_per_sec": round(WC_LINES / dt2_col),
+                        "worker_cpu_seconds": round(cpu2_col, 2),
+                        "overhead_pct": round(ov_col, 2),
+                        "strpool_hit_rate": round(pool_rate, 3),
+                        "stats": _round(xstats_col),
+                    },
+                },
+                "cluster": {k: extra[k] for k in cluster_keys if k in extra},
+            },
+            f,
+            indent=2,
+            sort_keys=True,
+        )
+        f.write("\n")
+    log(f"wrote {out}")
+
+    if SMOKE:
+        assert rps_col >= rps_row, (
+            f"columnar path ({rps_col:.0f} rows/s) is slower than the row "
+            f"path it replaces ({rps_row:.0f} rows/s)"
+        )
+        assert colrows_col.get("columnar", 0) > 0, (
+            f"no rows took the columnar path at optimize=2: {colrows_col}"
+        )
 
 
 def bench_select(extra: dict) -> None:
@@ -2170,6 +2330,7 @@ def main() -> None:
     sections = [
         (bench_wordcount, "wordcount"),
         (bench_wordcount_multiprocess, "wordcount_multiprocess"),
+        (bench_columnar, "columnar"),
         (bench_select, "select"),
         (bench_strdt, "strdt"),
         (bench_streaming_latency, "streaming_latency"),

@@ -336,7 +336,9 @@ PyObject* py_hash_rows(PyObject*, PyObject* rows) {
 
 // Feed a small (64-bit) signed int exactly like the PyLong branch of
 // feed(): n = (bit_length + 8)//8 + 1 bytes, signed little-endian.
-inline void feed_small_int(Hasher& h, long long val) {
+// Templated over the sink for the same reason feed() is.
+template <typename Sink>
+inline void feed_small_int(Sink& h, long long val) {
     unsigned long long mag =
         val < 0 ? (unsigned long long)(-(val + 1)) + 1ULL
                 : (unsigned long long)val;
@@ -5982,7 +5984,1978 @@ PyObject* py_hist_snapshot(PyObject*, PyObject* args) {
         out[0], "p95_ns", out[1], "p99_ns", out[2]);
 }
 
+// --------------------------------------------------------------------------
+// columnar epoch frames
+//
+// A Frame is one epoch delta held as contiguous typed columns plus an
+// interned string pool — the role of the reference's batched
+// arrangements (Rust differential operates on sorted (data, time, diff)
+// batches, never on per-row boxed values).  Connectors build frames
+// straight from the input bytes (frame_parse_jsonl), operators fold them
+// with vectorized kernels (frame_groupby_partials, frame_route_split,
+// frame_project, frame_filter), and the exchange layer ships the column
+// buffers as one blob per (peer, slot) with a transmission-scoped string
+// pool (frame_pack / frame_unpack).  Any value outside the typed set
+// (nested tuples, ndarrays, ERROR sentinels, >64-bit ints) keeps the
+// whole batch on the row-at-a-time path: frames are an optimization of
+// REPRESENTATION only, every kernel is behaviour-identical to its row
+// counterpart and Unsupported/None means "caller falls back".
+//
+// Keys carry a LAZY representation: connector rows are keyed as
+// blake2b(prefix..., seq + offset) (see hash_prefix_ints), so a frame
+// can hold just the prefix hash STATE plus the int64 seqs — 8 bytes a
+// row instead of 16, and no per-row blake2b until something actually
+// needs the digests (positional groupby/route never does).
+
+enum FrameTag : uint8_t {
+    CF_I64 = 1,
+    CF_F64 = 2,
+    CF_STR = 3,   // u32 index into the frame string pool
+    CF_BOOL = 4,
+};
+
+struct FrameCol {
+    uint8_t tag = 0;
+    std::vector<int64_t> i64;
+    std::vector<double> f64;
+    std::vector<uint32_t> sidx;
+    std::vector<uint8_t> b8;
+    std::vector<uint8_t> valid;  // empty == every row valid (non-None)
+
+    bool is_valid(size_t i) const { return valid.empty() || valid[i] != 0; }
+    size_t length() const {
+        switch (tag) {
+            case CF_I64: return i64.size();
+            case CF_F64: return f64.size();
+            case CF_STR: return sidx.size();
+            case CF_BOOL: return b8.size();
+            default: return 0;
+        }
+    }
+    void reserve(size_t n) {
+        switch (tag) {
+            case CF_I64: i64.reserve(n); break;
+            case CF_F64: f64.reserve(n); break;
+            case CF_STR: sidx.reserve(n); break;
+            case CF_BOOL: b8.reserve(n); break;
+            default: break;
+        }
+    }
+    // append a None cell (data slot is a zero placeholder)
+    void push_null() {
+        size_t len = length();
+        if (valid.empty()) valid.assign(len, 1);
+        valid.push_back(0);
+        switch (tag) {
+            case CF_I64: i64.push_back(0); break;
+            case CF_F64: f64.push_back(0.0); break;
+            case CF_STR: sidx.push_back(0); break;
+            case CF_BOOL: b8.push_back(0); break;
+            default: break;
+        }
+    }
+    void push_valid_mark() {
+        if (!valid.empty()) valid.push_back(1);
+    }
+    void copy_cell_from(const FrameCol& src, size_t i) {
+        if (!src.is_valid(i)) {
+            push_null();
+            return;
+        }
+        switch (tag) {
+            case CF_I64: i64.push_back(src.i64[i]); break;
+            case CF_F64: f64.push_back(src.f64[i]); break;
+            case CF_STR: sidx.push_back(src.sidx[i]); break;
+            case CF_BOOL: b8.push_back(src.b8[i]); break;
+            default: break;
+        }
+        push_valid_mark();
+    }
+    size_t nbytes() const {
+        return i64.size() * 8 + f64.size() * 8 + sidx.size() * 4 +
+               b8.size() + valid.size();
+    }
+};
+
+struct Frame {
+    int64_t n_rows = 0;
+    std::vector<FrameCol> cols;
+    std::vector<PyObject*> pool;  // owned PyUnicode, deduplicated
+
+    bool keys_lazy = false;
+    std::vector<uint8_t> keyb;        // 16 * n_rows when !keys_lazy
+    pwnative::Blake2bState key_base;  // salted + prefix-fed when keys_lazy
+    int64_t key_offset = 0;
+    std::vector<int64_t> key_seqs;    // n_rows when keys_lazy
+
+    bool all_plus = true;
+    std::vector<int8_t> diffs;  // n_rows when !all_plus
+
+    ~Frame() {
+        for (PyObject* s : pool) Py_XDECREF(s);
+    }
+    long long diff_at(size_t i) const {
+        return all_plus ? 1 : (long long)diffs[i];
+    }
+    void key_digest(size_t i, uint8_t out[16]) const {
+        if (!keys_lazy) {
+            std::memcpy(out, keyb.data() + 16 * i, 16);
+            return;
+        }
+        Hasher h;
+        h.S = key_base;
+        feed_small_int(h, key_seqs[(size_t)i] + key_offset);
+        pwnative::blake2b_final(&h.S, out);
+    }
+    // force the digest representation (needed for key grouping/routing
+    // and for ordering-independent consumers of int keys)
+    void materialize_keys() {
+        if (!keys_lazy) return;
+        keyb.resize((size_t)n_rows * 16);
+        for (int64_t i = 0; i < n_rows; i++) {
+            Hasher h;
+            h.S = key_base;
+            feed_small_int(h, key_seqs[(size_t)i] + key_offset);
+            pwnative::blake2b_final(&h.S, keyb.data() + 16 * (size_t)i);
+        }
+        keys_lazy = false;
+        key_seqs.clear();
+        key_seqs.shrink_to_fit();
+    }
+    size_t nbytes() const {
+        size_t n = sizeof(Frame) + keyb.size() + key_seqs.size() * 8 +
+                   diffs.size();
+        for (const FrameCol& c : cols) n += c.nbytes();
+        for (PyObject* s : pool) {
+            Py_ssize_t sl;
+            // utf8 cache is populated for pool strings (built from utf8)
+            if (PyUnicode_AsUTF8AndSize(s, &sl) != nullptr)
+                n += (size_t)sl + 8;
+            else
+                PyErr_Clear();
+        }
+        return n;
+    }
+    // new empty frame shaped like this one (shared pool, same col tags,
+    // same key representation); used by slice/route_split/filter
+    Frame* like(bool share_pool = true) const {
+        Frame* f = new Frame();
+        f->cols.resize(cols.size());
+        for (size_t c = 0; c < cols.size(); c++) f->cols[c].tag = cols[c].tag;
+        if (share_pool) {
+            f->pool = pool;
+            for (PyObject* s : f->pool) Py_INCREF(s);
+        }
+        f->keys_lazy = keys_lazy;
+        f->key_base = key_base;
+        f->key_offset = key_offset;
+        f->all_plus = all_plus;
+        return f;
+    }
+    void append_row_from(const Frame& src, size_t i) {
+        for (size_t c = 0; c < cols.size(); c++)
+            cols[c].copy_cell_from(src.cols[c], i);
+        if (keys_lazy) {
+            key_seqs.push_back(src.key_seqs[i]);
+        } else {
+            keyb.insert(keyb.end(), src.keyb.begin() + 16 * i,
+                        src.keyb.begin() + 16 * (i + 1));
+        }
+        if (!all_plus) diffs.push_back(src.diffs[i]);
+        n_rows++;
+    }
+    // new ref or nullptr; cell must be valid
+    PyObject* cell_object(size_t c, size_t i) const {
+        const FrameCol& col = cols[c];
+        if (!col.is_valid(i)) Py_RETURN_NONE;
+        switch (col.tag) {
+            case CF_I64: return PyLong_FromLongLong(col.i64[i]);
+            case CF_F64: return PyFloat_FromDouble(col.f64[i]);
+            case CF_STR: {
+                PyObject* s = pool[col.sidx[i]];
+                Py_INCREF(s);
+                return s;
+            }
+            case CF_BOOL: return PyBool_FromLong(col.b8[i]);
+            default:
+                PyErr_SetString(g_unsupported, "bad column tag");
+                return nullptr;
+        }
+    }
+};
+
+const char kFrameCap[] = "pathway_tpu.frame";
+
+void frame_cap_free(PyObject* cap) {
+    delete static_cast<Frame*>(PyCapsule_GetPointer(cap, kFrameCap));
+}
+
+Frame* frame_arg(PyObject* cap) {
+    return static_cast<Frame*>(PyCapsule_GetPointer(cap, kFrameCap));
+}
+
+PyObject* frame_to_capsule(Frame* f) {
+    PyObject* cap = PyCapsule_New(f, kFrameCap, frame_cap_free);
+    if (cap == nullptr) delete f;
+    return cap;
+}
+
+// pool builder: dedup by utf8 bytes during frame construction
+struct FramePoolBuilder {
+    std::unordered_map<std::string, uint32_t> map;
+    // takes a NEW reference to store (steals on success)
+    int64_t intern(Frame* f, PyObject* str, const char* u8, size_t n) {
+        auto it = map.find(std::string(u8, n));
+        if (it != map.end()) {
+            Py_DECREF(str);
+            return (int64_t)it->second;
+        }
+        uint32_t idx = (uint32_t)f->pool.size();
+        if (idx == UINT32_MAX) {
+            Py_DECREF(str);
+            return -1;
+        }
+        f->pool.push_back(str);
+        map.emplace(std::string(u8, n), idx);
+        return (int64_t)idx;
+    }
+};
+
+PyObject* py_frame_len(PyObject*, PyObject* cap) {
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    return PyLong_FromLongLong(f->n_rows);
+}
+
+PyObject* py_frame_nbytes(PyObject*, PyObject* cap) {
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    return PyLong_FromSize_t(f->nbytes());
+}
+
+PyObject* py_frame_ncols(PyObject*, PyObject* cap) {
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    return PyLong_FromSize_t(f->cols.size());
+}
+
+PyObject* py_frame_all_plus(PyObject*, PyObject* cap) {
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    return PyBool_FromLong(f->all_plus ? 1 : 0);
+}
+
+PyObject* py_frame_from_updates(PyObject*, PyObject* batch) {
+    // strict columnarization of an update list: every value must be in
+    // the typed set and every column type-stable, else Unsupported (the
+    // caller keeps the row representation — NEVER a lossy conversion)
+    PyObject* seq =
+        PySequence_Fast(batch, "frame_from_updates expects a sequence");
+    if (seq == nullptr) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    std::unique_ptr<Frame> f(new Frame());
+    FramePoolBuilder pb;
+    Py_ssize_t ncols = -1;
+    bool unsupported = false;
+    for (Py_ssize_t i = 0; i < n && !unsupported; i++) {
+        PyObject* u = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(u) || PyTuple_GET_SIZE(u) != 3) {
+            Py_DECREF(seq);
+            PyErr_SetString(PyExc_TypeError, "updates must be 3-tuples");
+            return nullptr;
+        }
+        PyObject* key = PyTuple_GET_ITEM(u, 0);
+        PyObject* values = PyTuple_GET_ITEM(u, 1);
+        if (!PyTuple_CheckExact(values)) {
+            unsupported = true;
+            break;
+        }
+        if (ncols == -1) {
+            ncols = PyTuple_GET_SIZE(values);
+            f->cols.resize((size_t)ncols);
+            for (FrameCol& c : f->cols) c.reserve((size_t)n);
+            f->keyb.reserve((size_t)n * 16);
+        } else if (PyTuple_GET_SIZE(values) != ncols) {
+            unsupported = true;
+            break;
+        }
+        uint8_t kb[16];
+        if (!PyLong_Check(key) || pt_long_as_bytes_unsigned(key, kb, 16) < 0) {
+            PyErr_Clear();
+            unsupported = true;  // negative / >128-bit / non-int key
+            break;
+        }
+        long long d = PyLong_AsLongLong(PyTuple_GET_ITEM(u, 2));
+        if (d == -1 && PyErr_Occurred()) {
+            PyErr_Clear();
+            unsupported = true;
+            break;
+        }
+        if (d < INT8_MIN || d > INT8_MAX) {
+            unsupported = true;
+            break;
+        }
+        for (Py_ssize_t c = 0; c < ncols && !unsupported; c++) {
+            FrameCol& col = f->cols[(size_t)c];
+            PyObject* v = PyTuple_GET_ITEM(values, c);
+            if (v == Py_None) {
+                if (col.tag == 0) {
+                    // type still unknown: count as null, backfilled when
+                    // (if ever) the column discovers its type
+                    size_t len = col.valid.size();
+                    if (col.valid.empty() && i > 0)
+                        col.valid.assign((size_t)i, 0), len = (size_t)i;
+                    col.valid.push_back(0);
+                    (void)len;
+                    continue;
+                }
+                col.push_null();
+                continue;
+            }
+            uint8_t want;
+            if (PyBool_Check(v)) {
+                want = CF_BOOL;
+            } else if (g_pointer_type != nullptr &&
+                       PyObject_TypeCheck(
+                           v, reinterpret_cast<PyTypeObject*>(
+                                  g_pointer_type))) {
+                unsupported = true;  // Pointer cells lose identity
+                break;
+            } else if (PyLong_CheckExact(v)) {
+                want = CF_I64;
+            } else if (PyFloat_CheckExact(v)) {
+                want = CF_F64;
+            } else if (PyUnicode_CheckExact(v)) {
+                want = CF_STR;
+            } else {
+                unsupported = true;  // tuple/bytes/ndarray/ERROR/...
+                break;
+            }
+            if (col.tag == 0) {
+                // column discovers its type: backfill earlier nulls
+                col.tag = want;
+                size_t nulls = col.valid.size();
+                switch (want) {
+                    case CF_I64: col.i64.assign(nulls, 0); break;
+                    case CF_F64: col.f64.assign(nulls, 0.0); break;
+                    case CF_STR: col.sidx.assign(nulls, 0); break;
+                    case CF_BOOL: col.b8.assign(nulls, 0); break;
+                }
+            } else if (col.tag != want) {
+                unsupported = true;  // mixed column
+                break;
+            }
+            switch (want) {
+                case CF_I64: {
+                    int overflow = 0;
+                    long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+                    if (overflow != 0 || (x == -1 && PyErr_Occurred())) {
+                        PyErr_Clear();
+                        unsupported = true;
+                        break;
+                    }
+                    col.i64.push_back(x);
+                    break;
+                }
+                case CF_F64:
+                    col.f64.push_back(PyFloat_AS_DOUBLE(v));
+                    break;
+                case CF_STR: {
+                    Py_ssize_t sl;
+                    const char* s = PyUnicode_AsUTF8AndSize(v, &sl);
+                    if (s == nullptr) {
+                        PyErr_Clear();
+                        unsupported = true;
+                        break;
+                    }
+                    Py_INCREF(v);
+                    int64_t idx = pb.intern(f.get(), v, s, (size_t)sl);
+                    if (idx < 0) {
+                        unsupported = true;
+                        break;
+                    }
+                    col.sidx.push_back((uint32_t)idx);
+                    break;
+                }
+                case CF_BOOL:
+                    col.b8.push_back(v == Py_True ? 1 : 0);
+                    break;
+            }
+            if (!unsupported) col.push_valid_mark();
+        }
+        if (unsupported) break;
+        f->keyb.insert(f->keyb.end(), kb, kb + 16);
+        if (d != 1 && f->all_plus) {
+            f->all_plus = false;
+            f->diffs.assign((size_t)i, 1);
+        }
+        if (!f->all_plus) f->diffs.push_back((int8_t)d);
+        f->n_rows++;
+    }
+    Py_DECREF(seq);
+    if (unsupported) {
+        if (!PyErr_Occurred())
+            PyErr_SetString(g_unsupported, "batch not columnarizable");
+        return nullptr;
+    }
+    if (ncols == -1) f->cols.clear();  // empty batch: zero columns
+    // columns that stayed all-None: give them a concrete tag so every
+    // kernel can treat tag as trusted
+    for (FrameCol& c : f->cols) {
+        if (c.tag == 0) {
+            c.tag = CF_I64;
+            c.i64.assign(c.valid.size(), 0);
+        }
+    }
+    return frame_to_capsule(f.release());
+}
+
+PyObject* py_frame_to_updates(PyObject*, PyObject* cap) {
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    if (g_update_type == nullptr || g_pointer_type == nullptr) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "frame_to_updates: Update/Pointer unregistered");
+        return nullptr;
+    }
+    PyObject* out = PyList_New((Py_ssize_t)f->n_rows);
+    if (out == nullptr) return nullptr;
+    size_t ncols = f->cols.size();
+    for (int64_t i = 0; i < f->n_rows; i++) {
+        uint8_t kb[16];
+        f->key_digest((size_t)i, kb);
+        PyObject* num = pt_long_from_bytes_unsigned(kb, 16);
+        PyObject* key = pointer_from_long(num);
+        if (key == nullptr) goto fail;
+        {
+            PyObject* values = PyTuple_New((Py_ssize_t)ncols);
+            if (values == nullptr) {
+                Py_DECREF(key);
+                goto fail;
+            }
+            for (size_t c = 0; c < ncols; c++) {
+                PyObject* v = f->cell_object(c, (size_t)i);
+                if (v == nullptr) {
+                    Py_DECREF(values);
+                    Py_DECREF(key);
+                    goto fail;
+                }
+                PyTuple_SET_ITEM(values, (Py_ssize_t)c, v);
+            }
+            PyObject* u =
+                make_update(g_update_type, key, values, f->diff_at((size_t)i));
+            Py_DECREF(key);
+            Py_DECREF(values);
+            if (u == nullptr) goto fail;
+            PyList_SET_ITEM(out, (Py_ssize_t)i, u);
+        }
+    }
+    return out;
+fail:
+    Py_DECREF(out);
+    return nullptr;
+}
+
+PyObject* py_frame_slice(PyObject*, PyObject* args) {
+    PyObject* cap;
+    long long start, stop;
+    if (!PyArg_ParseTuple(args, "OLL", &cap, &start, &stop)) return nullptr;
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    if (start < 0) start = 0;
+    if (stop > f->n_rows) stop = f->n_rows;
+    if (stop < start) stop = start;
+    std::unique_ptr<Frame> out(f->like());
+    for (size_t c = 0; c < f->cols.size(); c++)
+        out->cols[c].reserve((size_t)(stop - start));
+    for (long long i = start; i < stop; i++)
+        out->append_row_from(*f, (size_t)i);
+    return frame_to_capsule(out.release());
+}
+
+// ---- JSONL -> frame parser -------------------------------------------
+//
+// frame_parse_jsonl(data, plan, prefix, seq_start, seq_step, key_offset)
+// parses a block of complete JSONL object lines straight into a frame:
+// one pass over the bytes, zero per-row Python objects, lazy keys
+// carrying just (prefix-hash state, line seq).  Strictly conservative:
+// ANY construct whose semantics could diverge from the
+// json.loads + coerce_rows row path (escapes, nested values, big ints,
+// type/plan mismatches, malformed lines) returns None and the caller
+// re-parses the whole block on the existing path.  Behaviour parity is
+// therefore exact by construction — this parser only accepts inputs
+// where the two paths provably agree.
+
+struct FrameDefCell {
+    bool is_null = true;
+    int64_t i = 0;
+    double d = 0.0;
+    uint32_t s = 0;
+    uint8_t b = 0;
+};
+
+inline const char* fj_skip_ws(const char* p, const char* end) {
+    while (p < end &&
+           (*p == ' ' || *p == '\t' || *p == '\r')) p++;
+    return p;
+}
+
+// strict JSON number grammar; returns past-the-end or nullptr
+const char* fj_scan_number(const char* p, const char* end, bool* is_float) {
+    *is_float = false;
+    if (p < end && *p == '-') p++;
+    if (p >= end || *p < '0' || *p > '9') return nullptr;
+    if (*p == '0') {
+        p++;
+    } else {
+        while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    if (p < end && *p == '.') {
+        *is_float = true;
+        p++;
+        if (p >= end || *p < '0' || *p > '9') return nullptr;
+        while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    if (p < end && (*p == 'e' || *p == 'E')) {
+        *is_float = true;
+        p++;
+        if (p < end && (*p == '+' || *p == '-')) p++;
+        if (p >= end || *p < '0' || *p > '9') return nullptr;
+        while (p < end && *p >= '0' && *p <= '9') p++;
+    }
+    return p;
+}
+
+// string body scan: [p, returned) is the content, quote consumed.
+// Escapes and raw control bytes bail (nullptr) — json.loads handles
+// them; this fast path only takes the overwhelmingly common clean case.
+const char* fj_scan_string(const char* p, const char* end,
+                           const char** content_end) {
+    const char* s = p;
+    while (p < end) {
+        unsigned char c = (unsigned char)*p;
+        if (c == '"') {
+            *content_end = p;
+            return p + 1;
+        }
+        if (c == '\\' || c < 0x20) return nullptr;
+        p++;
+    }
+    (void)s;
+    return nullptr;
+}
+
+PyObject* py_frame_parse_jsonl(PyObject*, PyObject* args) {
+    PyObject *data_obj, *plan, *prefix;
+    long long seq_start, seq_step, key_offset;
+    if (!PyArg_ParseTuple(args, "OOO!LLL", &data_obj, &plan, &PyTuple_Type,
+                          &prefix, &seq_start, &seq_step, &key_offset))
+        return nullptr;
+    char* data;
+    Py_ssize_t nbytes;
+    if (PyBytes_AsStringAndSize(data_obj, &data, &nbytes) < 0) return nullptr;
+
+    // plan: (name, default, code) per column — same triples coerce_rows
+    // takes, so defaults coerce identically
+    PyObject* plan_seq = PySequence_Fast(plan, "plan must be a sequence");
+    if (plan_seq == nullptr) return nullptr;
+    Py_ssize_t ncols = PySequence_Fast_GET_SIZE(plan_seq);
+
+    std::unique_ptr<Frame> f(new Frame());
+    f->cols.resize((size_t)ncols);
+    FramePoolBuilder pb;
+    std::vector<std::string> names((size_t)ncols);
+    std::vector<FrameDefCell> defaults((size_t)ncols);
+    bool fallback = false;
+    for (Py_ssize_t c = 0; c < ncols && !fallback; c++) {
+        PyObject* item = PySequence_Fast_GET_ITEM(plan_seq, c);
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 3) {
+            Py_DECREF(plan_seq);
+            PyErr_SetString(PyExc_TypeError, "plan items must be 3-tuples");
+            return nullptr;
+        }
+        PyObject* name = PyTuple_GET_ITEM(item, 0);
+        PyObject* dflt = PyTuple_GET_ITEM(item, 1);
+        long code = PyLong_AsLong(PyTuple_GET_ITEM(item, 2));
+        if (code == -1 && PyErr_Occurred()) {
+            Py_DECREF(plan_seq);
+            return nullptr;
+        }
+        Py_ssize_t nl;
+        const char* ns = PyUnicode_AsUTF8AndSize(name, &nl);
+        if (ns == nullptr) {
+            Py_DECREF(plan_seq);
+            return nullptr;
+        }
+        names[(size_t)c].assign(ns, (size_t)nl);
+        // key names containing quotes/backslashes would never byte-match
+        // the escaped form in the JSON text
+        if (names[(size_t)c].find('"') != std::string::npos ||
+            names[(size_t)c].find('\\') != std::string::npos) {
+            fallback = true;
+            break;
+        }
+        uint8_t tag;
+        switch (code) {
+            case CO_INT: tag = CF_I64; break;
+            case CO_FLOAT: tag = CF_F64; break;
+            case CO_STR: tag = CF_STR; break;
+            case CO_BOOL: tag = CF_BOOL; break;
+            default:
+                fallback = true;  // CO_ANY columns stay on the row path
+                tag = 0;
+                break;
+        }
+        if (fallback) break;
+        f->cols[(size_t)c].tag = tag;
+        FrameDefCell& dc = defaults[(size_t)c];
+        if (dflt == Py_None) {
+            dc.is_null = true;
+        } else {
+            // run the default through the exact coercer, then require the
+            // result to be natively storable
+            PyObject* cv = coerce_one(dflt, (int)code);
+            if (cv == nullptr) {
+                Py_DECREF(plan_seq);
+                return nullptr;
+            }
+            dc.is_null = false;
+            if (tag == CF_BOOL && PyBool_Check(cv)) {
+                dc.b = cv == Py_True ? 1 : 0;
+            } else if (tag == CF_I64 && PyLong_CheckExact(cv)) {
+                int overflow = 0;
+                dc.i = PyLong_AsLongLongAndOverflow(cv, &overflow);
+                if (overflow != 0 || (dc.i == -1 && PyErr_Occurred())) {
+                    PyErr_Clear();
+                    fallback = true;
+                }
+            } else if (tag == CF_F64 && PyFloat_CheckExact(cv)) {
+                dc.d = PyFloat_AS_DOUBLE(cv);
+            } else if (tag == CF_STR && PyUnicode_CheckExact(cv)) {
+                Py_ssize_t sl;
+                const char* s = PyUnicode_AsUTF8AndSize(cv, &sl);
+                if (s == nullptr) {
+                    Py_DECREF(cv);
+                    Py_DECREF(plan_seq);
+                    return nullptr;
+                }
+                Py_INCREF(cv);
+                int64_t idx = pb.intern(f.get(), cv, s, (size_t)sl);
+                if (idx < 0)
+                    fallback = true;
+                else
+                    dc.s = (uint32_t)idx;
+            } else {
+                fallback = true;  // coerced default escapes the typed set
+            }
+            Py_DECREF(cv);
+        }
+    }
+    Py_DECREF(plan_seq);
+    if (fallback) Py_RETURN_NONE;
+
+    // key prefix hash state, computed once for the whole block
+    Hasher base;
+    for (Py_ssize_t j = 0; j < PyTuple_GET_SIZE(prefix); j++) {
+        if (!feed(base, PyTuple_GET_ITEM(prefix, j))) {
+            if (PyErr_Occurred()) return nullptr;
+            Py_RETURN_NONE;  // exotic prefix type: row path keys
+        }
+    }
+    f->keys_lazy = true;
+    f->key_base = base.S;
+    f->key_offset = key_offset;
+
+    size_t est = (size_t)std::count(data, data + nbytes, '\n') + 1;
+    for (FrameCol& c : f->cols) c.reserve(est);
+    f->key_seqs.reserve(est);
+
+    // per-row staging: duplicate keys overwrite (json.loads keeps the
+    // last occurrence), so cells commit to the columns only at row end
+    struct StageCell {
+        int64_t i;
+        double d;
+        int64_t s;  // pool idx, or -1 null
+        uint8_t b;
+        uint8_t null;
+    };
+    std::vector<StageCell> stage((size_t)ncols);
+    std::vector<int64_t> seen((size_t)ncols, -1);
+    char numbuf[64];
+
+    const char* p = data;
+    const char* end = data + nbytes;
+    int64_t row = 0;
+    while (p < end && !fallback) {
+        const char* line_end =
+            static_cast<const char*>(memchr(p, '\n', (size_t)(end - p)));
+        if (line_end == nullptr) line_end = end;
+        const char* q = fj_skip_ws(p, line_end);
+        if (q >= line_end) {
+            fallback = true;  // blank/whitespace line: not one JSON object
+            break;
+        }
+        if (*q != '{') {
+            fallback = true;
+            break;
+        }
+        q = fj_skip_ws(q + 1, line_end);
+        bool first = true;
+        while (!fallback) {
+            if (q < line_end && *q == '}') {
+                q++;
+                break;
+            }
+            if (!first) {
+                if (q >= line_end || *q != ',') {
+                    fallback = true;
+                    break;
+                }
+                q = fj_skip_ws(q + 1, line_end);
+            }
+            first = false;
+            if (q >= line_end || *q != '"') {
+                fallback = true;
+                break;
+            }
+            const char* kend;
+            const char* kq = fj_scan_string(q + 1, line_end, &kend);
+            if (kq == nullptr) {
+                fallback = true;
+                break;
+            }
+            const char* kstart = q + 1;
+            size_t klen = (size_t)(kend - kstart);
+            q = fj_skip_ws(kq, line_end);
+            if (q >= line_end || *q != ':') {
+                fallback = true;
+                break;
+            }
+            q = fj_skip_ws(q + 1, line_end);
+            // match the key against the plan
+            Py_ssize_t col = -1;
+            for (Py_ssize_t c = 0; c < ncols; c++) {
+                if (names[(size_t)c].size() == klen &&
+                    std::memcmp(names[(size_t)c].data(), kstart, klen) == 0) {
+                    col = c;
+                    break;
+                }
+            }
+            if (q >= line_end) {
+                fallback = true;
+                break;
+            }
+            uint8_t tag = col >= 0 ? f->cols[(size_t)col].tag : 0;
+            StageCell cell{0, 0.0, -1, 0, 0};
+            char vch = *q;
+            if (vch == '"') {
+                const char* vend;
+                const char* vq = fj_scan_string(q + 1, line_end, &vend);
+                if (vq == nullptr) {
+                    fallback = true;
+                    break;
+                }
+                if (col >= 0) {
+                    if (tag != CF_STR) {
+                        // string into a numeric/bool column: coerce_one
+                        // would attempt parses — row path decides
+                        fallback = true;
+                        break;
+                    }
+                    PyObject* s = PyUnicode_DecodeUTF8(
+                        q + 1, (Py_ssize_t)(vend - (q + 1)), nullptr);
+                    if (s == nullptr) {
+                        PyErr_Clear();
+                        fallback = true;  // invalid utf-8
+                        break;
+                    }
+                    int64_t idx =
+                        pb.intern(f.get(), s, q + 1, (size_t)(vend - (q + 1)));
+                    if (idx < 0) {
+                        fallback = true;
+                        break;
+                    }
+                    cell.s = idx;
+                }
+                q = vq;
+            } else if (vch == 't' || vch == 'f') {
+                const char* word = vch == 't' ? "true" : "false";
+                size_t wl = vch == 't' ? 4 : 5;
+                if ((size_t)(line_end - q) < wl ||
+                    std::memcmp(q, word, wl) != 0) {
+                    fallback = true;
+                    break;
+                }
+                if (col >= 0) {
+                    if (tag != CF_BOOL) {
+                        fallback = true;  // bool survives CO_INT coercion
+                        break;
+                    }
+                    cell.b = vch == 't' ? 1 : 0;
+                }
+                q += wl;
+            } else if (vch == 'n') {
+                if ((size_t)(line_end - q) < 4 ||
+                    std::memcmp(q, "null", 4) != 0) {
+                    fallback = true;
+                    break;
+                }
+                // explicit null == missing: both take the default
+                cell.null = 1;
+                q += 4;
+            } else if (vch == '-' || (vch >= '0' && vch <= '9')) {
+                bool is_float;
+                const char* nend = fj_scan_number(q, line_end, &is_float);
+                if (nend == nullptr ||
+                    (size_t)(nend - q) >= sizeof(numbuf)) {
+                    fallback = true;
+                    break;
+                }
+                if (col >= 0) {
+                    std::memcpy(numbuf, q, (size_t)(nend - q));
+                    numbuf[nend - q] = '\0';
+                    if (!is_float) {
+                        errno = 0;
+                        char* ep = nullptr;
+                        long long x = strtoll(numbuf, &ep, 10);
+                        if (errno != 0 || ep != numbuf + (nend - q)) {
+                            fallback = true;  // >64-bit int
+                            break;
+                        }
+                        if (tag == CF_I64) {
+                            cell.i = x;
+                        } else if (tag == CF_F64) {
+                            // PyNumber_Float(int64) and the C conversion
+                            // both round to nearest-even
+                            cell.d = (double)x;
+                        } else {
+                            fallback = true;
+                            break;
+                        }
+                    } else {
+                        if (tag != CF_F64) {
+                            fallback = true;  // float into int col: row path
+                            break;
+                        }
+                        // json.loads parses doubles with this exact
+                        // function, so the bits match
+                        char* ep = nullptr;
+                        double d =
+                            PyOS_string_to_double(numbuf, &ep, nullptr);
+                        if (d == -1.0 && PyErr_Occurred()) {
+                            PyErr_Clear();
+                            fallback = true;
+                            break;
+                        }
+                        if (ep != numbuf + (nend - q)) {
+                            fallback = true;
+                            break;
+                        }
+                        cell.d = d;
+                    }
+                }
+                q = nend;
+            } else {
+                fallback = true;  // nested object/array or garbage
+                break;
+            }
+            if (col >= 0) {
+                stage[(size_t)col] = cell;
+                seen[(size_t)col] = row;
+            }
+            q = fj_skip_ws(q, line_end);
+        }
+        if (fallback) break;
+        q = fj_skip_ws(q, line_end);
+        if (q != line_end) {
+            fallback = true;  // trailing garbage after the object
+            break;
+        }
+        // commit the staged row
+        for (Py_ssize_t c = 0; c < ncols; c++) {
+            FrameCol& colv = f->cols[(size_t)c];
+            bool have = seen[(size_t)c] == row;
+            const StageCell& cell = stage[(size_t)c];
+            bool is_null = !have || cell.null ||
+                           (colv.tag == CF_STR && have && !cell.null &&
+                            cell.s < 0);
+            if (is_null) {
+                const FrameDefCell& dc = defaults[(size_t)c];
+                if (dc.is_null) {
+                    colv.push_null();
+                } else {
+                    switch (colv.tag) {
+                        case CF_I64: colv.i64.push_back(dc.i); break;
+                        case CF_F64: colv.f64.push_back(dc.d); break;
+                        case CF_STR: colv.sidx.push_back(dc.s); break;
+                        case CF_BOOL: colv.b8.push_back(dc.b); break;
+                    }
+                    colv.push_valid_mark();
+                }
+            } else {
+                switch (colv.tag) {
+                    case CF_I64: colv.i64.push_back(cell.i); break;
+                    case CF_F64: colv.f64.push_back(cell.d); break;
+                    case CF_STR:
+                        colv.sidx.push_back((uint32_t)cell.s);
+                        break;
+                    case CF_BOOL: colv.b8.push_back(cell.b); break;
+                }
+                colv.push_valid_mark();
+            }
+        }
+        f->key_seqs.push_back(seq_start + row * seq_step);
+        f->n_rows++;
+        row++;
+        p = line_end < end ? line_end + 1 : end;
+    }
+    if (fallback) Py_RETURN_NONE;
+    return frame_to_capsule(f.release());
+}
+
+// ---- frame groupby partials ------------------------------------------
+//
+// frame_groupby_partials(frame, group_idx, red_specs, error_obj)
+// — byte-compatible output with groupby_partials ({gvals: (count,
+// (partial, ...))}), computed from columns without building row
+// objects.  The Python merge loop that folds partials into persistent
+// accumulators is IDENTICAL for both entry points, so reducer semantics
+// are shared by construction.  Frames cannot contain ERROR sentinels or
+// exotic types (construction rejects them), which removes the poisoning
+// scan the row path needs.
+
+PyObject* py_frame_groupby_partials(PyObject*, PyObject* args) {
+    PyObject *cap, *group_idx, *red_specs, *error_obj;
+    if (!PyArg_ParseTuple(args, "OOOO", &cap, &group_idx, &red_specs,
+                          &error_obj))
+        return nullptr;
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    if (!PyTuple_Check(group_idx) || !PyTuple_Check(red_specs)) {
+        PyErr_SetString(PyExc_TypeError, "group_idx/red_specs must be tuples");
+        return nullptr;
+    }
+    Py_ssize_t ngroup = PyTuple_GET_SIZE(group_idx);
+    std::vector<Py_ssize_t> gidx((size_t)ngroup);
+    bool need_keys = false;
+    for (Py_ssize_t i = 0; i < ngroup; i++) {
+        gidx[(size_t)i] = PyLong_AsSsize_t(PyTuple_GET_ITEM(group_idx, i));
+        if (gidx[(size_t)i] == -1 && PyErr_Occurred()) return nullptr;
+        if (gidx[(size_t)i] < 0) need_keys = true;
+        if (gidx[(size_t)i] >= (Py_ssize_t)f->cols.size()) {
+            PyErr_SetString(g_unsupported, "group column out of range");
+            return nullptr;
+        }
+    }
+    Py_ssize_t nred = PyTuple_GET_SIZE(red_specs);
+    std::vector<int> rcodes((size_t)nred);
+    std::vector<std::vector<Py_ssize_t>> ridx((size_t)nred);
+    for (Py_ssize_t r = 0; r < nred; r++) {
+        PyObject* spec = PyTuple_GET_ITEM(red_specs, r);
+        if (!PyTuple_Check(spec) || PyTuple_GET_SIZE(spec) != 2) {
+            PyErr_SetString(PyExc_TypeError, "red_specs items must be pairs");
+            return nullptr;
+        }
+        long code = PyLong_AsLong(PyTuple_GET_ITEM(spec, 0));
+        if (code == -1 && PyErr_Occurred()) return nullptr;
+        rcodes[(size_t)r] = (int)code;
+        PyObject* idxs = PyTuple_GET_ITEM(spec, 1);
+        if (!PyTuple_Check(idxs)) {
+            PyErr_SetString(PyExc_TypeError, "red spec idx must be a tuple");
+            return nullptr;
+        }
+        for (Py_ssize_t j = 0; j < PyTuple_GET_SIZE(idxs); j++) {
+            Py_ssize_t v = PyLong_AsSsize_t(PyTuple_GET_ITEM(idxs, j));
+            if (v == -1 && PyErr_Occurred()) return nullptr;
+            if (v >= (Py_ssize_t)f->cols.size()) {
+                PyErr_SetString(g_unsupported, "reduce column out of range");
+                return nullptr;
+            }
+            if (v < 0) need_keys = true;
+            ridx[(size_t)r].push_back(v);
+        }
+        if (code == 1) {
+            // sum-like native partial: the argument column must be
+            // numeric (string "sums" concatenate — row path handles)
+            uint8_t t = ridx[(size_t)r][0] < 0
+                            ? (uint8_t)0
+                            : f->cols[(size_t)ridx[(size_t)r][0]].tag;
+            if (ridx[(size_t)r][0] < 0 || t == CF_STR) {
+                PyErr_SetString(g_unsupported, "non-numeric sum column");
+                return nullptr;
+            }
+        }
+    }
+    if (need_keys) f->materialize_keys();
+
+    // staging table: group cells serialized to a byte key.  Single
+    // string-column grouping (the dominant shape: wordcount, any
+    // group-by-categorical) short-circuits through a pool-index table —
+    // O(1) per row with zero hashing of string bytes.
+    struct FPart {
+        long long isum = 0;
+        double dsum = 0.0;
+        long long cnt = 0;
+        bool seen = false;
+        PyObject* msdict = nullptr;
+        std::vector<MsItem> msitems;
+    };
+    struct FEntry {
+        long long count = 0;
+        int64_t first_row = 0;
+        std::vector<FPart> parts;
+    };
+    std::vector<FEntry> entries;
+    std::unordered_map<std::string, size_t> emap;
+    std::vector<int64_t> ent_by_pool;
+    int64_t ent_null = -1;
+    bool single_str = ngroup == 1 && gidx[0] >= 0 &&
+                      f->cols[(size_t)gidx[0]].tag == CF_STR;
+    if (single_str) ent_by_pool.assign(f->pool.size(), -1);
+    std::string gkey;
+    bool fail = false;
+    bool unsupported = false;
+
+    for (int64_t i = 0; i < f->n_rows && !fail; i++) {
+        long long diff = f->diff_at((size_t)i);
+        size_t ei;
+        if (single_str) {
+            const FrameCol& gc = f->cols[(size_t)gidx[0]];
+            int64_t* slot;
+            if (gc.is_valid((size_t)i)) {
+                slot = &ent_by_pool[gc.sidx[(size_t)i]];
+            } else {
+                slot = &ent_null;
+            }
+            if (*slot < 0) {
+                *slot = (int64_t)entries.size();
+                entries.emplace_back();
+                entries.back().first_row = i;
+                entries.back().parts.resize((size_t)nred);
+            }
+            ei = (size_t)*slot;
+        } else {
+            gkey.clear();
+            for (Py_ssize_t j = 0; j < ngroup; j++) {
+                Py_ssize_t ix = gidx[(size_t)j];
+                if (ix < 0) {
+                    gkey.push_back((char)0x10);
+                    size_t at = gkey.size();
+                    gkey.resize(at + 16);
+                    f->key_digest((size_t)i, (uint8_t*)&gkey[at]);
+                    continue;
+                }
+                const FrameCol& c = f->cols[(size_t)ix];
+                if (!c.is_valid((size_t)i)) {
+                    gkey.push_back((char)0x00);
+                    continue;
+                }
+                switch (c.tag) {
+                    case CF_I64: {
+                        gkey.push_back((char)CF_I64);
+                        int64_t v = c.i64[(size_t)i];
+                        gkey.append((const char*)&v, 8);
+                        break;
+                    }
+                    case CF_F64: {
+                        gkey.push_back((char)CF_F64);
+                        double v = c.f64[(size_t)i];
+                        gkey.append((const char*)&v, 8);
+                        break;
+                    }
+                    case CF_STR: {
+                        gkey.push_back((char)CF_STR);
+                        uint32_t v = c.sidx[(size_t)i];
+                        gkey.append((const char*)&v, 4);
+                        break;
+                    }
+                    case CF_BOOL:
+                        gkey.push_back((char)CF_BOOL);
+                        gkey.push_back((char)c.b8[(size_t)i]);
+                        break;
+                }
+            }
+            auto it = emap.find(gkey);
+            if (it != emap.end()) {
+                ei = it->second;
+            } else {
+                ei = entries.size();
+                emap.emplace(gkey, ei);
+                entries.emplace_back();
+                entries.back().first_row = i;
+                entries.back().parts.resize((size_t)nred);
+            }
+        }
+        FEntry& ge = entries[ei];
+        ge.count += diff;
+        for (Py_ssize_t r = 0; r < nred && !fail; r++) {
+            FPart& part = ge.parts[(size_t)r];
+            int code = rcodes[(size_t)r];
+            if (code == 0) continue;
+            if (code == 1) {
+                Py_ssize_t ix = ridx[(size_t)r][0];
+                const FrameCol& c = f->cols[(size_t)ix];
+                if (!c.is_valid((size_t)i)) continue;  // None: skipped
+                if (c.tag == CF_F64) {
+                    part.dsum += c.f64[(size_t)i] * (double)diff;
+                } else {
+                    long long v = c.tag == CF_I64 ? c.i64[(size_t)i]
+                                                  : (long long)c.b8[(size_t)i];
+                    long long term, nsum;
+                    if (__builtin_mul_overflow(v, diff, &term) ||
+                        __builtin_add_overflow(part.isum, term, &nsum)) {
+                        unsupported = true;  // int64 overflow: row path
+                        fail = true;
+                        break;
+                    }
+                    part.isum = nsum;
+                }
+                part.cnt += diff;
+                part.seen = true;
+            } else if (code == 2) {
+                // multiset partial: per-row arg tuples (scalar cells are
+                // always hashable, so no hashable_fn detour)
+                const std::vector<Py_ssize_t>& idxs = ridx[(size_t)r];
+                PyObject* margs = PyTuple_New((Py_ssize_t)idxs.size());
+                if (margs == nullptr) {
+                    fail = true;
+                    break;
+                }
+                bool cellfail = false;
+                for (size_t j = 0; j < idxs.size(); j++) {
+                    PyObject* cell;
+                    if (idxs[j] < 0) {
+                        uint8_t kb[16];
+                        f->key_digest((size_t)i, kb);
+                        cell = pointer_from_long(
+                            pt_long_from_bytes_unsigned(kb, 16));
+                    } else {
+                        cell = f->cell_object((size_t)idxs[j], (size_t)i);
+                    }
+                    if (cell == nullptr) {
+                        cellfail = true;
+                        break;
+                    }
+                    PyTuple_SET_ITEM(margs, (Py_ssize_t)j, cell);
+                }
+                if (cellfail) {
+                    Py_DECREF(margs);
+                    fail = true;
+                    break;
+                }
+                if (part.msdict == nullptr) {
+                    part.msdict = PyDict_New();
+                    if (part.msdict == nullptr) {
+                        Py_DECREF(margs);
+                        fail = true;
+                        break;
+                    }
+                }
+                PyObject* mf = PyDict_GetItemWithError(part.msdict, margs);
+                if (mf == nullptr && PyErr_Occurred()) {
+                    Py_DECREF(margs);
+                    fail = true;
+                    break;
+                }
+                if (mf != nullptr) {
+                    size_t mi = (size_t)PyLong_AsSsize_t(mf);
+                    part.msitems[mi].delta += diff;
+                    Py_DECREF(margs);
+                } else {
+                    PyObject* mi =
+                        PyLong_FromSsize_t((Py_ssize_t)part.msitems.size());
+                    if (mi == nullptr ||
+                        PyDict_SetItem(part.msdict, margs, mi) < 0) {
+                        Py_XDECREF(mi);
+                        Py_DECREF(margs);
+                        fail = true;
+                        break;
+                    }
+                    Py_DECREF(mi);
+                    Py_INCREF(margs);  // msitems owns args AND h (same obj)
+                    part.msitems.push_back({diff, margs, margs});
+                }
+            } else {
+                unsupported = true;
+                fail = true;
+                break;
+            }
+        }
+    }
+
+    auto free_entries = [&entries]() {
+        for (FEntry& e : entries) {
+            for (FPart& p : e.parts) {
+                Py_XDECREF(p.msdict);
+                for (MsItem& it : p.msitems) {
+                    Py_XDECREF(it.args);
+                    Py_XDECREF(it.h);
+                }
+            }
+        }
+        entries.clear();
+    };
+
+    if (fail) {
+        free_entries();
+        if (unsupported && !PyErr_Occurred())
+            PyErr_SetString(g_unsupported, "frame groupby not supported");
+        return nullptr;
+    }
+
+    PyObject* out = PyDict_New();
+    if (out == nullptr) {
+        free_entries();
+        return nullptr;
+    }
+    for (size_t ei = 0; ei < entries.size() && !fail; ei++) {
+        FEntry& ge = entries[ei];
+        // rebuild gvals from the entry's first row
+        PyObject* gv = PyTuple_New(ngroup);
+        if (gv == nullptr) {
+            fail = true;
+            break;
+        }
+        for (Py_ssize_t j = 0; j < ngroup && !fail; j++) {
+            PyObject* cell;
+            if (gidx[(size_t)j] < 0) {
+                uint8_t kb[16];
+                f->key_digest((size_t)ge.first_row, kb);
+                cell = pointer_from_long(pt_long_from_bytes_unsigned(kb, 16));
+            } else {
+                cell = f->cell_object((size_t)gidx[(size_t)j],
+                                      (size_t)ge.first_row);
+            }
+            if (cell == nullptr) {
+                fail = true;
+                break;
+            }
+            PyTuple_SET_ITEM(gv, j, cell);
+        }
+        if (fail) {
+            Py_DECREF(gv);
+            break;
+        }
+        PyObject* parts = PyTuple_New(nred);
+        if (parts == nullptr) {
+            Py_DECREF(gv);
+            fail = true;
+            break;
+        }
+        for (Py_ssize_t r = 0; r < nred && !fail; r++) {
+            FPart& p = ge.parts[(size_t)r];
+            PyObject* payload = nullptr;
+            if (rcodes[(size_t)r] == 0) {
+                payload = PyLong_FromLongLong(ge.count);
+            } else if (rcodes[(size_t)r] == 1) {
+                if (!p.seen) {
+                    payload = Py_BuildValue("(OL)", Py_None, (long long)0);
+                } else {
+                    Py_ssize_t ix = ridx[(size_t)r][0];
+                    PyObject* tot =
+                        f->cols[(size_t)ix].tag == CF_F64
+                            ? PyFloat_FromDouble(p.dsum)
+                            : PyLong_FromLongLong(p.isum);
+                    if (tot != nullptr) {
+                        payload = Py_BuildValue("(NL)", tot, p.cnt);
+                        if (payload == nullptr) Py_DECREF(tot);
+                    }
+                }
+            } else {
+                payload = PyDict_New();
+                if (payload != nullptr) {
+                    for (MsItem& it : p.msitems) {
+                        PyObject* dv = Py_BuildValue("(LO)", it.delta,
+                                                     it.args);
+                        if (dv == nullptr ||
+                            PyDict_SetItem(payload, it.h, dv) < 0) {
+                            Py_XDECREF(dv);
+                            Py_DECREF(payload);
+                            payload = nullptr;
+                            break;
+                        }
+                        Py_DECREF(dv);
+                    }
+                }
+            }
+            if (payload == nullptr) {
+                Py_DECREF(parts);
+                Py_DECREF(gv);
+                fail = true;
+                break;
+            }
+            PyTuple_SET_ITEM(parts, r, payload);
+        }
+        if (fail) break;
+        PyObject* val = Py_BuildValue("(LO)", ge.count, parts);
+        Py_DECREF(parts);
+        if (val == nullptr || PyDict_SetItem(out, gv, val) < 0) {
+            Py_XDECREF(val);
+            Py_DECREF(gv);
+            fail = true;
+            break;
+        }
+        Py_DECREF(val);
+        Py_DECREF(gv);
+    }
+    free_entries();
+    if (fail) {
+        Py_DECREF(out);
+        return nullptr;
+    }
+    return out;
+}
+
+// ---- frame routing / projection / filtering --------------------------
+
+template <typename Sink>
+bool frame_feed_cell(Sink& sink, const Frame* f, Py_ssize_t ix, size_t i) {
+    if (ix < 0) {
+        uint8_t kb[16];
+        f->key_digest(i, kb);
+        sink.tag(0x07);
+        sink.bytes(kb, 16);
+        return true;
+    }
+    const FrameCol& c = f->cols[(size_t)ix];
+    if (!c.is_valid(i)) {
+        sink.tag(0x00);
+        return true;
+    }
+    switch (c.tag) {
+        case CF_I64:
+            feed_small_int(sink, c.i64[i]);
+            return true;
+        case CF_F64: {
+            double d = c.f64[i];
+            sink.tag(0x03);
+            sink.bytes(&d, 8);
+            return true;
+        }
+        case CF_STR: {
+            Py_ssize_t n;
+            const char* s = PyUnicode_AsUTF8AndSize(f->pool[c.sidx[i]], &n);
+            if (s == nullptr) return false;
+            sink.tag(0x04);
+            sink.u64le((uint64_t)n);
+            sink.bytes(s, (size_t)n);
+            return true;
+        }
+        case CF_BOOL:
+            sink.tag(0x01);
+            sink.tag(c.b8[i] ? 0x01 : 0x00);
+            return true;
+        default:
+            return false;
+    }
+}
+
+PyObject* py_frame_route_split(PyObject*, PyObject* args) {
+    // frame_route_split(frame, idx_tuple, W) -> list of W frames.
+    // Destinations are byte-identical to route_split on the materialized
+    // rows: positional cells feed the same tagged stream into the same
+    // digest memo; the empty tuple means int(key) % W.  Single
+    // string-column routes memoize the destination per POOL INDEX, so a
+    // million-row frame over a 1k vocabulary does ~1k digests.
+    PyObject *cap, *idxs;
+    long W;
+    if (!PyArg_ParseTuple(args, "OOl", &cap, &idxs, &W)) return nullptr;
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    if (W <= 0 || !PyTuple_Check(idxs)) {
+        PyErr_SetString(PyExc_ValueError, "bad frame_route_split arguments");
+        return nullptr;
+    }
+    Py_ssize_t nidx = PyTuple_GET_SIZE(idxs);
+    std::vector<Py_ssize_t> pos((size_t)nidx);
+    for (Py_ssize_t i = 0; i < nidx; i++) {
+        pos[(size_t)i] = PyLong_AsSsize_t(PyTuple_GET_ITEM(idxs, i));
+        if (pos[(size_t)i] == -1 && PyErr_Occurred()) return nullptr;
+        if (pos[(size_t)i] >= (Py_ssize_t)f->cols.size()) {
+            PyErr_SetString(PyExc_IndexError, "route column out of range");
+            return nullptr;
+        }
+    }
+    if (nidx == 0) f->materialize_keys();  // key routing needs digests
+
+    std::vector<std::unique_ptr<Frame>> outs;
+    outs.reserve((size_t)W);
+    for (long w = 0; w < W; w++) outs.emplace_back(f->like());
+
+    bool single_str = nidx == 1 && pos[0] >= 0 &&
+                      f->cols[(size_t)pos[0]].tag == CF_STR;
+    std::vector<long> dest_by_pool;
+    long dest_null = -1;
+    if (single_str) dest_by_pool.assign(f->pool.size(), -1);
+    std::string cells;
+
+    for (int64_t i = 0; i < f->n_rows; i++) {
+        long dest;
+        if (nidx == 0) {
+            // int(key) % W on the 128-bit LE digest
+            uint64_t lo, hi;
+            std::memcpy(&lo, f->keyb.data() + 16 * (size_t)i, 8);
+            std::memcpy(&hi, f->keyb.data() + 16 * (size_t)i + 8, 8);
+            unsigned __int128 v =
+                ((unsigned __int128)hi << 64) | (unsigned __int128)lo;
+            dest = (long)(unsigned long long)(v % (unsigned long long)W);
+        } else {
+            long* slot = nullptr;
+            if (single_str) {
+                const FrameCol& c = f->cols[(size_t)pos[0]];
+                slot = c.is_valid((size_t)i)
+                           ? &dest_by_pool[c.sidx[(size_t)i]]
+                           : &dest_null;
+                if (*slot >= 0) {
+                    outs[(size_t)*slot]->append_row_from(*f, (size_t)i);
+                    continue;
+                }
+            }
+            cells.clear();
+            ByteSink sink{cells};
+            bool ok = true;
+            for (Py_ssize_t j = 0; j < nidx && ok; j++)
+                ok = frame_feed_cell(sink, f, pos[(size_t)j], (size_t)i);
+            if (!ok) {
+                if (!PyErr_Occurred())
+                    PyErr_SetString(g_unsupported, "unroutable cell");
+                return nullptr;
+            }
+            uint8_t dg[16];
+            route_digest(cells, dg);
+            uint64_t lo, hi;
+            std::memcpy(&lo, dg, 8);
+            std::memcpy(&hi, dg + 8, 8);
+            unsigned __int128 v =
+                ((unsigned __int128)hi << 64) | (unsigned __int128)lo;
+            dest = (long)(unsigned long long)(v % (unsigned long long)W);
+            if (slot != nullptr) *slot = dest;
+        }
+        outs[(size_t)dest]->append_row_from(*f, (size_t)i);
+    }
+    PyObject* out = PyList_New(W);
+    if (out == nullptr) return nullptr;
+    for (long w = 0; w < W; w++) {
+        PyObject* c = frame_to_capsule(outs[(size_t)w].release());
+        if (c == nullptr) {
+            Py_DECREF(out);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, w, c);
+    }
+    return out;
+}
+
+PyObject* py_frame_project(PyObject*, PyObject* args) {
+    // frame_project(frame, pos_tuple) -> frame with the selected value
+    // columns (keys/diffs/pool preserved) — the columnar form of a
+    // pure-projection rowwise node
+    PyObject *cap, *idxs;
+    if (!PyArg_ParseTuple(args, "OO!", &cap, &PyTuple_Type, &idxs))
+        return nullptr;
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    std::unique_ptr<Frame> out(new Frame());
+    out->n_rows = f->n_rows;
+    out->pool = f->pool;
+    for (PyObject* s : out->pool) Py_INCREF(s);
+    out->keys_lazy = f->keys_lazy;
+    out->key_base = f->key_base;
+    out->key_offset = f->key_offset;
+    out->key_seqs = f->key_seqs;
+    out->keyb = f->keyb;
+    out->all_plus = f->all_plus;
+    out->diffs = f->diffs;
+    Py_ssize_t nsel = PyTuple_GET_SIZE(idxs);
+    out->cols.resize((size_t)nsel);
+    for (Py_ssize_t j = 0; j < nsel; j++) {
+        Py_ssize_t ix = PyLong_AsSsize_t(PyTuple_GET_ITEM(idxs, j));
+        if (ix == -1 && PyErr_Occurred()) return nullptr;
+        if (ix < 0 || ix >= (Py_ssize_t)f->cols.size()) {
+            PyErr_SetString(PyExc_IndexError, "project column out of range");
+            return nullptr;
+        }
+        out->cols[(size_t)j] = f->cols[(size_t)ix];  // column copy
+    }
+    return frame_to_capsule(out.release());
+}
+
+enum FrameCmp {
+    FC_EQ = 0,
+    FC_NE = 1,
+    FC_LT = 2,
+    FC_LE = 3,
+    FC_GT = 4,
+    FC_GE = 5,
+};
+
+template <typename T>
+inline bool frame_cmp(int op, T a, T b) {
+    switch (op) {
+        case FC_EQ: return a == b;
+        case FC_NE: return a != b;
+        case FC_LT: return a < b;
+        case FC_LE: return a <= b;
+        case FC_GT: return a > b;
+        default: return a >= b;
+    }
+}
+
+PyObject* py_frame_filter(PyObject*, PyObject* args) {
+    // frame_filter(frame, pos, op, const) -> frame keeping rows where
+    // column[pos] <op> const.  None cells follow Python comparison
+    // semantics under FilterNode's drop rules: == is False (drop),
+    // != is True (keep), ordering raises (drop).  Type pairings are
+    // strict — any cross-type compare falls back to the row path so
+    // exact-arithmetic parity (int64 vs float) is never at risk.
+    PyObject *cap, *cobj;
+    long long posl;
+    int op;
+    if (!PyArg_ParseTuple(args, "OLiO", &cap, &posl, &op, &cobj))
+        return nullptr;
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    if (posl < 0 || posl >= (long long)f->cols.size() || op < 0 || op > 5) {
+        PyErr_SetString(PyExc_ValueError, "bad frame_filter arguments");
+        return nullptr;
+    }
+    const FrameCol& c = f->cols[(size_t)posl];
+    long long ci = 0;
+    double cd = 0.0;
+    std::string cs;
+    if (c.tag == CF_I64 && PyLong_CheckExact(cobj)) {
+        int overflow = 0;
+        ci = PyLong_AsLongLongAndOverflow(cobj, &overflow);
+        if (overflow != 0 || (ci == -1 && PyErr_Occurred())) {
+            PyErr_Clear();
+            PyErr_SetString(g_unsupported, "filter constant out of range");
+            return nullptr;
+        }
+    } else if (c.tag == CF_F64 && PyFloat_CheckExact(cobj)) {
+        cd = PyFloat_AS_DOUBLE(cobj);
+    } else if (c.tag == CF_BOOL && PyBool_Check(cobj)) {
+        ci = cobj == Py_True ? 1 : 0;
+    } else if (c.tag == CF_STR && PyUnicode_CheckExact(cobj)) {
+        Py_ssize_t n;
+        const char* s = PyUnicode_AsUTF8AndSize(cobj, &n);
+        if (s == nullptr) return nullptr;
+        cs.assign(s, (size_t)n);
+    } else {
+        PyErr_SetString(g_unsupported, "filter type pairing not columnar");
+        return nullptr;
+    }
+    std::unique_ptr<Frame> out(f->like());
+    for (int64_t i = 0; i < f->n_rows; i++) {
+        bool keep;
+        if (!c.is_valid((size_t)i)) {
+            keep = op == FC_NE;  // None != const is True; rest drop
+        } else {
+            switch (c.tag) {
+                case CF_I64:
+                    keep = frame_cmp(op, (long long)c.i64[(size_t)i], ci);
+                    break;
+                case CF_F64: keep = frame_cmp(op, c.f64[(size_t)i], cd); break;
+                case CF_BOOL:
+                    keep = frame_cmp(op, (long long)c.b8[(size_t)i], ci);
+                    break;
+                default: {
+                    // UTF-8 byte order == code point order
+                    Py_ssize_t n;
+                    const char* s = PyUnicode_AsUTF8AndSize(
+                        f->pool[c.sidx[(size_t)i]], &n);
+                    if (s == nullptr) return nullptr;
+                    int r = std::memcmp(
+                        s, cs.data(),
+                        std::min((size_t)n, cs.size()));
+                    if (r == 0)
+                        r = (size_t)n < cs.size() ? -1
+                            : (size_t)n > cs.size() ? 1 : 0;
+                    keep = frame_cmp(op, (long long)r, (long long)0);
+                    break;
+                }
+            }
+        }
+        if (keep) out->append_row_from(*f, (size_t)i);
+    }
+    return frame_to_capsule(out.release());
+}
+
+// ---- frame wire codec -------------------------------------------------
+//
+// One blob per (peer, slot): fixed-width column buffers memcpy'd in and
+// out, string pool shared across every frame of ONE transmission
+// (tx/rx pool capsules), lazy keys shipped as (hash state, seqs) so the
+// receiver inherits the 8-bytes-per-key representation.  Decode is
+// bounds-checked everywhere — a truncated or corrupt frame raises
+// ValueError, never reads past the buffer.
+
+constexpr uint8_t kFrameMagic = 0xCF;
+constexpr uint8_t kFrameVersion = 1;
+constexpr size_t kFramePoolShareCap = 1 << 20;  // tx/rx symmetric cap
+
+struct FrameTxPool {
+    std::unordered_map<std::string, uint32_t> map;
+    unsigned long long hits = 0;
+    unsigned long long misses = 0;
+};
+const char kTxPoolCap[] = "pathway_tpu.frame_txpool";
+void txpool_free(PyObject* cap) {
+    delete static_cast<FrameTxPool*>(
+        PyCapsule_GetPointer(cap, kTxPoolCap));
+}
+
+struct FrameRxPool {
+    std::vector<PyObject*> strs;  // owned
+    ~FrameRxPool() {
+        for (PyObject* s : strs) Py_XDECREF(s);
+    }
+};
+const char kRxPoolCap[] = "pathway_tpu.frame_rxpool";
+void rxpool_free(PyObject* cap) {
+    delete static_cast<FrameRxPool*>(
+        PyCapsule_GetPointer(cap, kRxPoolCap));
+}
+
+PyObject* py_frame_txpool_new(PyObject*, PyObject*) {
+    return PyCapsule_New(new FrameTxPool(), kTxPoolCap, txpool_free);
+}
+
+PyObject* py_frame_rxpool_new(PyObject*, PyObject*) {
+    return PyCapsule_New(new FrameRxPool(), kRxPoolCap, rxpool_free);
+}
+
+PyObject* py_frame_txpool_stats(PyObject*, PyObject* cap) {
+    FrameTxPool* tp =
+        static_cast<FrameTxPool*>(PyCapsule_GetPointer(cap, kTxPoolCap));
+    if (tp == nullptr) return nullptr;
+    return Py_BuildValue("(KK)", tp->hits, tp->misses);
+}
+
+bool frame_pack_to(std::string& buf, Frame* f, FrameTxPool* tp) {
+    buf.push_back((char)kFrameMagic);
+    buf.push_back((char)kFrameVersion);
+    uint8_t flags = (f->all_plus ? 1 : 0) | (f->keys_lazy ? 2 : 0);
+    buf.push_back((char)flags);
+    wf_put_u32(buf, (uint32_t)f->n_rows);
+    uint16_t nc = (uint16_t)f->cols.size();
+    buf.append((const char*)&nc, 2);
+    wf_put_u32(buf, (uint32_t)f->pool.size());
+    if (f->keys_lazy) {
+        uint16_t ns = (uint16_t)sizeof(pwnative::Blake2bState);
+        buf.append((const char*)&ns, 2);
+        buf.append((const char*)&f->key_base, sizeof(pwnative::Blake2bState));
+        wf_put_u64(buf, (uint64_t)f->key_offset);
+        buf.append((const char*)f->key_seqs.data(), f->key_seqs.size() * 8);
+    } else {
+        buf.append((const char*)f->keyb.data(), f->keyb.size());
+    }
+    if (!f->all_plus)
+        buf.append((const char*)f->diffs.data(), f->diffs.size());
+    for (PyObject* s : f->pool) {
+        Py_ssize_t n;
+        const char* u8 = PyUnicode_AsUTF8AndSize(s, &n);
+        if (u8 == nullptr) return false;
+        if (tp != nullptr) {
+            auto it = tp->map.find(std::string(u8, (size_t)n));
+            if (it != tp->map.end()) {
+                tp->hits++;
+                buf.push_back((char)1);
+                wf_put_u32(buf, it->second);
+                continue;
+            }
+            tp->misses++;
+            if (tp->map.size() < kFramePoolShareCap)
+                tp->map.emplace(std::string(u8, (size_t)n),
+                                (uint32_t)tp->map.size());
+        }
+        buf.push_back((char)0);
+        wf_put_u32(buf, (uint32_t)n);
+        buf.append(u8, (size_t)n);
+    }
+    for (const FrameCol& c : f->cols) {
+        buf.push_back((char)c.tag);
+        buf.push_back((char)(c.valid.empty() ? 0 : 1));
+        switch (c.tag) {
+            case CF_I64:
+                buf.append((const char*)c.i64.data(), c.i64.size() * 8);
+                break;
+            case CF_F64:
+                buf.append((const char*)c.f64.data(), c.f64.size() * 8);
+                break;
+            case CF_STR:
+                buf.append((const char*)c.sidx.data(), c.sidx.size() * 4);
+                break;
+            case CF_BOOL:
+                buf.append((const char*)c.b8.data(), c.b8.size());
+                break;
+            default:
+                PyErr_SetString(PyExc_ValueError, "bad column tag");
+                return false;
+        }
+        if (!c.valid.empty())
+            buf.append((const char*)c.valid.data(), c.valid.size());
+    }
+    return true;
+}
+
+FrameTxPool* txpool_arg_opt(PyObject* obj) {
+    if (obj == Py_None) return nullptr;
+    return static_cast<FrameTxPool*>(PyCapsule_GetPointer(obj, kTxPoolCap));
+}
+
+PyObject* py_frame_pack(PyObject*, PyObject* args) {
+    PyObject* cap;
+    PyObject* tpobj = Py_None;
+    if (!PyArg_ParseTuple(args, "O|O", &cap, &tpobj)) return nullptr;
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    FrameTxPool* tp = txpool_arg_opt(tpobj);
+    if (tp == nullptr && tpobj != Py_None) return nullptr;
+    std::string buf;
+    buf.reserve(f->nbytes() + 64);
+    if (!frame_pack_to(buf, f, tp)) return nullptr;
+    return PyBytes_FromStringAndSize(buf.data(), (Py_ssize_t)buf.size());
+}
+
+PyObject* py_frame_pack_into(PyObject*, PyObject* args) {
+    PyObject *cap, *target;
+    PyObject* tpobj = Py_None;
+    if (!PyArg_ParseTuple(args, "OO!|O", &cap, &PyByteArray_Type, &target,
+                          &tpobj))
+        return nullptr;
+    Frame* f = frame_arg(cap);
+    if (f == nullptr) return nullptr;
+    FrameTxPool* tp = txpool_arg_opt(tpobj);
+    if (tp == nullptr && tpobj != Py_None) return nullptr;
+    static thread_local std::string buf;
+    buf.clear();
+    if (!frame_pack_to(buf, f, tp)) return nullptr;
+    Py_ssize_t at = PyByteArray_GET_SIZE(target);
+    if (PyByteArray_Resize(target, at + (Py_ssize_t)buf.size()) < 0)
+        return nullptr;
+    std::memcpy(PyByteArray_AS_STRING(target) + at, buf.data(), buf.size());
+    return PyLong_FromSsize_t((Py_ssize_t)buf.size());
+}
+
+PyObject* py_frame_unpack(PyObject*, PyObject* args) {
+    PyObject* src;
+    PyObject* rpobj = Py_None;
+    if (!PyArg_ParseTuple(args, "O|O", &src, &rpobj)) return nullptr;
+    FrameRxPool* rp = nullptr;
+    if (rpobj != Py_None) {
+        rp = static_cast<FrameRxPool*>(
+            PyCapsule_GetPointer(rpobj, kRxPoolCap));
+        if (rp == nullptr) return nullptr;
+    }
+    Py_buffer view;
+    if (PyObject_GetBuffer(src, &view, PyBUF_SIMPLE) < 0) return nullptr;
+    const uint8_t* p = static_cast<const uint8_t*>(view.buf);
+    const uint8_t* end = p + view.len;
+    std::unique_ptr<Frame> f(new Frame());
+
+    auto truncated = [&view]() -> PyObject* {
+        PyBuffer_Release(&view);
+        if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_ValueError, "truncated columnar frame");
+        return nullptr;
+    };
+    auto need = [&p, end](size_t n) { return (size_t)(end - p) >= n; };
+
+    if (!need(13)) return truncated();
+    if (p[0] != kFrameMagic || p[1] != kFrameVersion) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_ValueError, "bad columnar frame header");
+        return nullptr;
+    }
+    uint8_t flags = p[2];
+    uint32_t n_rows;
+    uint16_t n_cols;
+    uint32_t n_pool;
+    std::memcpy(&n_rows, p + 3, 4);
+    std::memcpy(&n_cols, p + 7, 2);
+    std::memcpy(&n_pool, p + 9, 4);
+    p += 13;
+    if (n_rows > (uint32_t)INT32_MAX) return truncated();
+    f->n_rows = (int64_t)n_rows;
+    f->all_plus = (flags & 1) != 0;
+    f->keys_lazy = (flags & 2) != 0;
+    if (f->keys_lazy) {
+        if (!need(2)) return truncated();
+        uint16_t ns;
+        std::memcpy(&ns, p, 2);
+        p += 2;
+        if (ns != sizeof(pwnative::Blake2bState)) {
+            PyBuffer_Release(&view);
+            PyErr_SetString(PyExc_ValueError,
+                            "columnar frame hash-state size mismatch");
+            return nullptr;
+        }
+        if (!need(sizeof(pwnative::Blake2bState) + 8 + (size_t)n_rows * 8))
+            return truncated();
+        std::memcpy(&f->key_base, p, sizeof(pwnative::Blake2bState));
+        p += sizeof(pwnative::Blake2bState);
+        uint64_t off;
+        std::memcpy(&off, p, 8);
+        p += 8;
+        f->key_offset = (int64_t)off;
+        f->key_seqs.resize(n_rows);
+        std::memcpy(f->key_seqs.data(), p, (size_t)n_rows * 8);
+        p += (size_t)n_rows * 8;
+    } else {
+        if (!need((size_t)n_rows * 16)) return truncated();
+        f->keyb.assign(p, p + (size_t)n_rows * 16);
+        p += (size_t)n_rows * 16;
+    }
+    if (!f->all_plus) {
+        if (!need(n_rows)) return truncated();
+        f->diffs.resize(n_rows);
+        std::memcpy(f->diffs.data(), p, n_rows);
+        p += n_rows;
+    }
+    f->pool.reserve(n_pool);
+    for (uint32_t s = 0; s < n_pool; s++) {
+        if (!need(1)) return truncated();
+        uint8_t kind = *p++;
+        if (kind == 0) {
+            if (!need(4)) return truncated();
+            uint32_t len;
+            std::memcpy(&len, p, 4);
+            p += 4;
+            if (!need(len)) return truncated();
+            PyObject* str = PyUnicode_DecodeUTF8(
+                reinterpret_cast<const char*>(p), (Py_ssize_t)len, nullptr);
+            if (str == nullptr) return truncated();
+            p += len;
+            // rx-pool mirror of the encoder's insert-on-first-sight
+            if (rp != nullptr && rp->strs.size() < kFramePoolShareCap) {
+                Py_INCREF(str);
+                rp->strs.push_back(str);
+            }
+            f->pool.push_back(str);
+        } else if (kind == 1) {
+            if (!need(4)) return truncated();
+            uint32_t ref;
+            std::memcpy(&ref, p, 4);
+            p += 4;
+            if (rp == nullptr || ref >= rp->strs.size()) {
+                PyBuffer_Release(&view);
+                PyErr_SetString(PyExc_ValueError,
+                                "bad string pool ref in columnar frame");
+                return nullptr;
+            }
+            PyObject* str = rp->strs[ref];
+            Py_INCREF(str);
+            f->pool.push_back(str);
+        } else {
+            PyBuffer_Release(&view);
+            PyErr_SetString(PyExc_ValueError,
+                            "bad pool entry kind in columnar frame");
+            return nullptr;
+        }
+    }
+    f->cols.resize(n_cols);
+    for (uint16_t c = 0; c < n_cols; c++) {
+        if (!need(2)) return truncated();
+        uint8_t tag = p[0];
+        uint8_t has_valid = p[1];
+        p += 2;
+        FrameCol& col = f->cols[c];
+        col.tag = tag;
+        switch (tag) {
+            case CF_I64:
+                if (!need((size_t)n_rows * 8)) return truncated();
+                col.i64.resize(n_rows);
+                std::memcpy(col.i64.data(), p, (size_t)n_rows * 8);
+                p += (size_t)n_rows * 8;
+                break;
+            case CF_F64:
+                if (!need((size_t)n_rows * 8)) return truncated();
+                col.f64.resize(n_rows);
+                std::memcpy(col.f64.data(), p, (size_t)n_rows * 8);
+                p += (size_t)n_rows * 8;
+                break;
+            case CF_STR:
+                if (!need((size_t)n_rows * 4)) return truncated();
+                col.sidx.resize(n_rows);
+                std::memcpy(col.sidx.data(), p, (size_t)n_rows * 4);
+                p += (size_t)n_rows * 4;
+                for (uint32_t v : col.sidx) {
+                    if (v >= f->pool.size()) {
+                        PyBuffer_Release(&view);
+                        PyErr_SetString(
+                            PyExc_ValueError,
+                            "string index out of range in columnar frame");
+                        return nullptr;
+                    }
+                }
+                break;
+            case CF_BOOL:
+                if (!need(n_rows)) return truncated();
+                col.b8.resize(n_rows);
+                for (uint32_t i = 0; i < n_rows; i++)
+                    col.b8[i] = p[i] ? 1 : 0;
+                p += n_rows;
+                break;
+            default:
+                PyBuffer_Release(&view);
+                PyErr_SetString(PyExc_ValueError,
+                                "bad column tag in columnar frame");
+                return nullptr;
+        }
+        if (has_valid) {
+            if (!need(n_rows)) return truncated();
+            col.valid.resize(n_rows);
+            for (uint32_t i = 0; i < n_rows; i++)
+                col.valid[i] = p[i] ? 1 : 0;
+            p += n_rows;
+        }
+    }
+    if (p != end) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_ValueError,
+                        "trailing bytes after columnar frame");
+        return nullptr;
+    }
+    PyBuffer_Release(&view);
+    return frame_to_capsule(f.release());
+}
+
 PyMethodDef kMethods[] = {
+    {"frame_from_updates", py_frame_from_updates, METH_O,
+     "columnarize an update batch into a frame capsule"},
+    {"frame_to_updates", py_frame_to_updates, METH_O,
+     "materialize a frame capsule back into a list of Updates"},
+    {"frame_len", py_frame_len, METH_O, "row count of a frame"},
+    {"frame_nbytes", py_frame_nbytes, METH_O,
+     "approximate in-memory size of a frame"},
+    {"frame_ncols", py_frame_ncols, METH_O, "value column count of a frame"},
+    {"frame_all_plus", py_frame_all_plus, METH_O,
+     "True iff every row diff in the frame is +1"},
+    {"frame_slice", py_frame_slice, METH_VARARGS,
+     "row-range copy of a frame (shared string pool)"},
+    {"frame_parse_jsonl", py_frame_parse_jsonl, METH_VARARGS,
+     "parse a block of JSONL lines directly into a frame (None = fallback)"},
+    {"frame_groupby_partials", py_frame_groupby_partials, METH_VARARGS,
+     "per-group partial aggregates of a frame (same output as "
+     "groupby_partials)"},
+    {"frame_route_split", py_frame_route_split, METH_VARARGS,
+     "split a frame into W per-destination frames (route_split parity)"},
+    {"frame_project", py_frame_project, METH_VARARGS,
+     "select value columns of a frame by position"},
+    {"frame_filter", py_frame_filter, METH_VARARGS,
+     "keep frame rows where column <op> constant"},
+    {"frame_pack", py_frame_pack, METH_VARARGS,
+     "serialize a frame to wire bytes (optional tx string pool)"},
+    {"frame_pack_into", py_frame_pack_into, METH_VARARGS,
+     "append a frame's wire bytes to a bytearray, returning the length"},
+    {"frame_unpack", py_frame_unpack, METH_VARARGS,
+     "decode wire bytes into a frame (optional rx string pool)"},
+    {"frame_txpool_new", py_frame_txpool_new, METH_NOARGS,
+     "new per-transmission string pool for frame_pack"},
+    {"frame_txpool_stats", py_frame_txpool_stats, METH_O,
+     "(hits, misses) of a tx string pool"},
+    {"frame_rxpool_new", py_frame_rxpool_new, METH_NOARGS,
+     "new per-transmission string pool for frame_unpack"},
     {"ref_scalar", py_ref_scalar, METH_VARARGS,
      "128-bit key hash of the argument values"},
     {"hash_rows", py_hash_rows, METH_O,

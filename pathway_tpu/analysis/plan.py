@@ -56,6 +56,10 @@ class ExecutionPlan:
         self.steps: list[RewriteStep] = []
         self.nodes_before = 0
         self.nodes_after = 0
+        #: per-operator columnar decisions: (node_label, path, reason)
+        #: where path is "columnar" or "row" and reason explains a row
+        #: fallback (empty for columnar).  Golden-tested like steps.
+        self.columnar: list[tuple[str, str, str]] = []
 
     def record(self, pass_name: str, nodes: list[Any], detail: str = "") -> None:
         """Append one step; ``nodes`` may be engine nodes (labelled
@@ -64,6 +68,21 @@ class ExecutionPlan:
             n if isinstance(n, str) else f"{n.name}#{n.id}" for n in nodes
         ]
         self.steps.append(RewriteStep(pass_name, labels, detail))
+
+    def record_columnar(self, node: Any, path: str, reason: str = "") -> None:
+        """Record one operator's batch-execution decision ("columnar" =
+        frame segments run native kernels; "row" = the operator
+        materializes frames and runs row-at-a-time, with ``reason``)."""
+        label = node if isinstance(node, str) else f"{node.name}#{node.id}"
+        self.columnar.append((label, path, reason))
+
+    def columnar_lines(self) -> list[str]:
+        """The per-operator decision lines (shared by ``format()`` and
+        the ``/status`` plan block)."""
+        return [
+            f"{label}: {path}" + (f" [{reason}]" if reason else "")
+            for label, path, reason in self.columnar
+        ]
 
     def counters(self) -> dict[str, int]:
         out: dict[str, int] = {}
@@ -91,6 +110,9 @@ class ExecutionPlan:
                 "counters: "
                 + " ".join(f"{k}={v}" for k, v in sorted(counters.items()))
             )
+        if self.columnar:
+            lines.append("columnar:")
+            lines.extend("  " + ln for ln in self.columnar_lines())
         return "\n".join(lines)
 
     def __str__(self) -> str:

@@ -694,6 +694,102 @@ def _pass_pushdown_projection(view: _GraphView, plan: ExecutionPlan) -> None:
 
 
 # ---------------------------------------------------------------------------
+# columnar path annotation (the static half of frame execution)
+
+#: FilterNode comparison ops with a native frame kernel, by expression
+#: operator string -> native FrameCmp code (frame_filter's ``op``)
+_FRAME_CMP_OPS = {"==": 0, "!=": 1, "<": 2, "<=": 3, ">": 4, ">=": 5}
+
+
+def _columnar_select_spec(n: eg.Node) -> "tuple | str":
+    """Positional projection tuple if every output column of a select is
+    a plain column reference, else the row-fallback reason string."""
+    if n.meta.get("plan_fused") is not None:
+        return "fused program (expression VM)"
+    sel = n.meta.get("select")
+    if not sel or sel.get("kind") not in ("select", "with_columns"):
+        return "non-select rowwise program"
+    exprs, layout = sel.get("exprs"), sel.get("layout")
+    if exprs is None or layout is None:
+        return "no expression metadata"
+    poses = []
+    for e in exprs:
+        if type(e) is not ex.ColumnReference:
+            return f"computed column (expression VM): {type(e).__name__}"
+        pos = layout.resolve_pos(e)
+        if pos is None or pos < 0:
+            return "key-derived column"
+        poses.append(pos)
+    return tuple(poses)
+
+
+def _columnar_filter_spec(n: eg.Node) -> "tuple | str":
+    """(pos, op, const) for a single col-cmp-const predicate, else the
+    row-fallback reason string."""
+    if n.meta.get("plan_fused") is not None:
+        return "fused predicate (expression VM)"
+    flt = n.meta.get("filter")
+    if not flt:
+        return "no predicate metadata (expression VM)"
+    exprs, layout = flt.get("exprs"), flt.get("layout")
+    if not exprs or layout is None:
+        return "no predicate metadata"
+    e = exprs[0]
+    if (
+        type(e) is not ex.BinaryExpression
+        or e._op not in _FRAME_CMP_OPS
+        or type(e._left) is not ex.ColumnReference
+        or type(e._right) is not ex.ConstExpression
+    ):
+        return "predicate not col-cmp-const (expression VM)"
+    pos = layout.resolve_pos(e._left)
+    if pos is None or pos < 0:
+        return "key-derived predicate column"
+    return (pos, _FRAME_CMP_OPS[e._op], e._right._value)
+
+
+def _pass_columnar(view: _GraphView, plan: ExecutionPlan) -> None:
+    """Record every operator's batch-execution decision and arm the
+    frame fast paths the kernels support: pure-projection selects
+    (``frame_project``) and col-cmp-const filters (``frame_filter``).
+    Input and groupby decisions were fixed at graph build time
+    (``supports_columnar`` / ``fast_spec``); this pass makes them
+    visible in the plan next to the ones it decides itself."""
+    for n in view.nodes:
+        t = type(n)
+        if t is eg.InputNode:
+            if n.supports_columnar:
+                plan.record_columnar(n, "columnar")
+            else:
+                plan.record_columnar(
+                    n, "row", "upsert stream keeps per-key state"
+                )
+        elif t is eg.GroupByNode:
+            if n.fast_spec is not None:
+                plan.record_columnar(n, "columnar")
+            else:
+                plan.record_columnar(
+                    n, "row", "reducer or grouping not native-positional"
+                )
+        elif t is eg.RowwiseNode:
+            spec = _columnar_select_spec(n)
+            if isinstance(spec, tuple):
+                n.frame_project = spec
+                n.supports_columnar = True
+                plan.record_columnar(n, "columnar")
+            else:
+                plan.record_columnar(n, "row", spec)
+        elif t is eg.FilterNode:
+            spec = _columnar_filter_spec(n)
+            if isinstance(spec, tuple):
+                n.frame_filter_spec = spec
+                n.supports_columnar = True
+                plan.record_columnar(n, "columnar")
+            else:
+                plan.record_columnar(n, "row", spec)
+
+
+# ---------------------------------------------------------------------------
 # pipeline
 
 
@@ -724,6 +820,9 @@ def optimize_graph(
         _pass_pushdown_filters(view, plan)
     _pass_fuse_selects(view, plan)
     _pass_fuse_filters(view, plan)
+    # after all rewrites: decide + record the frame/row path per operator
+    # on the FINAL shape of each node's program
+    _pass_columnar(view, plan)
     exec_graph = view.finish()
     plan.nodes_after = len(exec_graph.nodes)
     return exec_graph, plan

@@ -79,6 +79,7 @@ import time as _time
 from collections import deque
 from typing import Any, Callable
 
+from pathway_tpu.engine.columnar import ColumnarBatch, extend_batch
 from pathway_tpu.internals import keys as K
 from pathway_tpu.internals import native as _native_mod
 from pathway_tpu.internals import tracing as _tracing
@@ -151,6 +152,24 @@ def _est_boxes_bytes(boxes: list) -> int:
     return 96 + 56 * n
 
 
+def _est_frame_boxes_bytes(boxes: list, native: Any) -> int:
+    """Wire-size estimate for columnar boxes: frame segments are priced
+    by their actual column-buffer footprint (fixed-width columns make
+    this nearly exact), row segments by the per-update constant."""
+    n = 96
+    for row in boxes:
+        for box in row:
+            if isinstance(box, ColumnarBatch):
+                for kind, seg in box.segments:
+                    if kind == "f":
+                        n += native.frame_nbytes(seg) + 32
+                    else:
+                        n += 56 * len(seg)
+            else:
+                n += 56 * len(box)
+    return n
+
+
 class WakeupHub:
     """Shared wakeup channel for the event-driven scheduler loops.
 
@@ -204,6 +223,13 @@ def stable_shard(*values: Any) -> int:
 _K_OBJ = 0      # [u64 len] pickle — statuses, gathers, control objects
 _K_UPDATES = 1  # [u16 n_src][u16 n_dst] ([u64 len] packed_updates)* — binary
 _K_PLAIN = 2    # [u64 len] pickle of plain (int_key, values, diff) boxes
+#: columnar boxes: [u16 n_src][u16 n_dst], then per box [u16 n_segments]
+#: and per segment [u8 tag (0=rows,1=frame)][u64 len][payload] — frame
+#: segments ship the zero-copy column buffers (native frame codec) with
+#: ONE string pool per transmission (TxPool on encode, the symmetric
+#: RxPool on decode: identical insert order, so pool refs resolve by
+#: index with no per-slot re-sending of repeated strings)
+_K_FRAME = 3
 
 
 class _PeerSender(threading.Thread):
@@ -368,22 +394,71 @@ class _PeerSender(threading.Thread):
         DATA (update-box) messages in it — the unit the credit protocol
         accounts in on both sides (the receiver measures the identical
         spans while decoding)."""
+        native = _native_mod.load()
+        txpool = None
+        if native is not None and any(k == _K_FRAME for _s, k, _p in items):
+            # one string pool per transmission: frames encoded in msg
+            # order, so the receiver's RxPool (same order) resolves pool
+            # refs by index — repeated strings cross the wire once
+            txpool = native.frame_txpool_new()
+        try:
+            return self._encode_into(items, native, txpool)
+        except Exception:
+            if txpool is None:
+                raise
+            # a frame msg failed mid-encode: the shared pool may hold
+            # inserts whose bytes never shipped, so pool refs from later
+            # frames would skew on the receiver — rebuild the WHOLE
+            # transmission on the row path (no pool, self-contained msgs)
+            items = [
+                (
+                    slot,
+                    _K_UPDATES,
+                    [
+                        [
+                            box.to_list()
+                            if isinstance(box, ColumnarBatch)
+                            else box
+                            for box in row
+                        ]
+                        for row in payload
+                    ],
+                )
+                if kind == _K_FRAME
+                else (slot, kind, payload)
+                for slot, kind, payload in items
+            ]
+            return self._encode_into(items, native, None)
+
+    def _encode_into(
+        self, items: list, native: Any, txpool: Any
+    ) -> tuple[bytearray, int]:
         buf = self._buf
         del buf[:]  # reset length, keep capacity across epochs
         buf += b"\x00" * 12  # u64 body_len + u32 n_msgs, patched below
-        native = _native_mod.load()
         data_bytes = 0
         for slot, kind, payload in items:
             before = len(buf)
-            self._encode_msg(buf, slot, kind, payload, native)
-            if kind == _K_UPDATES:
+            self._encode_msg(buf, slot, kind, payload, native, txpool)
+            if kind in (_K_UPDATES, _K_FRAME):
                 data_bytes += len(buf) - before
         struct.pack_into("<QI", buf, 0, len(buf) - 8, len(items))
+        if txpool is not None:
+            hits, misses = native.frame_txpool_stats(txpool)
+            with self.links.stats_lock:
+                st = self.links.stats
+                st["strpool_hits"] += hits
+                st["strpool_misses"] += misses
         return buf, data_bytes
 
     @staticmethod
     def _encode_msg(
-        buf: bytearray, slot: Any, kind: int, payload: Any, native: Any
+        buf: bytearray,
+        slot: Any,
+        kind: int,
+        payload: Any,
+        native: Any,
+        txpool: Any = None,
     ) -> None:
         slot_data = pickle.dumps(slot, protocol=pickle.HIGHEST_PROTOCOL)
         buf += struct.pack("<I", len(slot_data))
@@ -393,6 +468,44 @@ class _PeerSender(threading.Thread):
             buf += struct.pack("<BQ", _K_OBJ, len(data))
             buf += data
             return
+        if kind == _K_FRAME and native is not None:
+            # columnar boxes: frame segments append their column buffers
+            # verbatim (no per-row boxing), row segments ride the update
+            # codec.  A failure here must NOT fall back per-msg — the
+            # transmission's shared string pool may already hold inserts
+            # from the torn msg — so it propagates and _encode rebuilds
+            # the whole transmission on the row path.
+            n_src = len(payload)
+            n_dst = len(payload[0]) if n_src else 0
+            buf += struct.pack("<BHH", _K_FRAME, n_src, n_dst)
+            pack_rows = native.pack_updates_into
+            pack_frame = native.frame_pack_into
+            for row in payload:
+                for box in row:
+                    segs = (
+                        box.segments
+                        if isinstance(box, ColumnarBatch)
+                        else ([("r", box)] if box else [])
+                    )
+                    buf += struct.pack("<H", len(segs))
+                    for tag, seg in segs:
+                        buf += b"\x01" if tag == "f" else b"\x00"
+                        at = len(buf)
+                        buf += b"\x00" * 8
+                        if tag == "f":
+                            n = pack_frame(seg, buf, txpool)
+                        else:
+                            n = pack_rows(seg, buf)
+                        struct.pack_into("<Q", buf, at, n)
+            return
+        if kind == _K_FRAME:
+            payload = [
+                [
+                    box.to_list() if isinstance(box, ColumnarBatch) else box
+                    for box in row
+                ]
+                for row in payload
+            ]
         # update boxes: payload[src_tid][dst_tid] is a list of Updates.
         # Binary frames append straight into the transmission buffer (one
         # C++ pass per box, length patched after the fact); a box the
@@ -537,6 +650,10 @@ class _ProcessLinks:
             "pack_ms": 0.0,
             "send_ms": 0.0,
             "unpack_ms": 0.0,
+            # per-transmission string-pool effectiveness of the columnar
+            # wire: a hit is a string that crossed as a u32 pool ref
+            "strpool_hits": 0,
+            "strpool_misses": 0,
         }
         self.stats_lock = threading.Lock()
 
@@ -916,6 +1033,7 @@ class _ProcessLinks:
         (n_msgs,) = struct.unpack_from("<I", mv, 0)
         off = 4
         out = []
+        rxpool = None  # per-transmission, mirrors the sender's TxPool
         for _ in range(n_msgs):
             msg_start = off
             (slot_len,) = struct.unpack_from("<I", mv, off)
@@ -924,6 +1042,61 @@ class _ProcessLinks:
             off += slot_len
             kind = mv[off]
             off += 1
+            if kind == _K_FRAME:
+                if native is None:
+                    raise RuntimeError(
+                        "cluster exchange: peer sent columnar frames but "
+                        "the native module is unavailable in this process"
+                    )
+                if rxpool is None:
+                    rxpool = native.frame_rxpool_new()
+                n_src, n_dst = struct.unpack_from("<HH", mv, off)
+                off += 4
+                boxes = []
+                for _s in range(n_src):
+                    row = []
+                    for _d in range(n_dst):
+                        (n_segs,) = struct.unpack_from("<H", mv, off)
+                        off += 2
+                        parts = []
+                        any_frame = False
+                        for _g in range(n_segs):
+                            tag = mv[off]
+                            off += 1
+                            (blen,) = struct.unpack_from("<Q", mv, off)
+                            off += 8
+                            span = mv[off : off + blen]
+                            off += blen
+                            if tag == 1:
+                                any_frame = True
+                                parts.append(
+                                    ("f", native.frame_unpack(span, rxpool))
+                                )
+                            else:
+                                parts.append(
+                                    ("r", native.unpack_updates(span))
+                                )
+                        if not any_frame:
+                            # pure row box: hand workers the plain list
+                            # they have always received
+                            rows_only: list = (
+                                parts[0][1] if len(parts) == 1 else []
+                            )
+                            if len(parts) > 1:
+                                for _t, p in parts:
+                                    rows_only.extend(p)
+                            row.append(rows_only)
+                        else:
+                            cb = ColumnarBatch()
+                            for t, p in parts:
+                                if t == "f":
+                                    cb.append_frame(p)
+                                else:
+                                    cb.extend(p)
+                            row.append(cb)
+                    boxes.append(row)
+                out.append((slot, boxes, off - msg_start))
+                continue
             if kind == _K_UPDATES:
                 if native is None:
                     # peer packed binary frames we cannot parse (native
@@ -1098,6 +1271,22 @@ class _ProcessLinks:
         sender = self._senders.get(peer)
         if sender is not None:
             sender.enqueue(slot, _K_UPDATES, boxes, est=est)
+
+    def send_frames_async(self, peer: int, slot: Any, boxes: list) -> None:
+        """Queue a columnar-box frame (``boxes[src_tid][dst_tid]`` lists
+        of Updates OR :class:`ColumnarBatch`); frame segments are packed
+        zero-copy on the sender thread.  Same credit discipline as
+        ``send_updates_async`` — columnar data is still data."""
+        native = _native_mod.load()
+        if native is None:
+            # no native codec, so no frames exist to preserve anyway
+            return self.send_updates_async(peer, slot, boxes)
+        est = _est_frame_boxes_bytes(boxes, native)
+        if self.credit_bytes > 0:
+            self._wait_for_credit(peer, est)
+        sender = self._senders.get(peer)
+        if sender is not None:
+            sender.enqueue(slot, _K_FRAME, boxes, est=est)
 
     def recv_from_all(self, slot: Any) -> dict[int, Any]:
         """Block until every *live* peer delivered a payload for ``slot``.
@@ -1376,7 +1565,14 @@ class Cluster:
                         ]
                         for src_tid in range(T)
                     ]
-                    self._links.send_updates_async(peer, slot, boxes)
+                    if any(
+                        isinstance(b, ColumnarBatch)
+                        for row in boxes
+                        for b in row
+                    ):
+                        self._links.send_frames_async(peer, slot, boxes)
+                    else:
+                        self._links.send_updates_async(peer, slot, boxes)
                 t0 = _time.perf_counter()
                 t0_ns = _time.monotonic_ns()
                 remote = self._links.recv_from_all(slot)
@@ -1401,7 +1597,9 @@ class Cluster:
                     for src_tid in range(T):
                         boxes = local[src_tid]
                         for dst_tid in range(T):
-                            merged[dst_tid].extend(boxes[base + dst_tid])
+                            merged[dst_tid] = extend_batch(
+                                merged[dst_tid], boxes[base + dst_tid]
+                            )
                 else:
                     rows = remote.get(src_pid)  # decoded by the reader
                     if rows is None:
@@ -1409,7 +1607,9 @@ class Cluster:
                     for src_tid in range(T):
                         row = rows[src_tid]
                         for dst_tid in range(T):
-                            merged[dst_tid].extend(row[dst_tid])
+                            merged[dst_tid] = extend_batch(
+                                merged[dst_tid], row[dst_tid]
+                            )
             with self._lock:
                 self._merged[slot] = merged
         self._barrier.wait()

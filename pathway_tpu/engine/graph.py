@@ -126,6 +126,13 @@ class Node:
     #: nodes that want a `process` call every epoch even with empty input
     always_tick = False
 
+    #: True when :meth:`process` understands
+    #: :class:`~pathway_tpu.engine.columnar.ColumnarBatch` inputs (frame
+    #: segments consumed by native kernels); the scheduler materializes
+    #: frames to row lists before calling any node that leaves this False
+    #: — the Python-UDF row-at-a-time fallback
+    supports_columnar = False
+
     def __init__(self, graph: "EngineGraph", inputs: Sequence["Node"], name: str = ""):
         self.graph = graph
         self.inputs = list(inputs)
@@ -220,6 +227,9 @@ class InputNode(Node):
         self.static_rows = list(static_rows)
         self.subject = subject
         self.upsert = upsert
+        # upsert sessions walk per-row state; only the plain append
+        # stream can pass frames through untouched
+        self.supports_columnar = not upsert
 
     def exchange_routes(self):
         return [cl.route_by_key] if self.upsert else None
@@ -231,6 +241,16 @@ class InputNode(Node):
         # inbatches[0] is the externally injected batch for this epoch
         raw = inbatches[0] if inbatches else []
         if not self.upsert:
+            from pathway_tpu.engine.columnar import ColumnarBatch
+
+            if isinstance(raw, ColumnarBatch):
+                # frame passthrough: append-only frames flow downstream
+                # columnar (the header's all_plus flag makes the check
+                # O(segments)); anything with retractions materializes
+                # for the consolidation pass below
+                if raw.all_plus():
+                    return raw
+                raw = raw.to_list()
             if not isinstance(raw, list):
                 raw = list(raw)  # the all() scan below must not consume it
             # append-only batch (no retractions): consolidation is a
@@ -271,6 +291,12 @@ class RowwiseNode(Node):
     """expression_table (reference ``Graph::expression_table``): compute a new
     tuple of columns for each row via compiled expression closures."""
 
+    #: positional projection tuple set by the plan compiler
+    #: (analysis/rewrite._pass_columnar) when every output column is a
+    #: plain column reference — arms the frame_project fast path (and
+    #: supports_columnar with it)
+    frame_project: "tuple | None" = None
+
     def __init__(
         self,
         graph: EngineGraph,
@@ -310,6 +336,30 @@ class RowwiseNode(Node):
         return self._checker
 
     def process(self, ctx, time, inbatches):
+        from pathway_tpu.engine.columnar import ColumnarBatch
+
+        batch = inbatches[0]
+        if isinstance(batch, ColumnarBatch):
+            check = self._typecheck()
+            native = _native.load()
+            if (
+                self.frame_project is None
+                or check is not None
+                or native is None
+            ):
+                inbatches = [batch.to_list()]
+            else:
+                # pure projection: column copies per frame segment, row
+                # segments ride the existing row kernels below
+                out = ColumnarBatch()
+                for kind, seg in batch.segments:
+                    if kind == "f":
+                        out.append_frame(
+                            native.frame_project(seg, self.frame_project)
+                        )
+                    elif seg:
+                        out.extend(self.process(ctx, time, [seg]))
+                return out
         fn = self.row_fn
         check = self._typecheck()
         native = _native.load()
@@ -346,6 +396,10 @@ class RowwiseNode(Node):
 
 
 class FilterNode(Node):
+    #: (pos, cmp_op, const) set by the plan compiler for a single
+    #: col-cmp-const predicate — arms the frame_filter fast path
+    frame_filter_spec: "tuple | None" = None
+
     def __init__(
         self,
         graph: EngineGraph,
@@ -385,6 +439,30 @@ class FilterNode(Node):
         return n
 
     def process(self, ctx, time, inbatches):
+        from pathway_tpu.engine.columnar import ColumnarBatch
+
+        batch = inbatches[0]
+        if isinstance(batch, ColumnarBatch):
+            native = _native.load()
+            spec = self.frame_filter_spec
+            if native is None or spec is None:
+                inbatches = [batch.to_list()]
+            else:
+                out = ColumnarBatch()
+                for kind, seg in batch.segments:
+                    if kind == "f":
+                        try:
+                            out.append_frame(
+                                native.frame_filter(seg, *spec)
+                            )
+                            continue
+                        except native.Unsupported:
+                            # e.g. int column vs float const: exact
+                            # arithmetic parity needs the row semantics
+                            seg = native.frame_to_updates(seg)
+                    if seg:
+                        out.extend(self.process(ctx, time, [seg]))
+                return out
         pred = self.pred
         native = _native.load()
         if native is not None:
@@ -670,6 +748,9 @@ class GroupByNode(Node):
         #: aggregation path (groupbys.py builds it when every grouping and
         #: reducer argument is a plain positional column)
         self.fast_spec = fast_spec
+        # frame segments reduce via frame_groupby_partials, which needs
+        # the same positional spec as the row-batch partials kernel
+        self.supports_columnar = fast_spec is not None
 
     def exchange_routes(self):
         route = cl.route_by(self.group_fn)
@@ -748,6 +829,13 @@ class GroupByNode(Node):
         except native.Unsupported:
             return None
         dirty: dict[Any, Any] = {}
+        self._merge_partials(st, partials, dirty)
+        return dirty
+
+    def _merge_partials(self, st, partials: dict, dirty: dict) -> None:
+        """Fold a per-group partials dict (the shared output format of
+        ``groupby_partials`` and ``frame_groupby_partials``) into the
+        live group accumulators, marking touched groups dirty."""
         reducer_args = self.reducer_args
         for gvals, (cdelta, parts) in partials.items():
             gh, g = self._group(st, gvals)
@@ -755,11 +843,46 @@ class GroupByNode(Node):
             for (reducer, _), acc, part in zip(reducer_args, g["accs"], parts):
                 reducer.merge_partial(acc, part)
             dirty[gh] = g
-        return dirty
 
     def process(self, ctx, time, inbatches):
+        from pathway_tpu.engine.columnar import ColumnarBatch
+
         st = ctx.state(self)
         batch = inbatches[0]
+        frame_dirty: dict[Any, Any] = {}
+        if isinstance(batch, ColumnarBatch):
+            # frame segments: one native pass per frame producing the
+            # SAME partials dict as the row kernel — no Update objects,
+            # no per-row key hashing (groupby never looks at row keys
+            # when grouping by columns, so lazy frame keys stay lazy).
+            # Frames cannot hold the ERROR sentinel by construction, so
+            # the error-poisoning scan below applies only to row
+            # segments.  Unsupported frames (overflow, odd types) fall
+            # back to rows individually.
+            from pathway_tpu.internals import native as _native
+
+            native = _native.load()
+            rows: list = []
+            for seg_kind, seg in batch.segments:
+                if seg_kind != "f":
+                    rows.extend(seg)
+                    continue
+                partials = None
+                if self.fast_spec is not None and native is not None:
+                    try:
+                        partials = native.frame_groupby_partials(
+                            seg,
+                            self.fast_spec[0],
+                            self.fast_spec[1],
+                            api.ERROR,
+                        )
+                    except native.Unsupported:
+                        partials = None
+                if partials is None:
+                    rows.extend(native.frame_to_updates(seg))
+                else:
+                    self._merge_partials(st, partials, frame_dirty)
+            batch = rows
         if not isinstance(batch, list):
             batch = list(batch)  # Unsupported fallback must re-iterate
         # ERROR poisoning (reference reduce.rs: any Error input makes the
@@ -832,6 +955,8 @@ class GroupByNode(Node):
                         errs = g.setdefault("errs", {})
                         errs[ri] = errs.get(ri, 0) + u.diff
                 dirty[gh] = g
+        if frame_dirty:
+            dirty.update(frame_dirty)
         out = []
         for gh, g in dirty.items():
             # output key is a pure function of the group values — hash it

@@ -28,6 +28,7 @@ from collections import defaultdict, deque
 from typing import Any, Callable
 
 from pathway_tpu.engine.cluster import Cluster, epoch_trace_context
+from pathway_tpu.engine.columnar import ColumnarBatch, extend_batch
 from pathway_tpu.engine.graph import EngineGraph, InputNode, Node, RunContext
 from pathway_tpu.engine.stream import TIME_STEP, Batch, Update
 from pathway_tpu.internals import api
@@ -121,6 +122,9 @@ def _approx_event_bytes(kind: str, key: Any, values: Any) -> int:
     million-row chunk costs a bounded probe, not a deep walk."""
     if kind == "batch":
         return approx_state_bytes(key, depth=3) + 64
+    if kind == "frame":
+        native = _native.load()
+        return (native.frame_nbytes(key) if native is not None else 0) + 64
     return approx_state_bytes(values, depth=2) + 96
 
 
@@ -294,6 +298,16 @@ class IngestCredit:
             }
 
 
+def _buffer_frame(buffers: dict, nid: int, cap: Any) -> None:
+    """Append a native frame to a per-source drain buffer, promoting the
+    plain row list to a :class:`ColumnarBatch` on first frame arrival."""
+    buf = buffers[nid]
+    if not isinstance(buf, ColumnarBatch):
+        buf = ColumnarBatch.from_rows(buf)
+        buffers[nid] = buf
+    buf.append_frame(cap)
+
+
 class ConnectorEvents:
     """Callback bundle handed to a connector subject's reader thread.
 
@@ -341,8 +355,13 @@ class ConnectorEvents:
 
     def _put(self, kind: str, key: Any, values: Any) -> None:
         seq = None
-        if self._credit is not None and kind in ("add", "remove", "batch"):
-            nrows = len(key) if kind == "batch" else 1
+        if self._credit is not None and kind in ("add", "remove", "batch", "frame"):
+            if kind == "batch":
+                nrows = len(key)
+            elif kind == "frame":
+                nrows = _native.load().frame_len(key)
+            else:
+                nrows = 1
             seq = self._credit.charge(
                 self._node_id,
                 _approx_event_bytes(kind, key, values),
@@ -374,6 +393,18 @@ class ConnectorEvents:
         if rows:
             self.stats["rows"] += len(rows)
             self._put("batch", _build_adds(rows), None)
+
+    def add_frame(self, cap: Any) -> None:
+        """Columnar ingest: one native frame (contiguous typed columns +
+        interned string pool, lazy row keys) delivered as ONE queue item.
+        The frame stays columnar through the drain, routing, and the
+        frame-aware operators — no per-row Update objects are built
+        unless a downstream operator falls back to the row path."""
+        native = _native.load()
+        n = native.frame_len(cap)
+        if n:
+            self.stats["rows"] += n
+            self._put("frame", cap, None)
 
     def commit(self) -> None:
         self.stats["commits"] += 1
@@ -719,6 +750,30 @@ class Scheduler:
             outboxes[0] = batch
             return outboxes
         positional = getattr(route, "positional", None)
+        if isinstance(batch, ColumnarBatch):
+            native = _native.load()
+            if positional is not None and native is not None:
+                try:
+                    cbs = [ColumnarBatch() for _ in range(W)]
+                    spec = tuple(positional)
+                    for seg_kind, seg in batch.segments:
+                        if seg_kind == "f":
+                            # one native pass: byte-identical destinations
+                            # to route_split, children share the pool
+                            for dst, sub in enumerate(
+                                native.frame_route_split(seg, spec, W)
+                            ):
+                                cbs[dst].append_frame(sub)
+                        else:
+                            for dst, sub in enumerate(
+                                native.route_split(seg, spec, W)
+                            ):
+                                if sub:
+                                    cbs[dst].extend(sub)
+                    return cbs
+                except Exception:
+                    pass  # fall through to the materialized row path
+            batch = batch.to_list()
         if positional is not None:
             native = _native.load()
             if native is not None:
@@ -753,7 +808,9 @@ class Scheduler:
         W = cluster.n_workers if cluster is not None else 1
         pending: dict[int, dict[int, list[Update]]] = defaultdict(lambda: defaultdict(list))
         for nid, batch in inject.items():
-            pending[nid][0] = list(batch)
+            pending[nid][0] = (
+                batch if isinstance(batch, ColumnarBatch) else list(batch)
+            )
         for node in self.graph.nodes:
             if active is not None and node.id not in active:
                 continue  # globally idle this epoch: no data can reach it
@@ -769,7 +826,7 @@ class Scheduler:
                     if route is None:
                         continue
                     batch = ins.get(port, ())
-                    if not isinstance(batch, list):
+                    if not isinstance(batch, (list, ColumnarBatch)):
                         batch = list(batch)
                     outboxes = self._route_outboxes(route, batch, W)
                     ins[port] = cluster.exchange(  # type: ignore[union-attr]
@@ -780,6 +837,31 @@ class Scheduler:
                 continue
             n_ports = max(1, len(node.inputs))
             inbatches = [ins.get(i, []) if ins else [] for i in range(n_ports)]
+            # columnar/row seam: a frame batch reaching a row-only operator
+            # materializes HERE (one place), and every routed row is
+            # attributed to its execution path — the
+            # pathway_tpu_columnar_rows_total{path} counter that makes a
+            # silently degraded pipeline (everything on the fallback path)
+            # visible in /metrics and /status
+            rows_in = 0
+            col_in = 0
+            for i, b in enumerate(inbatches):
+                if isinstance(b, ColumnarBatch):
+                    if node.supports_columnar:
+                        col_in += b.frame_rows()
+                        rows_in += len(b)
+                    else:
+                        b = b.to_list()
+                        inbatches[i] = b
+                        rows_in += len(b)
+                else:
+                    rows_in += len(b)
+            if rows_in:
+                cr = ctx.stats.setdefault(
+                    "columnar_rows", {"columnar": 0, "row": 0}
+                )
+                cr["columnar"] += col_in
+                cr["row"] += rows_in - col_in
             t0 = _time.perf_counter()
             try:
                 out = node.process(ctx, time, inbatches)
@@ -826,7 +908,7 @@ class Scheduler:
                             "state_bytes": 0,
                         },
                     )
-            probe["rows_in"] += sum(len(b) for b in inbatches)
+            probe["rows_in"] += rows_in
             probe["rows_out"] += len(out)
             probe["total_ms"] += dt_ms
             probe["max_ms"] = max(probe["max_ms"], dt_ms)
@@ -841,7 +923,11 @@ class Scheduler:
                     probe["state_bytes"] = approx_state_bytes(st)
             if out:
                 for consumer, port in self.consumers.get(node.id, ()):  # fan-out
-                    pending[consumer.id][port].extend(out)
+                    # extend_batch keeps frame segments columnar through
+                    # the fan-out (promoting the pending list if needed)
+                    pending[consumer.id][port] = extend_batch(
+                        pending[consumer.id][port], out
+                    )
         for node in self.graph.nodes:
             node.on_time_end(ctx, time)
         if self.graph.probers:
@@ -1137,6 +1223,30 @@ class Scheduler:
                     else:
                         buffers[nid].extend(key)
                         rows_buffered += len(key)
+                elif kind == "frame":
+                    native = _native.load()
+                    n = native.frame_len(key)
+                    room = self._epoch_max_rows - rows_buffered
+                    if 0 < room < n:
+                        # budget-split: frame_slice shares the string pool
+                        # and keeps keys lazy — two column copies, no rows
+                        _buffer_frame(
+                            buffers, nid, native.frame_slice(key, 0, room)
+                        )
+                        rows_buffered += room
+                        carry.appendleft(
+                            (
+                                nid,
+                                "frame",
+                                native.frame_slice(key, room, n),
+                                values,
+                                enq_ns,
+                                None,
+                            )
+                        )
+                    else:
+                        _buffer_frame(buffers, nid, key)
+                        rows_buffered += n
                 elif kind == "remove":
                     buffers[nid].append(Update(key, values, -1))
                     rows_buffered += 1
@@ -1145,7 +1255,7 @@ class Scheduler:
                     break
                 elif kind == "close":
                     open_subjects.discard(nid)
-                if kind in ("add", "batch", "remove"):
+                if kind in ("add", "batch", "remove", "frame"):
                     data_drained = True
                     if enq_ns is not None:
                         lat.record("ingest", drain_ns - enq_ns)
@@ -1439,6 +1549,28 @@ class Scheduler:
                     else:
                         buffers[nid].extend(key)
                         rows_buffered += len(key)
+                elif kind == "frame":
+                    native = _native.load()
+                    n = native.frame_len(key)
+                    room = self._epoch_max_rows - rows_buffered
+                    if 0 < room < n:
+                        _buffer_frame(
+                            buffers, nid, native.frame_slice(key, 0, room)
+                        )
+                        rows_buffered += room
+                        carry.appendleft(
+                            (
+                                nid,
+                                "frame",
+                                native.frame_slice(key, room, n),
+                                values,
+                                enq_ns,
+                                None,
+                            )
+                        )
+                    else:
+                        _buffer_frame(buffers, nid, key)
+                        rows_buffered += n
                 elif kind == "remove":
                     buffers[nid].append(Update(key, values, -1))
                     rows_buffered += 1
@@ -1447,7 +1579,7 @@ class Scheduler:
                     break
                 elif kind == "close":
                     open_subjects.discard(nid)
-                if kind in ("add", "batch", "remove"):
+                if kind in ("add", "batch", "remove", "frame"):
                     data_drained = True
                     if enq_ns is not None:
                         lat.record("ingest", drain_ns - enq_ns)

@@ -62,6 +62,17 @@ def _metrics_text(sched: Any) -> str:
         metric = "pathway_tpu_" + name.replace(".", "_") + "_total"
         lines.append(f"# TYPE {metric} counter")
         lines.append(f"{metric} {v}")
+    # columnar vs row execution-path row counts (ISSUE 19): a pipeline
+    # silently degraded to the row fallback shows up as path="row"
+    # dominating instead of a latent slowdown
+    colrows = ctx.stats.get("columnar_rows")
+    if colrows:
+        lines.append("# TYPE pathway_tpu_columnar_rows_total counter")
+        for path in ("columnar", "row"):
+            lines.append(
+                f'pathway_tpu_columnar_rows_total{{path="{path}"}} '
+                f"{colrows.get(path, 0)}"
+            )
     # per-operator probes (reference attach_prober, graph.rs:988-995)
     probes = ctx.stats.get("operators", {})
     if probes:
@@ -409,6 +420,7 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
             if self.path.startswith("/status"):
                 srv = _serving_snapshot()
                 fo = srv.get("failover", {})
+                xplan = getattr(sched, "execution_plan", None)
                 body = json.dumps(
                     {
                         "epoch": sched.ctx.time,
@@ -419,15 +431,21 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         "analysis": dict(
                             getattr(sched, "analysis_findings", {}) or {}
                         ),
-                        # plan-compiler rewrite counters + level
+                        # plan-compiler rewrite counters + level, plus
+                        # the per-operator columnar/row path decisions
+                        # and the runtime rows-per-path counter
                         "plan": {
-                            "level": getattr(
-                                getattr(sched, "execution_plan", None),
-                                "level",
-                                0,
-                            ),
+                            "level": getattr(xplan, "level", 0),
                             "rewrites": dict(
                                 getattr(sched, "plan_counters", {}) or {}
+                            ),
+                            "columnar": (
+                                xplan.columnar_lines()
+                                if hasattr(xplan, "columnar_lines")
+                                else []
+                            ),
+                            "columnar_rows": dict(
+                                sched.ctx.stats.get("columnar_rows", {})
                             ),
                         },
                         # coordinated-checkpoint health: last checkpoint

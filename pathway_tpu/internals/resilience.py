@@ -232,6 +232,11 @@ class _AttemptEvents:
             self.last_activity = _time.monotonic()
             self._inner.add_many(rows)
 
+    def add_frame(self, cap: Any) -> None:
+        if not self._fenced:
+            self.last_activity = _time.monotonic()
+            self._inner.add_frame(cap)
+
     def remove(self, key: Any, values: tuple) -> None:
         if not self._fenced:
             self.last_activity = _time.monotonic()
@@ -275,6 +280,19 @@ class _SkipEvents:
             rows = rows[skip:]
         if rows:
             self._inner.add_many(rows)
+
+    def add_frame(self, cap: Any) -> None:
+        from pathway_tpu.internals import native as _native
+
+        native = _native.load()
+        n = native.frame_len(cap)
+        skip = min(self.resume_offset, n)
+        if skip:
+            self.resume_offset -= skip
+            if skip == n:
+                return
+            cap = native.frame_slice(cap, skip, n)
+        self._inner.add_frame(cap)
 
     def remove(self, key: Any, values: tuple) -> None:
         if self.resume_offset > 0:

@@ -8,6 +8,7 @@ import os
 import time as _time
 from typing import Any, Callable
 
+from pathway_tpu.engine.columnar import columnar_enabled as _columnar_enabled
 from pathway_tpu.internals import native as _native_mod
 from pathway_tpu.internals import schema as sch
 from pathway_tpu.internals.keys import keys_for_values, ref_scalar
@@ -90,6 +91,7 @@ class _FilesSource(RowSource):
         parse_line: Callable[[str], dict | None] | None = None,
         parser_factory: Callable[[str], Callable[[str], dict | None]] | None = None,
         parse_block: Callable[[bytes], "list[dict] | None"] | None = None,
+        frame_plan: tuple | None = None,
         mode: str = "streaming",
         poll_interval: float = 0.2,
         with_metadata: bool = False,
@@ -101,6 +103,12 @@ class _FilesSource(RowSource):
         #: once (e.g. pandas' C JSON parser); returning None falls back to
         #: the per-line parser for that block (e.g. malformed rows)
         self.parse_block = parse_block
+        #: native schema plan for frame_parse_jsonl (set by formats whose
+        #: lines are flat JSON objects): a block of lines parses straight
+        #: into a columnar frame — typed column arrays + interned string
+        #: pool + LAZY row keys — and enters the engine via add_frame
+        #: with no per-row Python objects at all.  None = row path.
+        self.frame_plan = frame_plan
         # parser_factory(fp) -> line parser with per-file state (CSV headers);
         # plain parse_line is wrapped as a stateless factory.  Stateless
         # parsers allow the pre-parse line partition (each worker parses
@@ -151,6 +159,23 @@ class _FilesSource(RowSource):
             else None
         )
         w, n = self._part
+        # columnar ingest gate, decided once per file: the native JSONL->
+        # frame parser replicates coerce_rows + hash_prefix_ints exactly
+        # (strict subset — anything unusual returns None and the block
+        # falls back to the row path), so it is sound whenever keys are
+        # seq-derived (no primary key), no metadata column is spliced in,
+        # and the engine accepts frames (events.add_frame)
+        _native = _native_mod.load()
+        add_frame = getattr(events, "add_frame", None)
+        frame_prefix = ("__fs__", self.tag, fp)
+        frame_ok = (
+            self.frame_plan is not None
+            and add_frame is not None
+            and _native is not None
+            and not pk
+            and meta is None
+            and _columnar_enabled()
+        )
         # static files with stateless parsers partition by BYTE RANGE:
         # the interleaved line share makes every worker read AND split the
         # whole file (the split allocates one object per line), a fixed
@@ -220,7 +245,7 @@ class _FilesSource(RowSource):
             lines overlaps the downstream epochs with the parse the way
             the reference's connector thread overlaps with its timely
             workers (src/connectors/mod.rs reader thread -> main loop)."""
-            nonlocal seq
+            nonlocal seq, chunk
             lines = [ln for ln in complete.split(b"\n") if ln]
             base = seq
             seq = base + len(lines)
@@ -249,6 +274,31 @@ class _FilesSource(RowSource):
             for lo in range(0, len(owned_lines), _SUB):
                 sub_lines = owned_lines[lo : lo + _SUB]
                 sub_seqs = owned_seqs[lo : lo + _SUB]
+                if frame_ok and not emit_filter and isinstance(sub_seqs, range):
+                    # columnar fast path: one C pass parses the lines into
+                    # a frame (typed columns, interned strings, lazy keys
+                    # from the same prefix-hash the row path uses).  The
+                    # row count must match exactly — a skipped/malformed
+                    # line changes seq alignment, so the row path decides.
+                    fr = _native.frame_parse_jsonl(
+                        b"\n".join(sub_lines),
+                        self.frame_plan,
+                        frame_prefix,
+                        sub_seqs.start,
+                        sub_seqs.step,
+                        1,
+                    )
+                    if fr is not None and _native.frame_len(fr) == len(
+                        sub_lines
+                    ):
+                        if chunk:
+                            # per-source event ORDER is the persistence
+                            # resume contract: row chunks queued before
+                            # this frame must enter the log first
+                            add_many(chunk)
+                            chunk = []
+                        add_frame(fr)
+                        continue
                 rows = None
                 if self.parse_block is not None and not emit_filter:
                     # (emit_filter set = stateful parser under n>1: only

@@ -90,8 +90,18 @@ def read(
             return None
         return rows
 
+    # columnar frame parsing is sound only for flat objects mapped
+    # one-to-one onto the schema — json_field_paths rewrites rows in
+    # Python, so it stays on the row path
+    frame_plan = None
+    if not json_field_paths:
+        from pathway_tpu.io._connector import _schema_plans
+
+        frame_plan = _schema_plans(schema)[1]
+
     source = _FilesSource(
-        str(path), schema, parse_line=parse_line, parse_block=parse_block, mode=mode,
+        str(path), schema, parse_line=parse_line, parse_block=parse_block,
+        frame_plan=frame_plan, mode=mode,
         with_metadata=with_metadata, tag=f"jsonlines:{path}",
     )
     return input_table(source, schema, name=name, persistent_id=persistent_id)

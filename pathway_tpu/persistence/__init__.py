@@ -538,6 +538,27 @@ class _RecordingEvents:
         self._dirty = True
         self._inner.add_many(rows)
 
+    def add_frame(self, cap):
+        """Columnar ingest: skip the replayed prefix by frame_slice (keys
+        stay lazy, pool shared), log the survivor as ONE "addframe"
+        record holding the frame's wire encoding — replay expands it back
+        to per-row events, so resume offsets stay row-accurate."""
+        native = _native_mod.load()
+        n = native.frame_len(cap)
+        skip = min(self.resume_offset, n)
+        if skip:
+            self.resume_offset -= skip
+            if skip == n:
+                return
+            cap = native.frame_slice(cap, skip, n)
+        self._impl.append(
+            self._stream,
+            pickle.dumps(("addframe", native.frame_pack(cap, None), None)),
+            durable=False,
+        )
+        self._dirty = True
+        self._inner.add_frame(cap)
+
     def remove(self, key, values):
         self._record_and_forward("remove", key, values, self._inner.remove)
 
@@ -854,6 +875,19 @@ class PersistenceHooks:
                         "native module is unavailable"
                     )
                 out.extend(("add", kk, vv) for kk, vv in native.unpack_kv(k))
+            elif kind == "addframe":  # columnar frame record
+                native = _native_mod.load()
+                if native is None:
+                    raise RuntimeError(
+                        "snapshot log holds columnar frame records but the "
+                        "native module is unavailable"
+                    )
+                out.extend(
+                    ("add" if u.diff > 0 else "remove", u.key, u.values)
+                    for u in native.frame_to_updates(
+                        native.frame_unpack(k, None)
+                    )
+                )
             elif kind == "addmany":  # chunked record: expand to per-row events
                 out.extend(("add", Pointer(kk), vv) for kk, vv in k)
             elif kind in ("add", "remove"):

@@ -95,14 +95,16 @@ called_from_lib:_multiarray_umath
 EOF
 
 # concurrency-relevant subset: histogram/exchange/groupby-partial paths
-# that the threaded scheduler exercises from multiple workers
+# that the threaded scheduler exercises from multiple workers, plus the
+# columnar frame kernels and zero-copy pack/unpack (sender thread
+# encodes with a shared TxPool while workers build frames)
 echo "running concurrency-native tests under TSan" >&2
 LD_PRELOAD="$LIBTSAN" \
 TSAN_OPTIONS="suppressions=$TSAN_SUPP:halt_on_error=1:report_signal_unsafe=0" \
 PATHWAY_NATIVE_SO="$TSAN_OUT" \
 JAX_PLATFORMS=cpu \
 python -m pytest "$REPO/tests/test_native.py" -q -p no:cacheprovider \
-    -k "hash_parity or scan_lines or consolidate or per_key_changes or groupby_partials or multiset_reducer" \
+    -k "hash_parity or scan_lines or consolidate or per_key_changes or groupby_partials or multiset_reducer or frame" \
     "$@"
 
 echo "thread-sanitizer run clean" >&2

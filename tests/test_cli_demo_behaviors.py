@@ -27,20 +27,24 @@ from tests.utils import run_to_rows
 # CLI
 
 
-def test_cli_spawn_sets_env_contract(tmp_path, capfd):
+def test_cli_spawn_sets_env_contract(tmp_path):
     """``pathway spawn --processes N --threads M`` launches N copies with
     the PATHWAY_* env contract (reference spawn/spawn-from-env)."""
+    # each child reports into its own file: concurrent children sharing
+    # one stdout can interleave lines, which made the capfd version flaky
     prog = tmp_path / "p.py"
     prog.write_text(
         textwrap.dedent(
-            """
+            f"""
             import json, os
-            print(json.dumps({
-                "pid": os.environ.get("PATHWAY_PROCESS_ID"),
-                "procs": os.environ.get("PATHWAY_PROCESSES"),
-                "threads": os.environ.get("PATHWAY_THREADS"),
-                "port": os.environ.get("PATHWAY_FIRST_PORT"),
-            }))
+            pid = os.environ.get("PATHWAY_PROCESS_ID")
+            with open({str(tmp_path)!r} + "/env_%s.json" % pid, "w") as f:
+                json.dump({{
+                    "pid": pid,
+                    "procs": os.environ.get("PATHWAY_PROCESSES"),
+                    "threads": os.environ.get("PATHWAY_THREADS"),
+                    "port": os.environ.get("PATHWAY_FIRST_PORT"),
+                }}, f)
             """
         )
     )
@@ -60,11 +64,9 @@ def test_cli_spawn_sets_env_contract(tmp_path, capfd):
     assert rc == 0
     import json
 
-    captured = capfd.readouterr().out  # child stdout arrives at fd level
     lines = [
-        json.loads(line)
-        for line in captured.splitlines()
-        if line.strip().startswith("{")
+        json.loads(p.read_text())
+        for p in sorted(tmp_path.glob("env_*.json"))
     ]
     assert len(lines) == 2
     assert {rec["pid"] for rec in lines} == {"0", "1"}

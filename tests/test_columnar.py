@@ -1,0 +1,286 @@
+"""Columnar execution differential properties (ISSUE 19).
+
+The columnar path is an optimization of REPRESENTATION only: frames
+through native kernels must be observably identical to the row path.
+Three layers of evidence:
+
+- randomized typed batches (None/Optional cells, interned and
+  non-interned strings, retractions) through :class:`ColumnarBatch`
+  seams (split, extend_batch, iteration order);
+- the cluster wire codec: ``_K_FRAME`` encode/decode symmetry with the
+  per-transmission string pool, including the row-materializing
+  fallback;
+- whole pipelines: the same graph at ``optimize=0`` and ``optimize=2``,
+  at 1 and 2 workers, columnar on vs ``PATHWAY_DISABLE_COLUMNAR=1`` —
+  captured rows (keys included) must match exactly.
+
+Kernel-level parity (roundtrip, route_split, groupby partials,
+project/filter, pack/unpack, truncation fuzz) lives in
+``tests/test_native.py`` so the sanitizer jobs cover it.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import struct
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.analysis.rewrite import optimize_graph
+from pathway_tpu.engine.cluster import Cluster, _PeerSender, _ProcessLinks
+from pathway_tpu.engine.columnar import ColumnarBatch, extend_batch
+from pathway_tpu.engine.graph import CaptureNode
+from pathway_tpu.engine.scheduler import Scheduler
+from pathway_tpu.engine.stream import Update
+from pathway_tpu.internals import keys as K
+from pathway_tpu.internals import native as _native
+from pathway_tpu.internals.parse_graph import G
+
+
+@pytest.fixture(scope="module")
+def mod():
+    m = _native.load()
+    if m is None:
+        pytest.skip("native extension unavailable (no g++?)")
+    m.set_pointer_type(K.Pointer)
+    return m
+
+
+def _rand_rows(rng: random.Random, n: int) -> list:
+    pool = ["alpha", "beta", "überstr", ""]
+    rows = []
+    for i in range(n):
+        s = (
+            rng.choice(pool)
+            if rng.random() < 0.6
+            else "s%d" % rng.randrange(10**6)
+        )
+        vals = (
+            rng.randrange(-(2**40), 2**40),
+            None if rng.random() < 0.2 else rng.random() * 100 - 50,
+            s,
+            None if rng.random() < 0.3 else s + "!",
+            rng.random() < 0.5,
+        )
+        rows.append(
+            Update(
+                K.Pointer(K.ref_scalar("r", i)),
+                vals,
+                -1 if rng.random() < 0.25 else 1,
+            )
+        )
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# ColumnarBatch seams
+
+
+def test_batch_protocol_and_split(mod):
+    rng = random.Random(17)
+    rows = _rand_rows(rng, 300)
+    cap = mod.frame_from_updates(rows[:200])
+    cb = ColumnarBatch()
+    cb.append_frame(cap)
+    cb.extend(rows[200:])
+    assert len(cb) == 300 and bool(cb)
+    assert list(cb) == rows and cb.to_list() == rows
+    assert cb.frame_rows() == 200
+    for cut in (0, 1, 57, 200, 250, 300):
+        head, tail = cb.split(cut)
+        assert head.to_list() + tail.to_list() == rows
+        assert len(head) == cut
+
+
+def test_extend_batch_promotes_and_preserves_order(mod):
+    rng = random.Random(23)
+    rows = _rand_rows(rng, 120)
+    cap = mod.frame_from_updates(rows[40:80])
+    buf: list = list(rows[:40])
+    more = ColumnarBatch()
+    more.append_frame(cap)
+    buf = extend_batch(buf, more)
+    assert isinstance(buf, ColumnarBatch)
+    buf = extend_batch(buf, rows[80:])
+    assert buf.to_list() == rows
+    # list buffer + list more stays a plain list (no gratuitous wrapping)
+    assert extend_batch([1], [2]) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# wire codec: _K_FRAME transmission symmetry
+
+
+def _codec_roundtrip(mod, items):
+    """Encode one transmission exactly as _PeerSender does, decode it
+    exactly as the reader thread does."""
+    buf = bytearray(b"\x00" * 12)
+    txpool = mod.frame_txpool_new()
+    from pathway_tpu.engine.cluster import _K_FRAME
+
+    for slot, kind, payload in items:
+        _PeerSender._encode_msg(buf, slot, kind, payload, mod, txpool)
+    struct.pack_into("<QI", buf, 0, len(buf) - 8, len(items))
+    return _ProcessLinks._decode(memoryview(bytes(buf))[8:], mod)
+
+
+def test_frame_wire_codec_symmetry(mod):
+    from pathway_tpu.engine.cluster import _K_FRAME
+
+    rng = random.Random(31)
+    rows = _rand_rows(rng, 400)
+    cb0 = ColumnarBatch()
+    cb0.append_frame(mod.frame_from_updates(rows[:150]))
+    cb0.extend(rows[150:180])  # mixed frame+row segments in one box
+    cb1 = ColumnarBatch()
+    cb1.append_frame(mod.frame_from_updates(rows[180:300]))
+    boxes = [[cb0, cb1, rows[300:350], []]]  # CB, CB, plain rows, empty
+    out = _codec_roundtrip(
+        mod, [("slot", _K_FRAME, boxes), ("s2", _K_FRAME, [[rows[350:]]])]
+    )
+    assert len(out) == 2
+    slot, decoded, nbytes = out[0]
+    assert slot == "slot" and nbytes > 0
+    (drow,) = decoded
+    assert isinstance(drow[0], ColumnarBatch)
+    assert drow[0].frame_rows() == 150  # zero-copy: frames stay frames
+    assert drow[0].to_list() == rows[:180]
+    assert drow[1].to_list() == rows[180:300]
+    assert drow[2] == rows[300:350]  # pure row box decodes to plain list
+    assert drow[3] == []
+    assert out[1][1][0][0] == rows[350:]
+
+
+# ---------------------------------------------------------------------------
+# whole-pipeline differential: optimize levels x workers x columnar
+
+
+class _Ev(pw.Schema):
+    word: str
+    n: int
+    x: float
+
+
+def _write_events(tmp_path, n=400) -> str:
+    rng = random.Random(29)
+    fp = tmp_path / "events.jsonl"
+    fp.write_text(
+        "\n".join(
+            json.dumps(
+                {
+                    "word": "w%d" % rng.randint(0, 15),
+                    "n": rng.randint(-20, 20),
+                    "x": rng.random() * 10 - 5,
+                }
+            )
+            for _ in range(n)
+        )
+    )
+    return str(fp)
+
+
+def _build_frame_chain(fp):
+    # jsonlines (frame parse) -> filter (frame_filter) -> projection
+    # (frame_project) -> groupby (frame partials): the full fast chain
+    t = pw.io.jsonlines.read(fp, schema=_Ev, mode="static")
+    flt = t.filter(t.n >= 0)
+    proj = flt.select(flt.x, flt.word)
+    return proj.groupby(proj.word).reduce(
+        proj.word, s=pw.reducers.sum(proj.x), c=pw.reducers.count()
+    )
+
+
+def _build_udf_fallback(fp):
+    # a python UDF keeps its operator on the row path while neighbors
+    # stay columnar — the per-operator materialization seam
+    t = pw.io.jsonlines.read(fp, schema=_Ev, mode="static")
+    u = t.select(t.word, z=pw.apply(lambda n, x: n * 2 + int(x), t.n, t.x))
+    return u.groupby(u.word).reduce(u.word, s=pw.reducers.sum(u.z))
+
+
+PIPELINES = {"frame_chain": _build_frame_chain, "udf_fallback": _build_udf_fallback}
+
+
+def _assert_same(a: dict, b: dict, msg: str) -> None:
+    """Exact equality except float cells, which get ULP-scale tolerance:
+    native frame partials accumulate f64 sums in segment order, which is
+    not the row path's iteration order, and float addition is not
+    associative."""
+    assert a.keys() == b.keys(), msg
+    for k, va in a.items():
+        vb = b[k]
+        assert len(va) == len(vb), f"{msg}: {k}"
+        for ca, cb in zip(va, vb):
+            if isinstance(ca, float):
+                assert cb == pytest.approx(ca, rel=1e-9, abs=1e-9), f"{msg}: {k}"
+            else:
+                assert ca == cb, f"{msg}: {k}"
+
+
+def _run(build, fp, level: int, n_threads: int) -> dict:
+    G.clear()
+    table = build(fp)
+    cap = CaptureNode(G.engine_graph, table._node)
+    exec_graph, _plan = optimize_graph(G.engine_graph, level)
+    sched = Scheduler(exec_graph, autocommit_ms=10)
+    cluster = Cluster(threads=n_threads)
+    try:
+        ctx = sched.run_cluster(cluster)
+    finally:
+        cluster.close()
+    return dict(ctx.state(cap)["rows"])
+
+
+@pytest.mark.parametrize("n_threads", [1, 2])
+@pytest.mark.parametrize("name", sorted(PIPELINES))
+def test_columnar_row_equivalence(tmp_path, monkeypatch, mod, name, n_threads):
+    fp = _write_events(tmp_path)
+    build = PIPELINES[name]
+    results = {}
+    for tag, disabled, level in (
+        ("col0", False, 0),
+        ("col2", False, 2),
+        ("row0", True, 0),
+        ("row2", True, 2),
+    ):
+        if disabled:
+            monkeypatch.setenv("PATHWAY_DISABLE_COLUMNAR", "1")
+        else:
+            monkeypatch.delenv("PATHWAY_DISABLE_COLUMNAR", raising=False)
+        results[tag] = _run(build, fp, level, n_threads)
+    _assert_same(results["col0"], results["row0"], f"{name}: optimize=0 diverged")
+    _assert_same(results["col2"], results["row2"], f"{name}: optimize=2 diverged")
+    _assert_same(results["col0"], results["col2"], f"{name}: levels diverged")
+    assert results["col0"], f"{name}: empty capture"
+
+
+def test_columnar_rows_counter_and_plan(tmp_path, monkeypatch, mod):
+    """The runtime counter attributes rows to the path they ran, and the
+    plan records every operator's decision with a fallback reason."""
+    fp = _write_events(tmp_path)
+    monkeypatch.delenv("PATHWAY_DISABLE_COLUMNAR", raising=False)
+    G.clear()
+    table = _build_frame_chain(fp)
+    CaptureNode(G.engine_graph, table._node)
+    exec_graph, plan = optimize_graph(G.engine_graph, 2)
+    text = plan.format()
+    assert "columnar:" in text
+    assert any(p == "columnar" for _n, p, _r in plan.columnar)
+    sched = Scheduler(exec_graph, autocommit_ms=10)
+    cluster = Cluster(threads=1)
+    try:
+        ctx = sched.run_cluster(cluster)
+    finally:
+        cluster.close()
+    cr = ctx.stats.get("columnar_rows", {})
+    assert cr.get("columnar", 0) > 0, cr
+    # UDF graph: the fallback reason is visible per operator
+    G.clear()
+    table = _build_udf_fallback(fp)
+    CaptureNode(G.engine_graph, table._node)
+    _g, plan = optimize_graph(G.engine_graph, 2)
+    assert any(
+        p == "row" and r for _n, p, r in plan.columnar
+    ), plan.format()

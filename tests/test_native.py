@@ -273,3 +273,140 @@ def test_engine_parity_native_vs_python_subprocess(mod):
     assert a.returncode == 0, a.stderr[-2000:]
     assert b.returncode == 0, b.stderr[-2000:]
     assert a.stdout == b.stdout and a.stdout.strip()
+
+
+# ---------------------------------------------------------------------------
+# columnar frames (ISSUE 19): every kernel must be behaviour-identical to
+# its row counterpart, and the wire codec must never read past a buffer.
+# These run under scripts/sanitize_native.sh (ASan+UBSan) unmodified.
+
+
+def _frame_rows(n=400, seed=5):
+    import random
+
+    from pathway_tpu.engine.stream import Update
+
+    rng = random.Random(seed)
+    pool = ["alpha", "beta", "überstr", ""]
+    rows = []
+    for i in range(n):
+        s = (
+            rng.choice(pool)
+            if rng.random() < 0.6
+            else "s%d" % rng.randrange(10**6)
+        )
+        vals = (
+            rng.randrange(-(2**40), 2**40),  # i64
+            None if rng.random() < 0.2 else rng.random() * 100 - 50,  # f64?
+            s,  # interned-ish str
+            None if rng.random() < 0.3 else s + "!",  # fresh str / None
+            rng.random() < 0.5,  # bool
+        )
+        diff = -1 if rng.random() < 0.25 else 1
+        rows.append(Update(K.Pointer(K.ref_scalar("r", i)), vals, diff))
+    return rows
+
+
+def test_frame_roundtrip_and_slice(mod):
+    rows = _frame_rows()
+    cap = mod.frame_from_updates(rows)
+    assert mod.frame_len(cap) == len(rows)
+    assert mod.frame_ncols(cap) == 5
+    assert mod.frame_to_updates(cap) == rows
+    head = mod.frame_slice(cap, 0, 123)
+    tail = mod.frame_slice(cap, 123, len(rows))
+    assert mod.frame_to_updates(head) + mod.frame_to_updates(tail) == rows
+
+
+def test_frame_route_split_parity(mod):
+    rows = _frame_rows()
+    cap = mod.frame_from_updates(rows)
+    for spec in ((2,), (0, 4), ()):  # str col, (int,bool), key-routed
+        frames = mod.frame_route_split(cap, spec, 4)
+        lists = mod.route_split(rows, spec, 4)
+        assert [mod.frame_to_updates(f) for f in frames] == lists
+
+
+def test_frame_groupby_partials_parity(mod):
+    from pathway_tpu.engine.stream import hashable_row
+    from pathway_tpu.internals import api
+
+    rows = _frame_rows()
+    cap = mod.frame_from_updates(rows)
+    specs = ((0, ()), (1, (0,)))  # count + sum(int col)
+    assert mod.frame_groupby_partials(
+        cap, (2,), specs, api.ERROR
+    ) == mod.groupby_partials(rows, (2,), specs, api.ERROR, hashable_row)
+
+
+def test_frame_project_filter_parity(mod):
+    from pathway_tpu.engine.stream import Update
+
+    rows = _frame_rows()
+    cap = mod.frame_from_updates(rows)
+    pr = mod.frame_project(cap, (2, 0, 4))
+    assert mod.frame_to_updates(pr) == [
+        Update(u.key, (u.values[2], u.values[0], u.values[4]), u.diff)
+        for u in rows
+    ]
+    # col0 > 0 — numeric with full validity
+    fl = mod.frame_filter(cap, 0, 4, 0)
+    assert mod.frame_to_updates(fl) == [
+        u for u in rows if u.values[0] > 0
+    ]
+    # col3 != const — Optional[str]: None != const keeps the row (Python
+    # semantics), None == / ordered comparisons drop it
+    fl2 = mod.frame_filter(cap, 3, 1, "alpha!")
+    assert mod.frame_to_updates(fl2) == [
+        u for u in rows if u.values[3] != "alpha!"
+    ]
+    # cross-type pairing (int col, float const) must refuse, not guess
+    with pytest.raises(mod.Unsupported):
+        mod.frame_filter(cap, 0, 4, 0.5)
+
+
+def test_frame_pack_pool_roundtrip(mod):
+    rows = _frame_rows()
+    cap = mod.frame_from_updates(rows)
+    # one tx/rx pool pair per transmission, frames encoded and decoded
+    # in the same order: pool refs resolve purely by insert index
+    tx = mod.frame_txpool_new()
+    a = mod.frame_pack(mod.frame_slice(cap, 0, 200), tx)
+    b = mod.frame_pack(mod.frame_slice(cap, 200, 400), tx)
+    hits, misses = mod.frame_txpool_stats(tx)
+    assert hits > 0 and misses > 0  # shared strings dedup across frames
+    rx = mod.frame_rxpool_new()
+    out = mod.frame_to_updates(mod.frame_unpack(a, rx)) + mod.frame_to_updates(
+        mod.frame_unpack(b, rx)
+    )
+    assert out == rows
+    # poolless blob stays self-contained
+    blob = mod.frame_pack(cap, None)
+    assert mod.frame_to_updates(mod.frame_unpack(blob, None)) == rows
+
+
+def test_frame_unpack_truncation_fuzz(mod):
+    """Intentionally-truncated frames: every cut must raise ValueError —
+    never crash, never read past the buffer (the sanitize_native.sh
+    ASan job is the real referee here)."""
+    rows = _frame_rows(n=150)
+    blob = mod.frame_pack(mod.frame_from_updates(rows), None)
+    for cut in range(len(blob)):
+        with pytest.raises(ValueError):
+            mod.frame_unpack(blob[:cut], None)
+    # corrupt magic/version bytes must be rejected up front
+    for i, b in ((0, 0x00), (1, 0xFF)):
+        bad = bytearray(blob)
+        bad[i] = b
+        with pytest.raises(ValueError):
+            mod.frame_unpack(bytes(bad), None)
+
+
+def test_frame_from_updates_unsupported(mod):
+    from pathway_tpu.engine.stream import Update
+
+    # nested tuples are outside the typed column set: the whole batch
+    # stays on the row path
+    rows = [Update(K.Pointer(K.ref_scalar("r", 0)), (("a", 1),), 1)]
+    with pytest.raises(mod.Unsupported):
+        mod.frame_from_updates(rows)
