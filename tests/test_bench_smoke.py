@@ -49,6 +49,14 @@ def test_bench_smoke_emits_wellformed_metrics():
     stats = extra["wordcount_exchange_stats"]
     assert stats["transmissions"] > 0
     assert stats["status_rounds"] > 0
+    # the columnar differential ran and its gates held (ISSUE 19: the
+    # columnar kernels must beat the row path and the _K_FRAME wire must
+    # engage, ship fewer bytes, and burn less codec CPU than the row
+    # wire; an assert inside bench_columnar surfaces here as
+    # columnar_error)
+    assert "columnar_error" not in extra, extra.get("columnar_error")
+    assert extra["columnar_rows_per_sec"] >= extra["columnar_row_path_rows_per_sec"]
+    assert extra["columnar_speedup_single_core"] >= 1.0
     # the streaming-latency probe ran and its dispersion gate held: a
     # p99/p50 blowout (raised inside bench.py) would surface here as a
     # streaming_latency_error key instead of the smoke summary
