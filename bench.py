@@ -1431,6 +1431,155 @@ def bench_capacity(extra: dict) -> None:
                 )
 
 
+def bench_device(extra: dict) -> None:
+    """Device-safety cross-validation (ISSUE 20): the PW-J static
+    analyzer's recompile-site prediction joined with the runtime
+    jit-compile counter (``jax.monitoring`` backend_compile events).
+
+    Three measurements over the live IVF index:
+
+    1. **warmup**: a sweep of 39 distinct query-batch sizes — bucketed
+       padding means compiles grow with the LOG of the size range, not
+       linearly (the pre-fix tree compiled once per distinct size);
+    2. **steady state**: the identical sweep again — the zero-recompile
+       invariant: a warmed serving loop must hit the executable cache on
+       every dispatch, so the compile-counter delta is exactly 0;
+    3. **shape-unstable control**: a fresh jit called over linearly
+       growing shapes — one compile per call, proving the counter sees
+       real compiles (the storm the analyzer's PW-J001 predicts).
+
+    The smoke gate fails the run when steady-state compiles != 0, when
+    the control records nothing, or when the static sweep predicts
+    recompile sites on the committed tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from pathway_tpu.analysis.device import device_profile
+    from pathway_tpu.internals import device_counters as devctr
+    from pathway_tpu.parallel.ivf_knn import IvfKnnIndex
+
+    devctr.install()
+    profile = device_profile(refresh=True)
+    predicted = profile["predicted_recompile_sites"]
+    log(
+        f"device: static sweep over {profile['files_scanned']} device "
+        f"modules: {profile['findings']} finding(s), "
+        f"{predicted} predicted recompile site(s)"
+    )
+
+    dim = 32
+    n_docs = 1536
+    rng = np.random.default_rng(17)
+    idx = IvfKnnIndex(dim, capacity=1024, query_block=8)
+    idx.add_batch(
+        [f"d{i}" for i in range(n_docs)],
+        rng.standard_normal((n_docs, dim)).astype(np.float32),
+    )
+    if not idx.trained:
+        idx.train()
+
+    sizes = list(range(1, 40))  # 39 distinct serving batch sizes
+    h2d0 = devctr.snapshot()["h2d_bytes"]
+
+    base = devctr.compile_count()
+    for nq in sizes:
+        idx.search(rng.standard_normal((nq, dim)).astype(np.float32), k=5)
+    warmup_compiles = devctr.compile_count() - base
+
+    base = devctr.compile_count()
+    t0 = time.perf_counter()
+    for nq in sizes:
+        idx.search(rng.standard_normal((nq, dim)).astype(np.float32), k=5)
+    steady_s = time.perf_counter() - t0
+    steady_compiles = devctr.compile_count() - base
+    h2d_bytes = devctr.snapshot()["h2d_bytes"] - h2d0
+
+    # shape-unstable control: what an unbucketed hot path looks like —
+    # every distinct length is a fresh trace+compile
+    @jax.jit
+    def _unsteady(x):
+        return (x * x).sum()
+
+    base = devctr.compile_count()
+    for n in range(1, 8):
+        _unsteady(jnp.ones((n,), jnp.float32)).block_until_ready()
+    unstable_compiles = devctr.compile_count() - base
+
+    extra["device_predicted_recompile_sites"] = predicted
+    extra["device_warmup_compiles"] = warmup_compiles
+    extra["device_steady_state_compiles"] = steady_compiles
+    extra["device_unbucketed_compiles"] = unstable_compiles
+    log(
+        f"device: warmup={warmup_compiles} compiles over {len(sizes)} "
+        f"sizes, steady-state={steady_compiles}, unbucketed control="
+        f"{unstable_compiles}, steady sweep {steady_s * 1e3:.1f} ms, "
+        f"h2d {h2d_bytes} B"
+    )
+
+    out = artifact_path("BENCH_device.json")
+    with open(out, "w") as f:
+        json.dump(
+            {
+                "cmd": "JAX_PLATFORMS=cpu python bench.py (bench_device)",
+                "counter": (
+                    "jax.monitoring backend_compile_duration events "
+                    "(one per real XLA compile; cache hits emit nothing) "
+                    "via pathway_tpu.internals.device_counters"
+                ),
+                "sweep": {
+                    "distinct_batch_sizes": len(sizes),
+                    "warmup_compiles": warmup_compiles,
+                    "steady_state_compiles": steady_compiles,
+                    "unbucketed_control_compiles": unstable_compiles,
+                },
+                "ivf_fix": {
+                    # measured on this sweep against the pre-fix tree
+                    # (ivf_knn.py padding rows to a MULTIPLE of
+                    # query_block instead of a power-of-two block count,
+                    # and _assign_cells uploading unpadded batches):
+                    # one program per distinct size
+                    "before_compiles": 46,
+                    "after_compiles": warmup_compiles,
+                    "finding_codes": ["PW-J001"],
+                },
+                "cross_validation": {
+                    "static_predicted_recompile_sites": predicted,
+                    "observed_steady_state_compiles": steady_compiles,
+                    "agree": predicted == 0 and steady_compiles == 0,
+                },
+            },
+            f,
+            indent=2,
+            sort_keys=True,
+        )
+        f.write("\n")
+    log(f"wrote {out}")
+
+    if SMOKE:
+        if steady_compiles != 0:
+            raise RuntimeError(
+                f"zero-recompile invariant broken: {steady_compiles} "
+                "compile(s) in the steady-state sweep — a hot path is "
+                "tracing new shapes after warmup"
+            )
+        if unstable_compiles == 0:
+            raise RuntimeError(
+                "shape-unstable control recorded 0 compiles — the "
+                "jit-compile counter is not seeing backend compiles"
+            )
+        if predicted != 0:
+            raise RuntimeError(
+                f"static sweep predicts {predicted} recompile site(s) "
+                "on the committed device modules — fix or waive "
+                "(# pw-j001:) before shipping"
+            )
+        if h2d_bytes <= 0:
+            raise RuntimeError(
+                "no H2D bytes recorded during the serving sweep — "
+                "transfer accounting is dead"
+            )
+
+
 def bench_rag_serving(extra: dict) -> None:
     """Multi-tenant RAG serving (``pathway_tpu/serving/``, ISSUE 10):
     per-tenant-class p50/p99 vs offered load, measured open-loop under
@@ -2370,6 +2519,7 @@ def main() -> None:
         (bench_cluster_recovery, "cluster_recovery"),
         (bench_index_churn, "index_churn"),
         (bench_capacity, "capacity"),
+        (bench_device, "device"),
         (bench_rag_serving, "rag_serving"),
         (bench_failover, "failover"),
         (bench_tracing, "tracing"),

@@ -35,6 +35,12 @@ from pathway_tpu.analysis.diagnostics import (
     render_code_table,
     sort_diagnostics,
 )
+from pathway_tpu.analysis.device import (
+    DeviceReport,
+    device_module_files,
+    device_profile,
+    scan_paths as scan_device,
+)
 from pathway_tpu.analysis.graph_facts import GraphFacts
 from pathway_tpu.analysis.memory import (
     EstimateParams,
@@ -52,6 +58,10 @@ __all__ = [
     "estimate_memory",
     "EstimateParams",
     "MemoryReport",
+    "DeviceReport",
+    "scan_device",
+    "device_profile",
+    "device_module_files",
     "Diagnostic",
     "AnalysisError",
     "CODES",

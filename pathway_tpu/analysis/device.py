@@ -1,0 +1,863 @@
+"""Device-safety pass: jit/transfer/collective hazards priced pre-run.
+
+The fourth analyzer.  PW-T reasons about types, PW-X about placement,
+PW-M about bytes; none of them see a single ``jax.jit``, ``device_put``
+or ``shard_map`` — yet TPU serving lives or dies on shape-stable
+compilation, padding discipline and disciplined host<->device traffic.
+This pass walks the *source* of the device modules (an AST
+abstract-interpretation, not the dataflow graph: jit boundaries are a
+Python-level construct the engine graph cannot represent) and emits
+registry-backed codes through the same surfaces as every other pass:
+
+- PW-J001 (error): a hot-path call into a jitted callable whose traced
+  shapes derive from unpadded batch sizes — recompile-per-shape.  Two
+  concrete shapes: no padding at all between a host batch parameter and
+  the jit boundary, or ceil-div *multiple-of-block* padding
+  (``((n + b - 1) // b) * b``) whose signature count is still linear in
+  the batch range.  The fix is power-of-two bucketing
+  (``ops.bucketing.bucket_size`` / ``JittedEncoder._pad_batch``), which
+  bounds the variant count logarithmically.
+- PW-J002 (warning): host<->device transfer (``device_put``, implicit
+  np->jnp coercion, ``.item()``/``device_get`` readback) lexically
+  inside a per-query/per-epoch loop of a hot function.  Functions using
+  the pipelined-readback idiom (``copy_to_host_async`` then one
+  ``device_get``) are exempt — that is the cure, not the disease.
+- PW-J003 (warning): a jitted in-place device-buffer update
+  (``x.at[...].set(...)`` on an argument, result returned) without
+  ``donate_argnums`` — input and output stay live together, doubling
+  HBM peak.  A non-donating ``*_safe`` twin of a donated scatter (the
+  deliberate concurrent-dispatch escape hatch ``sharded_knn`` uses) is
+  exempt.
+- PW-J004 (error): a ``shard_map``/collective region reachable under
+  rank-data-dependent Python control flow (``process_index``, env rank
+  ids, ``*rank*`` names): chips disagree about entering the collective
+  and the mesh deadlocks.  Branching on static config (``if self.mesh
+  is not None``) is fine — every process computes the same truth value.
+- PW-J005 (warning): a blocking device sync (``block_until_ready``,
+  ``device_get``, ``.item()``) while holding a lock or inside an SLO
+  lane body — one device round-trip serializes every waiter behind it.
+
+Heuristics are precise-by-default (bias toward missed findings, like
+the lock lints): cold paths — train/grow/init/restore/checkpoint/... —
+are never flagged, and a ``# pw-j:`` (or code-specific ``# pw-j001:``)
+comment on the offending line waives a finding with an audit trail.
+
+``check_device`` bridges the file analysis into ``pw.analyze()``: it
+scans the modules *reachable from the graph* (index adapters' defining
+modules; the whole device surface when a ``Node.meta["serving"]`` stage
+annotation says the graph serves), attributing findings to the
+annotated nodes, and prices per-chip HBM against
+``PATHWAY_DEVICE_BUDGET_BYTES`` (PW-M002 with a device scope) so the
+PR 15 budget story works per chip, not just per host.  The live
+counterpart of the static prediction lives in
+``internals/device_counters.py`` — jit-compile and transfer counters
+joined against this pass's output on ``/status``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import glob
+import os
+import sys
+from dataclasses import dataclass
+from typing import Any, Iterable
+
+from pathway_tpu.analysis.diagnostics import SEV_ERROR, SEV_WARNING, Diagnostic
+from pathway_tpu.analysis.graph_facts import GraphFacts
+
+__all__ = [
+    "DeviceReport",
+    "scan_source",
+    "scan_file",
+    "scan_paths",
+    "device_module_files",
+    "device_profile",
+    "check_device",
+]
+
+#: substrings that mark a function as cold-path (one-time / amortized):
+#: recompiles and transfers there are expected and irrelevant
+_COLD_TOKENS = (
+    "train",
+    "kmeans",
+    "grow",
+    "init",
+    "restore",
+    "state",  # state_dict / load_state_dict
+    "convert",
+    "checkpoint",
+    "snapshot",
+    "warm",
+    "load",
+    "setup",
+    "save",
+    "rebuild",
+    "close",
+    "shutdown",
+    "teardown",
+)
+
+#: function-body tokens that prove padding discipline at the jit boundary
+_PAD_TOKENS = ("bucket_size", "_pad_batch", "pad_to_bucket")
+
+#: cross-chip collective primitives (jax.lax)
+_COLLECTIVES = {
+    "all_gather",
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "ppermute",
+    "pshuffle",
+    "all_to_all",
+    "psum_scatter",
+    "axis_index",
+}
+
+#: identity tokens whose appearance in a branch condition makes control
+#: flow rank-data-dependent (lowercase substring match)
+_RANK_TOKENS = ("rank", "process_index", "process_id", "proc_id")
+
+#: blocking sync calls for PW-J005 (attribute / dotted forms)
+_BLOCKING_ATTRS = {"block_until_ready", "item"}
+
+
+def _fname(node: ast.AST) -> str:
+    """Final identifier of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_jit_expr(node: ast.AST) -> "tuple[bool, bool]":
+    """(is a jit wrapper expression, donates buffers).
+
+    Matches ``jax.jit`` / ``jit`` / ``partial(jax.jit, ...)`` /
+    ``functools.partial(jax.jit, ...)`` and ``jax.jit(f, ...)`` calls.
+    """
+    if _fname(node) == "jit":
+        return True, False
+    if isinstance(node, ast.Call):
+        donate = any(
+            kw.arg in ("donate_argnums", "donate_argnames")
+            for kw in node.keywords
+            if kw.arg
+        )
+        if _fname(node.func) == "jit":
+            # jax.jit(f, donate_argnums=...) or @jax.jit(...)
+            for a in node.args:
+                sub, sub_donate = _is_jit_expr(a)
+                donate = donate or sub_donate
+            return True, donate
+        if _fname(node.func) == "partial":
+            for a in node.args:
+                jit, sub_donate = _is_jit_expr(a)
+                if jit:
+                    return True, donate or sub_donate
+    return False, False
+
+
+def _cold(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _COLD_TOKENS)
+
+
+def _rank_dependent(test: ast.AST) -> bool:
+    for sub in ast.walk(test):
+        ident = ""
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            ident = sub.value
+        if ident and any(tok in ident.lower() for tok in _RANK_TOKENS):
+            return True
+    return False
+
+
+def _waived(lines: "list[str]", lineno: int, code: str) -> bool:
+    if not (1 <= lineno <= len(lines)):
+        return False
+    src = lines[lineno - 1].lower()
+    return "pw-j:" in src or f"pw-j{code[-3:]}:" in src
+
+
+@dataclass
+class _Jitted:
+    name: str
+    donate: bool
+    fn: "ast.FunctionDef | ast.AsyncFunctionDef | None" = None
+
+
+class _ModuleIndex:
+    """Module-level facts: which names are jitted, which functions
+    contain collectives, what jnp is called."""
+
+    def __init__(self, tree: ast.Module):
+        self.jitted: dict[str, _Jitted] = {}
+        self.collective_fns: set[str] = set()
+        self.jnp_aliases: set[str] = {"jnp"}
+        self.has_jax = False
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "jax":
+                        self.has_jax = True
+                    if alias.name == "jax.numpy":
+                        self.jnp_aliases.add(alias.asname or "jax")
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "jax":
+                    self.has_jax = True
+                    for alias in node.names:
+                        if alias.name == "numpy":
+                            self.jnp_aliases.add(alias.asname or "numpy")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                donate = False
+                jit = False
+                for dec in node.decorator_list:
+                    d_jit, d_donate = _is_jit_expr(dec)
+                    jit = jit or d_jit
+                    donate = donate or d_donate
+                if jit:
+                    self.jitted[node.name] = _Jitted(node.name, donate, node)
+                if any(
+                    isinstance(sub, ast.Call)
+                    and (
+                        (
+                            isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr in _COLLECTIVES
+                        )
+                        or _fname(sub.func) == "shard_map"
+                    )
+                    for sub in ast.walk(node)
+                ):
+                    self.collective_fns.add(node.name)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = _fname(node.targets[0])
+                if not target:
+                    continue
+                value = node.value
+                if isinstance(value, ast.Call):
+                    jit, donate = _is_jit_expr(value)
+                    if jit:
+                        fn = None
+                        for a in value.args:
+                            if isinstance(a, ast.Name):
+                                fn = a.id
+                        self.jitted[target] = _Jitted(target, donate, None)
+                        if fn:
+                            self.jitted.setdefault(
+                                fn, _Jitted(fn, donate, None)
+                            )
+                    elif "shard_map" in _fname(value.func):
+                        self.collective_fns.add(target)
+
+    def is_jitted_name(self, name: str) -> bool:
+        return name in self.jitted
+
+
+def _resolve_jit_call(call: ast.Call, idx: _ModuleIndex, local_jitted: set) -> bool:
+    """Is this Call a dispatch into a jitted callable?"""
+    func = call.func
+    name = _fname(func)
+    if name and (name in local_jitted or idx.is_jitted_name(name)):
+        return True
+    # curried dispatch: self._search_jit(k)(args...) — the inner call's
+    # callee NAMES the jit factory
+    if isinstance(func, ast.Call) and "jit" in _fname(func.func).lower():
+        return True
+    return False
+
+
+def _upload_of_param(arg: ast.AST, params: set, jnp_aliases: set) -> bool:
+    """arg is a fresh host->device upload of an (unpadded) parameter:
+    jnp.asarray(p) / jnp.array(p) / jax.device_put(p)."""
+    if not isinstance(arg, ast.Call):
+        return False
+    func = arg.func
+    is_upload = False
+    if isinstance(func, ast.Attribute) and func.attr in ("asarray", "array"):
+        is_upload = isinstance(func.value, ast.Name) and func.value.id in jnp_aliases
+    if _fname(func) == "device_put":
+        is_upload = True
+    if not is_upload:
+        return False
+    return any(
+        isinstance(sub, ast.Name) and sub.id in params for sub in ast.walk(arg)
+    )
+
+
+def _has_ceil_div_mult(fn: ast.AST) -> bool:
+    """Detect ``((n + b - 1) // b) * b``: multiple-of-block padding whose
+    distinct-shape count is linear in the batch range."""
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult)):
+            continue
+        for left, right in ((node.left, node.right), (node.right, node.left)):
+            if (
+                isinstance(left, ast.BinOp)
+                and isinstance(left.op, ast.FloorDiv)
+                and ast.dump(left.right) == ast.dump(right)
+            ):
+                return True
+    return False
+
+
+def _transfer_call(call: ast.Call, idx: _ModuleIndex) -> "str | None":
+    """Name of the host<->device transfer primitive this Call is, if any."""
+    func = call.func
+    name = _fname(func)
+    if name in ("device_put", "device_get"):
+        return name
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr in ("asarray", "array")
+        and isinstance(func.value, ast.Name)
+        and func.value.id in idx.jnp_aliases
+    ):
+        return f"{func.value.id}.{func.attr}"
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr == "item"
+        and not call.args
+        and idx.has_jax
+    ):
+        return ".item()"
+    return None
+
+
+def _blocking_call(call: ast.Call) -> "str | None":
+    func = call.func
+    name = _fname(func)
+    if name == "block_until_ready":
+        return "block_until_ready"
+    if name == "device_get":
+        return "device_get"
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr == "item"
+        and not call.args
+    ):
+        return ".item()"
+    return None
+
+
+def _locky(expr: ast.AST) -> bool:
+    name = _fname(expr).lower()
+    if isinstance(expr, ast.Call):
+        name = _fname(expr.func).lower()
+    return any(tok in name for tok in ("lock", "mutex", "_mu", "cond", "cv"))
+
+
+def _inplace_on_param(fn: ast.AST, params: set) -> "int | None":
+    """Line of an ``p.at[...].set(...)``-style in-place update of a
+    parameter, if the function performs one."""
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("set", "add", "mul", "multiply", "min", "max")
+        ):
+            continue
+        target = node.func.value
+        if (
+            isinstance(target, ast.Subscript)
+            and isinstance(target.value, ast.Attribute)
+            and target.value.attr == "at"
+            and isinstance(target.value.value, ast.Name)
+            and target.value.value.id in params
+        ):
+            return node.lineno
+    return None
+
+
+def _arg_names(fn: "ast.FunctionDef | ast.AsyncFunctionDef") -> set:
+    a = fn.args
+    names = [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+class _FunctionScan:
+    """One hot/cold-classified function body walked with loop / branch /
+    lock context stacks."""
+
+    def __init__(
+        self,
+        fn: "ast.FunctionDef | ast.AsyncFunctionDef",
+        qualname: str,
+        cold: bool,
+        idx: _ModuleIndex,
+        lines: "list[str]",
+        filename: str,
+        serving_lane: bool,
+    ):
+        self.fn = fn
+        self.qualname = qualname
+        self.cold = cold
+        self.idx = idx
+        self.lines = lines
+        self.filename = filename
+        self.serving_lane = serving_lane
+        self.diags: list[Diagnostic] = []
+        self.params = _arg_names(fn)
+        end = getattr(fn, "end_lineno", None) or fn.lineno
+        self.text = "\n".join(lines[fn.lineno - 1 : end])
+        self.padded = any(tok in self.text for tok in _PAD_TOKENS)
+        self.pipelined = "copy_to_host_async" in self.text
+        self.ceil_pad = _has_ceil_div_mult(fn)
+        self.local_jitted: set = set()
+        self.is_jitted_def = fn.name in idx.jitted and idx.jitted[fn.name].fn is fn
+        self._collect_local_jitted()
+
+    def _collect_local_jitted(self) -> None:
+        for node in ast.walk(self.fn):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            value = node.value
+            candidates: "list[ast.AST]" = [value]
+            if isinstance(value, ast.IfExp):
+                candidates = [value.body, value.orelse]
+            for cand in candidates:
+                if isinstance(cand, ast.Call):
+                    jit, _don = _is_jit_expr(cand)
+                    if jit or "jit" in _fname(cand.func).lower():
+                        self.local_jitted.add(target.id)
+                elif _fname(cand) in self.idx.jitted:
+                    self.local_jitted.add(target.id)
+
+    def _emit(self, code: str, sev: str, lineno: int, message: str, **details: Any) -> None:
+        if _waived(self.lines, lineno, code):
+            return
+        self.diags.append(
+            Diagnostic(
+                code=code,
+                severity=sev,
+                message=message,
+                trace=f"{self.filename}:{lineno}",
+                node_name=self.qualname,
+                details=dict(details, file=self.filename, line=lineno),
+            )
+        )
+
+    def run(self) -> "list[Diagnostic]":
+        self._visit(self.fn, loop=0, conds=(), locks=0)
+        return self.diags
+
+    # ------------------------------------------------------------------
+    def _visit(self, node: ast.AST, loop: int, conds: tuple, locks: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs get their own scan
+            c_loop, c_conds, c_locks = loop, conds, locks
+            if isinstance(child, (ast.For, ast.AsyncFor)):
+                c_loop = loop + 1
+            elif isinstance(child, ast.While):
+                c_loop = loop + 1
+                c_conds = conds + (child.test,)
+            elif isinstance(child, ast.If):
+                c_conds = conds + (child.test,)
+            elif isinstance(child, (ast.With, ast.AsyncWith)):
+                if any(_locky(item.context_expr) for item in child.items):
+                    c_locks = locks + 1
+            if isinstance(child, ast.Call):
+                self._check_call(child, c_loop, c_conds, c_locks)
+            self._visit(child, c_loop, c_conds, c_locks)
+
+    def _check_call(self, call: ast.Call, loop: int, conds: tuple, locks: int) -> None:
+        idx = self.idx
+        # PW-J004: collectives under rank-dependent control flow (checked
+        # even on cold paths — a deadlock at init hangs the mesh too)
+        name = _fname(call.func)
+        is_collective = (
+            (isinstance(call.func, ast.Attribute) and call.func.attr in _COLLECTIVES)
+            or name == "shard_map"
+            or name in idx.collective_fns
+        )
+        if is_collective and any(_rank_dependent(t) for t in conds):
+            self._emit(
+                "PW-J004",
+                SEV_ERROR,
+                call.lineno,
+                f"collective/shard_map region ({name}) reachable under "
+                "rank-data-dependent control flow: ranks can disagree "
+                "about entering the collective and the mesh deadlocks — "
+                "hoist the branch out or make it rank-invariant",
+                collective=name,
+                function=self.qualname,
+            )
+
+        if self.is_jitted_def:
+            return  # inside a traced body: coercions/calls are free
+
+        # PW-J005: blocking sync while holding a lock / in an SLO lane
+        blocking = _blocking_call(call)
+        if blocking and (locks > 0 or self.serving_lane):
+            where = "while holding a lock" if locks > 0 else "inside an SLO serving lane"
+            self._emit(
+                "PW-J005",
+                SEV_WARNING,
+                call.lineno,
+                f"blocking device sync ({blocking}) {where}: every "
+                "waiter serializes behind one device round-trip — move "
+                "the sync outside the critical section or pipeline with "
+                "copy_to_host_async",
+                sync=blocking,
+                function=self.qualname,
+            )
+
+        if self.cold:
+            return
+
+        # PW-J002: transfer inside a hot loop (pipelined readback exempt)
+        if loop > 0 and not self.pipelined:
+            transfer = _transfer_call(call, idx)
+            if transfer:
+                self._emit(
+                    "PW-J002",
+                    SEV_WARNING,
+                    call.lineno,
+                    f"host<->device transfer ({transfer}) inside a "
+                    "per-iteration loop of a hot function: the loop "
+                    "stalls on the host link every pass — batch the "
+                    "transfer outside the loop or pipeline it with "
+                    "copy_to_host_async",
+                    transfer=transfer,
+                    function=self.qualname,
+                )
+
+        # PW-J001: unpadded shapes crossing a jit boundary
+        if _resolve_jit_call(call, idx, self.local_jitted):
+            if self.ceil_pad and "bucket_size" not in self.text:
+                self._emit(
+                    "PW-J001",
+                    SEV_ERROR,
+                    call.lineno,
+                    "jitted call padded to a multiple of a block size "
+                    "(ceil-div pattern): the signature count is still "
+                    "linear in the batch range, so every new size "
+                    "compiles a fresh program — round the BLOCK COUNT to "
+                    "a power of two (ops.bucketing.bucket_size) like "
+                    "JittedEncoder._pad_batch",
+                    function=self.qualname,
+                    pattern="ceil_div_multiple",
+                )
+            elif not self.padded:
+                for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                    if _upload_of_param(arg, self.params, idx.jnp_aliases):
+                        self._emit(
+                            "PW-J001",
+                            SEV_ERROR,
+                            call.lineno,
+                            "unpadded host batch uploaded straight into a "
+                            "jitted callable: every distinct batch size "
+                            "traces and compiles a new program — pad to a "
+                            "power-of-two bucket (ops.bucketing."
+                            "bucket_size) before the jit boundary",
+                            function=self.qualname,
+                            pattern="unpadded_param",
+                        )
+                        break
+
+
+def _iter_functions(
+    tree: ast.Module,
+) -> "Iterable[tuple[ast.FunctionDef | ast.AsyncFunctionDef, str, bool]]":
+    """(fn, qualname, cold) for every def, nested defs inheriting the
+    enclosing function's coldness (a hot helper inside _kmeans is cold)."""
+
+    def walk(body, prefix, inherited_cold):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{node.name}" if prefix else node.name
+                cold = inherited_cold or _cold(node.name)
+                yield node, qual, cold
+                yield from walk(node.body, qual + ".", cold)
+            elif isinstance(node, ast.ClassDef):
+                yield from walk(node.body, f"{prefix}{node.name}.", inherited_cold)
+
+    yield from walk(tree.body, "", False)
+
+
+def scan_source(source: str, filename: str = "<string>") -> "list[Diagnostic]":
+    """Run all PW-J checks over one module's source.  Returns findings;
+    raises nothing (a syntax error yields no findings — the module will
+    fail louder elsewhere)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    idx = _ModuleIndex(tree)
+    lines = source.splitlines()
+    serving_mod = f"{os.sep}serving{os.sep}" in filename or filename.startswith(
+        "serving"
+    )
+    out: list[Diagnostic] = []
+    jitted_defs_seen: set = set()
+    for fn, qual, cold in _iter_functions(tree):
+        lane = serving_mod and "lane" in fn.name.lower()
+        scan = _FunctionScan(fn, qual, cold, idx, lines, filename, lane)
+        out.extend(scan.run())
+        if scan.is_jitted_def:
+            jitted_defs_seen.add(fn.name)
+
+    # PW-J003: non-donated in-place jitted updates (module-wide so the
+    # donated-twin suppression can see every sibling)
+    for jname, j in idx.jitted.items():
+        if j.fn is None or j.donate:
+            continue
+        if jname.endswith("_safe"):
+            base = idx.jitted.get(jname[: -len("_safe")])
+            if base is not None and base.donate:
+                continue  # deliberate non-donating twin of a donated scatter
+        lineno = _inplace_on_param(j.fn, _arg_names(j.fn))
+        if lineno is None or _waived(lines, lineno, "PW-J003"):
+            continue
+        out.append(
+            Diagnostic(
+                code="PW-J003",
+                severity=SEV_WARNING,
+                message=(
+                    f"jitted function {jname!r} updates a device buffer "
+                    "in place (.at[...].set) without donate_argnums: the "
+                    "old and new buffer are live together, doubling HBM "
+                    "peak — donate the updated operands (or add a "
+                    "donated twin and keep this as the *_safe variant "
+                    "for concurrent-dispatch windows)"
+                ),
+                trace=f"{filename}:{lineno}",
+                node_name=jname,
+                details={"file": filename, "line": lineno, "function": jname},
+            )
+        )
+    return out
+
+
+#: memoized per-file scans: path -> (mtime, size, findings)
+_file_cache: dict[str, tuple[float, int, "list[Diagnostic]"]] = {}
+
+
+def scan_file(path: str) -> "list[Diagnostic]":
+    path = os.path.abspath(path)
+    try:
+        st = os.stat(path)
+    except OSError:
+        return []
+    cached = _file_cache.get(path)
+    if cached is not None and cached[0] == st.st_mtime and cached[1] == st.st_size:
+        return list(cached[2])
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    except OSError:
+        return []
+    rel = path
+    for root in (os.getcwd(), os.path.dirname(os.path.dirname(os.path.dirname(path)))):
+        if root and path.startswith(root + os.sep):
+            rel = os.path.relpath(path, root)
+            break
+    findings = scan_source(source, rel)
+    _file_cache[path] = (st.st_mtime, st.st_size, findings)
+    return list(findings)
+
+
+@dataclass(frozen=True)
+class DeviceReport:
+    """One device-safety sweep: files scanned + findings + the static
+    prediction the live counters are joined against."""
+
+    files: tuple
+    diagnostics: tuple
+
+    @property
+    def by_code(self) -> dict:
+        out: dict[str, int] = {}
+        for d in self.diagnostics:
+            out[d.code] = out.get(d.code, 0) + 1
+        return out
+
+    @property
+    def predicted_recompile_sites(self) -> int:
+        return self.by_code.get("PW-J001", 0)
+
+    @property
+    def errors(self) -> int:
+        return sum(1 for d in self.diagnostics if d.severity == SEV_ERROR)
+
+
+def scan_paths(paths: "Iterable[str]") -> DeviceReport:
+    files = []
+    diags: list[Diagnostic] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if p in files:
+            continue
+        files.append(p)
+        diags.extend(scan_file(p))
+    return DeviceReport(files=tuple(files), diagnostics=tuple(diags))
+
+
+def device_module_files() -> "list[str]":
+    """The repo's device surface: parallel/, ops/ and serving/ modules."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out: list[str] = []
+    for sub in ("parallel", "ops", "serving"):
+        out.extend(sorted(glob.glob(os.path.join(pkg, sub, "*.py"))))
+    return out
+
+
+_profile_cache: "dict | None" = None
+
+
+def device_profile(refresh: bool = False) -> dict:
+    """Static prediction for the /status join: scan the device surface
+    once per process and summarize.  ``predicted_recompile_sites == 0``
+    is the invariant the live jit-compile counter is checked against —
+    with no PW-J001 sites, a warmed serving loop must hold
+    ``pathway_tpu_jit_compiles_total`` flat."""
+    global _profile_cache
+    if _profile_cache is not None and not refresh:
+        return dict(_profile_cache)
+    report = scan_paths(device_module_files())
+    _profile_cache = {
+        "files_scanned": len(report.files),
+        "findings": sum(report.by_code.values()),
+        "errors": report.errors,
+        "by_code": report.by_code,
+        "predicted_recompile_sites": report.predicted_recompile_sites,
+    }
+    return dict(_profile_cache)
+
+
+# ----------------------------------------------------------------------
+# graph pass
+
+
+def _module_file(obj: Any) -> "str | None":
+    mod = sys.modules.get(type(obj).__module__)
+    f = getattr(mod, "__file__", None)
+    if not f:
+        return None
+    f = os.path.abspath(f)
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return f if f.startswith(pkg + os.sep) else None
+
+
+def _check_device_budget(graph: Any, facts: GraphFacts) -> "list[Diagnostic]":
+    """PW-M002 with a per-chip scope: the device-resident share of the
+    estimated state, split across PATHWAY_DEVICE_CHIPS (default: the
+    local jax device count), must fit PATHWAY_DEVICE_BUDGET_BYTES."""
+    from pathway_tpu.analysis.memory import (
+        EstimateParams,
+        build_report,
+        parse_budget,
+    )
+
+    budget = parse_budget(os.environ.get("PATHWAY_DEVICE_BUDGET_BYTES"))
+    if budget is None:
+        return []
+    chips = int(os.environ.get("PATHWAY_DEVICE_CHIPS", "0") or 0)
+    if chips <= 0:
+        try:
+            import jax
+
+            chips = max(1, jax.device_count())
+        except Exception:
+            chips = 1
+    report = build_report(graph, facts, params=EstimateParams.from_env())
+    by_node = {n.id: n for n in graph.nodes}
+    device_ops = []
+    for op in report.operators:
+        n = by_node.get(op.node_id)
+        if n is None:
+            continue
+        meta = getattr(n, "meta", None) or {}
+        devicey = bool(meta.get("index_upsert"))
+        adapter = getattr(n, "adapter", None)
+        if adapter is not None:
+            mod = type(adapter).__module__
+            if mod.startswith("pathway_tpu.parallel") or ".indexing" in mod:
+                devicey = True
+        if devicey:
+            device_ops.append(op)
+    if not device_ops:
+        return []
+    dev_bytes = sum(op.per_worker_bytes for op in device_ops)
+    per_chip = dev_bytes // chips
+    if per_chip <= budget:
+        return []
+    breakdown = [
+        (f"{op.name}#{op.node_id}", op.per_worker_bytes)
+        for op in sorted(device_ops, key=lambda o: o.per_worker_bytes, reverse=True)[:8]
+    ]
+    return [
+        Diagnostic(
+            code="PW-M002",
+            severity=SEV_WARNING,
+            message=(
+                f"estimated device-resident state {per_chip} B/chip "
+                f"(total {dev_bytes} B across {chips} chip(s)) exceeds "
+                f"PATHWAY_DEVICE_BUDGET_BYTES={budget} B: shard the "
+                "index wider or spill cold cells to host"
+            ),
+            details={
+                "scope": "device-per-chip",
+                "budget_bytes": budget,
+                "estimated_bytes": per_chip,
+                "chips": chips,
+                "breakdown": breakdown,
+            },
+        )
+    ]
+
+
+def check_device(graph: Any, facts: GraphFacts) -> "list[Diagnostic]":
+    """The ``pw.analyze()`` bridge: scan the device modules reachable
+    from this graph and attribute findings to the nodes that pull them
+    in.  Host-only graphs (no index adapters, no serving stage
+    annotations) scan nothing and return fast."""
+    out: list[Diagnostic] = []
+    try:
+        out.extend(_check_device_budget(graph, facts))
+    except Exception:
+        pass  # budget pricing must never mask the source scan
+
+    files: dict[str, tuple] = {}
+    serving_anchor = None
+    for n in graph.nodes:
+        meta = getattr(n, "meta", None) or {}
+        adapter = getattr(n, "adapter", None)
+        if adapter is not None:
+            f = _module_file(adapter)
+            if f:
+                files.setdefault(f, (n.id, type(adapter).__name__))
+        if serving_anchor is None and (
+            meta.get("serving") or meta.get("index_upsert")
+        ):
+            serving_anchor = n
+    if serving_anchor is not None:
+        # a serving graph executes the whole device surface (encoder,
+        # index, lanes); scan all of it, anchored to the annotated node
+        anchor = (serving_anchor.id, type(serving_anchor).__name__)
+        for f in device_module_files():
+            files.setdefault(os.path.abspath(f), anchor)
+
+    for f in sorted(files):
+        node_id, node_name = files[f]
+        for d in scan_file(f):
+            out.append(
+                dataclasses.replace(d, node_id=node_id, node_name=node_name)
+            )
+    return out

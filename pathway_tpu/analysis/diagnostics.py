@@ -102,6 +102,38 @@ CODE_INFO: dict[str, tuple[str, str]] = {
         "checkpoint bytes grow with stream length (stream-linear state is "
         "snapshotted): recovery-time targets degrade as the run ages",
     ),
+    "PW-J001": (
+        SEV_ERROR,
+        "unbounded jit-signature space on a hot path: a jitted callable's "
+        "traced shapes derive from unpadded batch/corpus sizes, so every "
+        "new size recompiles (pad to power-of-two buckets like "
+        "JittedEncoder._pad_batch)",
+    ),
+    "PW-J002": (
+        SEV_WARNING,
+        "host<->device transfer (device_put, implicit np->jnp coercion, "
+        ".item()/device_get readback) inside a per-query or per-epoch "
+        "loop: the hot path stalls on PCIe/ICI every iteration",
+    ),
+    "PW-J003": (
+        SEV_WARNING,
+        "in-place device-buffer update without donate_argnums: the "
+        "non-donated jit keeps input and output alive together, doubling "
+        "HBM peak vs the donated scatter updates sharded_knn uses",
+    ),
+    "PW-J004": (
+        SEV_ERROR,
+        "collective divergence: a shard_map/collective region is "
+        "reachable under rank-data-dependent Python control flow, so "
+        "chips disagree about entering the collective and the mesh "
+        "deadlocks",
+    ),
+    "PW-J005": (
+        SEV_WARNING,
+        "blocking device sync (block_until_ready, device-array readback) "
+        "inside an SLO scheduler lane or while holding an index lock: "
+        "one device round-trip serializes every waiter behind it",
+    ),
 }
 
 #: every code the analyzer can emit, with its fixed severity (derived —

@@ -346,6 +346,7 @@ def check_dead_columns(
     return out
 
 
+from pathway_tpu.analysis.device import check_device  # noqa: E402
 from pathway_tpu.analysis.distribution import check_distribution  # noqa: E402
 from pathway_tpu.analysis.memory import check_memory  # noqa: E402
 
@@ -357,4 +358,5 @@ ALL_PASSES = (
     check_dead_columns,
     check_distribution,
     check_memory,
+    check_device,
 )

@@ -53,6 +53,7 @@ def lint(
     werror: bool = False,
     plan: bool = False,
     memory: bool = False,
+    device: bool = False,
     baseline: str | None = None,
 ) -> int:
     """Build ``program``'s dataflow graph without running it and print
@@ -62,8 +63,12 @@ def lint(
     level); with ``memory=True`` also print the plan-aware capacity
     report (``pw.estimate_memory()``; scenario and budget come from the
     PATHWAY_MEMORY_* environment — a blown PATHWAY_MEMORY_BUDGET
-    surfaces as a PW-M002 finding above, not a separate exit path).
-    ``baseline`` names a JSON file mapping program basenames to
+    surfaces as a PW-M002 finding above, not a separate exit path);
+    with ``device=True`` additionally sweep the program file AND the
+    repo's whole device surface (``parallel/``, ``ops/``, ``serving/``)
+    through the PW-J device-safety analyzer, whether or not the built
+    graph reaches it — the self-lint mode ``scripts/lint_repo.sh
+    --device`` runs over ``examples/``.  ``baseline`` names a JSON file mapping program basenames to
     ACCEPTED warning codes: baselined warnings are still printed but do
     not fail ``--werror`` (errors are never baselined — an accepted
     hazard belongs in the config, not silenced in code).  Exit 1 on
@@ -81,6 +86,16 @@ def lint(
         accepted = set(table.get(os.path.basename(program), ()))
 
     diags = lint_file(program)
+    if device:
+        # file-level sweep: program source + the repo device modules,
+        # deduplicated against findings the graph pass already raised
+        from pathway_tpu.analysis import scan_device, device_module_files
+
+        seen = {(d.code, d.trace) for d in diags}
+        report = scan_device([program, *device_module_files()])
+        diags = list(diags) + [
+            d for d in report.diagnostics if (d.code, d.trace) not in seen
+        ]
     if diags:
         print(format_diagnostics(diags))
     if plan:
@@ -145,6 +160,12 @@ def main(argv: list[str] | None = None) -> int:
         help="also print the plan-aware memory capacity report",
     )
     lp.add_argument(
+        "--device",
+        action="store_true",
+        help="also sweep the program and the repo device modules "
+        "through the PW-J device-safety analyzer",
+    )
+    lp.add_argument(
         "--baseline",
         default=None,
         help="JSON file of accepted warning codes per program basename",
@@ -170,6 +191,7 @@ def main(argv: list[str] | None = None) -> int:
             werror=args.werror,
             plan=args.plan,
             memory=args.memory,
+            device=args.device,
             baseline=args.baseline,
         )
     return 2

@@ -1,0 +1,132 @@
+"""Live device-plane counters: jit compiles and host<->device bytes.
+
+The static device analyzer (``pathway_tpu/analysis/device.py``) PREDICTS
+where recompiles and transfers happen; this module MEASURES them, the
+same estimated-vs-measured join PR 15 gave memory capacity.  Three
+counters, all monotonic:
+
+- ``jit_compiles`` — one per actual XLA backend compile, observed via
+  ``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration``
+  event (cache hits emit nothing, so a warmed, shape-stable serving loop
+  holds this flat — the zero-recompile steady-state invariant the bench
+  ``--smoke`` gate enforces).
+- ``h2d_bytes`` / ``d2h_bytes`` — recorded at the repo's own transfer
+  call sites (``parallel/sharded_knn.py`` dispatch/collect,
+  ``parallel/executor.py`` chunk uploads/readbacks, ``parallel/
+  ivf_knn.py``); jax has no public per-transfer hook, so these count the
+  transfers *we* issue, which is exactly the set the analyzer reasons
+  about.
+
+Exported as ``pathway_tpu_jit_compiles_total`` /
+``pathway_tpu_h2d_bytes_total`` / ``pathway_tpu_d2h_bytes_total`` on
+``/metrics`` and joined against the static prediction on ``/status``.
+Importing this module never imports jax; ``install()`` is called lazily
+by the first transfer-recording caller (all of which already have jax
+loaded) and degrades to transfer-only counting when ``jax.monitoring``
+is unavailable.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+__all__ = [
+    "install",
+    "installed",
+    "record_h2d",
+    "record_d2h",
+    "snapshot",
+    "compile_count",
+    "reset_for_tests",
+]
+
+_lock = threading.Lock()
+_installed = False
+_install_failed = False
+
+# monotonic counters; ints under the GIL, guarded anyway for += races
+_counters: dict[str, int] = {
+    "jit_compiles": 0,
+    "h2d_bytes": 0,
+    "h2d_transfers": 0,
+    "d2h_bytes": 0,
+    "d2h_transfers": 0,
+}
+
+
+def _bump(key: str, amount: int) -> None:
+    with _lock:
+        _counters[key] += amount
+
+
+def _on_duration(event: str, duration: float, **kw: Any) -> None:
+    # one backend_compile_duration per actual XLA compile; the sibling
+    # jaxpr_trace / jaxpr_to_mlir events fire on cheap retraces too, so
+    # only the backend event counts as "a compile happened"
+    if event.endswith("backend_compile_duration"):
+        _bump("jit_compiles", 1)
+
+
+def install() -> bool:
+    """Register the jit-compile listener (idempotent).  Returns whether
+    compile counting is live; byte counters work either way."""
+    global _installed, _install_failed
+    if _installed:
+        return True
+    if _install_failed:
+        return False
+    with _lock:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            _install_failed = True
+            return False
+        _installed = True
+    return True
+
+
+def installed() -> bool:
+    return _installed
+
+
+def record_h2d(nbytes: int) -> None:
+    """Count one host->device upload of ``nbytes`` (call at the repo's
+    ``device_put``/np->jnp coercion sites)."""
+    install()
+    _bump("h2d_bytes", int(nbytes))
+    _bump("h2d_transfers", 1)
+
+
+def record_d2h(nbytes: int) -> None:
+    """Count one device->host readback of ``nbytes``."""
+    install()
+    _bump("d2h_bytes", int(nbytes))
+    _bump("d2h_transfers", 1)
+
+
+def compile_count() -> int:
+    """Current jit-compile total (installs the listener on first use so
+    bench warmup loops can bracket themselves)."""
+    install()
+    return _counters["jit_compiles"]
+
+
+def snapshot() -> dict[str, int]:
+    """Point-in-time copy of all counters (for /metrics and /status)."""
+    with _lock:
+        out = dict(_counters)
+    out["listener_installed"] = 1 if _installed else 0
+    return out
+
+
+def reset_for_tests() -> None:
+    """Zero the counters (the jax listener cannot be unregistered, so
+    tests bracket with deltas or reset)."""
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0

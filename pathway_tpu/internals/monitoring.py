@@ -298,6 +298,11 @@ class ProberStats:
     #: shed counters, "exchange": per-peer credit backlog, "serving":
     #: brownout level + sheds}; sections empty where not applicable)
     pressure: dict[str, Any] = field(default_factory=dict)
+    #: device-plane join: live jit-compile / H2D / D2H counters
+    #: (internals/device_counters.py) next to the static device-safety
+    #: prediction (analysis/device.py) — steady state must hold
+    #: jit_compiles flat once predicted_recompile_sites == 0
+    device: dict[str, Any] = field(default_factory=dict)
 
 
 def memory_stats(sched: Any) -> dict[str, Any]:
@@ -364,6 +369,7 @@ def collect_stats(sched: Any) -> ProberStats:
         serving=serving_stats(),
         memory=memory_stats(sched),
         pressure=pressure_stats(sched),
+        device=device_stats(),
     )
 
 
@@ -394,6 +400,33 @@ def pressure_stats(sched: Any) -> dict[str, Any]:
             "brownout_shed_total": srv.get("brownout_shed_total", {}),
             "shed_total": srv.get("shed_total", {}),
         }
+    return out
+
+
+def device_stats() -> dict[str, Any]:
+    """Predicted-vs-observed device-plane join.  ``counters`` is the
+    live side (jit compiles, H2D/D2H bytes — zeros until a device module
+    runs); ``static`` is the analyzer's prediction over the device
+    source.  Keyed off ``sys.modules`` like :func:`serving_stats`: a
+    host-only process that never imported the device layer pays neither
+    a jax import nor an AST sweep on every scrape."""
+    import sys
+
+    if sys.modules.get("pathway_tpu.internals.device_counters") is None:
+        return {}
+    out: dict[str, Any] = {}
+    try:
+        from pathway_tpu.internals import device_counters
+
+        out["counters"] = device_counters.snapshot()
+    except Exception:
+        return {}
+    try:
+        from pathway_tpu.analysis.device import device_profile
+
+        out["static"] = device_profile()
+    except Exception:
+        pass
     return out
 
 

@@ -371,6 +371,34 @@ def _metrics_text(sched: Any) -> str:
                     f"pathway_tpu_serving_brownout_shed_total"
                     f'{{tenant_class="{label}"}} {n}'
                 )
+    device = _device_snapshot()
+    ctr = device.get("counters", {})
+    if ctr:
+        lines.append("# TYPE pathway_tpu_jit_compiles_total counter")
+        lines.append(
+            f"pathway_tpu_jit_compiles_total {ctr.get('jit_compiles', 0)}"
+        )
+        lines.append("# TYPE pathway_tpu_h2d_bytes_total counter")
+        lines.append(f"pathway_tpu_h2d_bytes_total {ctr.get('h2d_bytes', 0)}")
+        lines.append("# TYPE pathway_tpu_d2h_bytes_total counter")
+        lines.append(f"pathway_tpu_d2h_bytes_total {ctr.get('d2h_bytes', 0)}")
+        lines.append("# TYPE pathway_tpu_h2d_transfers_total counter")
+        lines.append(
+            f"pathway_tpu_h2d_transfers_total {ctr.get('h2d_transfers', 0)}"
+        )
+        lines.append("# TYPE pathway_tpu_d2h_transfers_total counter")
+        lines.append(
+            f"pathway_tpu_d2h_transfers_total {ctr.get('d2h_transfers', 0)}"
+        )
+        static = device.get("static", {})
+        if static:
+            lines.append(
+                "# TYPE pathway_tpu_device_predicted_recompile_sites gauge"
+            )
+            lines.append(
+                f"pathway_tpu_device_predicted_recompile_sites "
+                f"{static.get('predicted_recompile_sites', 0)}"
+            )
     return "\n".join(lines) + "\n# EOF\n"
 
 
@@ -408,6 +436,12 @@ def _pressure_snapshot(sched: Any) -> dict[str, Any]:
     from pathway_tpu.internals.monitoring import pressure_stats
 
     return pressure_stats(sched)
+
+
+def _device_snapshot() -> dict[str, Any]:
+    from pathway_tpu.internals.monitoring import device_stats
+
+    return device_stats()
 
 
 def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
@@ -467,6 +501,11 @@ def start_http_server(sched: Any, port: int | None = None) -> threading.Thread:
                         # buffer, exchange credit windows, brownout
                         # (ISSUE 16)
                         "pressure": _pressure_snapshot(sched),
+                        # device-plane join: live jit-compile + H2D/D2H
+                        # counters next to the static device-safety
+                        # prediction (analysis/device.py); a warmed
+                        # serving loop must hold jit_compiles flat
+                        "device": _device_snapshot(),
                         # degraded-mode summary (ISSUE 13): one glance says
                         # whether answers are currently partial and why
                         "degraded": {

@@ -24,6 +24,7 @@ from pathway_tpu.models.encoder import (
     TextEncoderModel,
     encoder_param_specs,
 )
+from pathway_tpu.internals import device_counters as _devctr
 from pathway_tpu.models.tokenizer import Tokenizer, get_tokenizer
 from pathway_tpu.ops.bucketing import bucket_size
 
@@ -219,6 +220,7 @@ class JittedEncoder:
             ids = ids.astype(np.int16, copy=False)
             mask = mask.astype(np.uint8, copy=False)
             tps = tps.astype(np.uint8, copy=False)
+        _devctr.record_h2d(ids.nbytes + mask.nbytes + tps.nbytes)
         args = [jnp.asarray(ids), jnp.asarray(mask), jnp.asarray(tps)]
         if self._in_batch_sharding is not None:
             args = [jax.device_put(a, self._in_batch_sharding) for a in args]
@@ -231,7 +233,13 @@ class JittedEncoder:
 
     def _run(self, ids: np.ndarray, mask: np.ndarray, tps: np.ndarray) -> np.ndarray:
         out, n = self._dispatch(ids, mask, tps)
-        return np.asarray(out)[:n]
+        return self._readback(out)[:n]
+
+    @staticmethod
+    def _readback(out: Any) -> np.ndarray:
+        host = np.asarray(out)
+        _devctr.record_d2h(host.nbytes)
+        return host
 
     def _chunks(self, texts: Sequence[str], pair: Sequence[str] | None):
         for i in range(0, len(texts), self.max_batch):
@@ -255,10 +263,10 @@ class JittedEncoder:
             inflight.append(self._dispatch(ids, mask, tps))
             if len(inflight) >= self.pipeline_depth:
                 out, nrows = inflight.popleft()
-                outs.append(np.asarray(out)[:nrows])
+                outs.append(self._readback(out)[:nrows])
         while inflight:
             out, nrows = inflight.popleft()
-            outs.append(np.asarray(out)[:nrows])
+            outs.append(self._readback(out)[:nrows])
         return outs
 
     # ------------------------------------------------------------------

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pathway_tpu.internals import device_counters as _devctr
 from pathway_tpu.ops.bucketing import bucket_size, pad_rows
 from pathway_tpu.ops.topk import NEG_INF
 
@@ -199,8 +200,12 @@ class IvfKnnIndex:
 
     def _assign_cells(self, vectors: np.ndarray) -> np.ndarray:
         # cos/dot: nearest centroid by inner product (centroids come from
-        # normalized data for cos)
-        return np.asarray(_assign_ip(jnp.asarray(vectors), self._centroids))
+        # normalized data for cos).  Rows pad to a power-of-two bucket so
+        # arbitrary batch sizes reuse a logarithmic set of compiled
+        # programs (pad rows are zeros; their assignment is sliced off)
+        n = vectors.shape[0]
+        vpad = pad_rows(np.ascontiguousarray(vectors, np.float32), bucket_size(n))
+        return np.asarray(_assign_ip(jnp.asarray(vpad), self._centroids))[:n]
 
     def add(self, items: Sequence[tuple[Any, np.ndarray]]) -> None:
         if not items:
@@ -357,8 +362,16 @@ class IvfKnnIndex:
             queries = self._normalize(queries)
         nprobe = min(nprobe or self.nprobe, self.nlist)
         k_eff = min(k, nprobe * self.cell_cap)
-        pad_q = ((nq + self.query_block - 1) // self.query_block) * self.query_block
+        # pad the BLOCK COUNT to a power of two, not just the row count to
+        # a multiple of query_block: multiple-of-block padding still
+        # compiles one program per distinct block count (linear in the
+        # query-batch range), which is a recompile storm under mixed
+        # serving batch sizes
+        pad_q = self.query_block * bucket_size(
+            -(-nq // self.query_block), min_bucket=1
+        )
         qpad = pad_rows(queries, pad_q)
+        _devctr.record_h2d(qpad.nbytes)
         run = self._search_jit(k_eff, nprobe)
         out = run(jnp.asarray(qpad), self._centroids, self._cells, self._valid)
         for a in out:
@@ -366,6 +379,7 @@ class IvfKnnIndex:
             if copy_async is not None:
                 copy_async()
         vals, ids = jax.device_get(out)
+        _devctr.record_d2h(vals.nbytes + ids.nbytes)
         rows: list[list[tuple[Any, float]]] = []
         for qi in range(nq):
             row = []

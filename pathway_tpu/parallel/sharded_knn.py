@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from pathway_tpu.internals import device_counters as _devctr
 from pathway_tpu.ops.bucketing import bucket_size, pad_rows
 from pathway_tpu.ops.distances import dot_scores, l2sq_distances, normalize
 from pathway_tpu.ops.shard_map_compat import shard_map
@@ -230,6 +231,7 @@ class ShardedKnnIndex:
             vectors = vectors / norms
         vals = vectors.astype(np.dtype(self.dtype), copy=False)
         vals = pad_rows(vals, b)
+        _devctr.record_h2d(vals.nbytes + slots.nbytes)
         scatter = self._scatter_set if self._inflight == 0 else self._scatter_set_safe
         self._vectors, self._valid = scatter(
             self._vectors, self._valid, jnp.asarray(slots), jnp.asarray(vals)
@@ -383,6 +385,7 @@ class ShardedKnnIndex:
             return (None, nq, k, self._version)
         k_eff = min(k, self.capacity)
         qb = pad_rows(queries, bucket_size(nq, min_bucket=1))
+        _devctr.record_h2d(qb.nbytes)
         out = self._search_jit(k_eff)(jnp.asarray(qb), self._vectors, self._valid)
         # start the device->host copy NOW, without blocking: on remote/
         # tunneled backends the result transfer then overlaps later
@@ -423,6 +426,7 @@ class ShardedKnnIndex:
         # one host readback for both arrays (each device_get is a full
         # host<->device round trip; they dominate single-query latency)
         vals, idx = jax.device_get(out)
+        _devctr.record_d2h(vals.nbytes + idx.nbytes)
         vals = vals[:nq]
         idx = idx[:nq]
         rows: list[list[tuple[Any, float]]] = []

@@ -60,6 +60,20 @@ AST-based checks over ``engine/cluster.py`` and ``engine/scheduler.py``
   whose bound lives elsewhere (byte-credit accounting, an epoch
   budget) are allowlisted with an ``# lk009: <why it is bounded>``
   comment on the construction line.
+- **LK010** — device work under a lock: in files that import jax
+  (override with ``device_path=``), a device dispatch or host<->device
+  transfer inside a lexical ``with <lock>:`` block — ``jax.device_put``
+  / ``device_get``, any ``jnp.*`` call (implicit upload + dispatch), a
+  ``.block_until_ready()`` sync, or a call whose name marks it jitted
+  (``*_jit*`` / assigned from ``jax.jit``).  Device dispatch enqueues
+  work whose completion the lock holder may then wait on, so every
+  other thread contending the lock eats the device's latency; a
+  blocking sync under an index lock turns one slow kernel into a
+  serving-wide stall.  Stage arrays outside the lock and hold it only
+  for the pointer swap.  ``copy_to_host_async`` is exempt (it is the
+  non-blocking idiom this check pushes toward); a transfer whose
+  bounded cost is understood is allowlisted with an ``# lk010: <why>``
+  comment on the call line.
 - **LK006** — serving-path wait discipline: in files under ``serving/``
   (override with ``serving_path=``) every queue handoff must ride the
   WakeupHub and every admission-path wait must be finite.  Flags bare
@@ -632,6 +646,129 @@ def _check_pressure_queues(
         )
 
 
+#: methods whose call is a device dispatch or transfer no matter the
+#: receiver (jax module functions and Array methods)
+_DEVICE_METHODS = {"device_put", "device_get", "block_until_ready"}
+
+
+def _jax_aliases(tree: ast.AST) -> set[str]:
+    """Local names bound to the jax package or its submodules
+    (``import jax``, ``import jax.numpy as jnp``, ``from jax import
+    numpy as jnp``); empty when the file never imports jax."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax" or a.name.startswith("jax."):
+                    aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == "jax" or node.module.startswith("jax."):
+                for a in node.names:
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _check_device_under_lock(
+    tree: ast.AST, source: str, filename: str, findings: list[Finding]
+) -> None:
+    """LK010: device dispatch or host<->device transfer while holding a
+    lock.  Device calls enqueue asynchronous work — but the enqueue
+    itself may block on a compile, an implicit upload serialises on the
+    transfer engine, and an explicit sync (``block_until_ready`` /
+    ``device_get``) parks the lock holder for the kernel's full
+    latency.  Every contending thread then queues behind device time.
+    The scatter-swap idiom (stage arrays outside the lock, ``with
+    lock:`` only for the reference swap) keeps critical sections
+    device-free.  ``copy_to_host_async`` is exempt; accepted transfers
+    carry an ``# lk010: <why bounded>`` comment on the call line."""
+    aliases = _jax_aliases(tree)
+    lines = source.splitlines()
+    # module/class-level names assigned from jax.jit(...) — calls to
+    # these dispatch a (possibly compiling) executable
+    jitted: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        f = node.value.func
+        is_jit = (
+            isinstance(f, ast.Attribute)
+            and f.attr == "jit"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in aliases
+        ) or (isinstance(f, ast.Name) and f.id == "jit" and "jit" in aliases)
+        if not is_jit:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                jitted.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                jitted.add(t.attr)
+
+    def _root_name(expr: ast.expr) -> str | None:
+        while isinstance(expr, ast.Attribute):
+            expr = expr.value
+        return expr.id if isinstance(expr, ast.Name) else None
+
+    def _device_call(call: ast.Call) -> str | None:
+        """A short description of why this call touches the device."""
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "copy_to_host_async":
+                return None  # the non-blocking idiom; explicitly exempt
+            if f.attr in _DEVICE_METHODS:
+                return f"{f.attr}()"
+            root = _root_name(f.value)
+            if root in aliases:
+                return f"{root}.{f.attr}()"
+            if "jit" in f.attr.lower() or f.attr in jitted:
+                return f"jitted call {f.attr}()"
+            return None
+        if isinstance(f, ast.Name):
+            if "jit" in f.id.lower() or f.id in jitted:
+                return f"jitted call {f.id}()"
+        return None
+
+    def walk(node: ast.AST, held: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                # a nested def under `with lock:` runs later, at an
+                # unknown lock state — scan its body lock-free
+                walk(child, None)
+                continue
+            inner = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    ln = _lock_name(item.context_expr)
+                    if ln is not None:
+                        inner = ln
+            if isinstance(child, ast.Call) and held is not None:
+                what = _device_call(child)
+                line_src = (
+                    lines[child.lineno - 1]
+                    if child.lineno <= len(lines)
+                    else ""
+                )
+                if what is not None and "lk010:" not in line_src:
+                    findings.append(
+                        Finding(
+                            filename,
+                            child.lineno,
+                            "LK010",
+                            f"{what} while holding {held!r}: device "
+                            "dispatch/transfer under a lock makes every "
+                            "contending thread wait out device latency; "
+                            "stage arrays outside the lock and hold it "
+                            "only for the swap, or document the bound "
+                            "with an '# lk010: ...' comment",
+                        )
+                    )
+            walk(child, inner)
+
+    walk(tree, None)
+
+
 def check_source(
     source: str,
     filename: str,
@@ -640,19 +777,26 @@ def check_source(
     cluster_path: bool | None = None,
     serving_path: bool | None = None,
     pressure_path: bool | None = None,
+    device_path: bool | None = None,
 ) -> list[Finding]:
     """Lint one file's source.  ``scheduler_path`` controls LK003
     (default: filename contains 'scheduler'); ``cluster_path`` controls
     LK005 (default: filename contains 'cluster'); ``serving_path``
     controls LK006 (default: the path contains 'serving');
     ``pressure_path`` controls LK009 (default: the path contains an
-    ``engine/``, ``io/``, or ``serving/`` segment)."""
+    ``engine/``, ``io/``, or ``serving/`` segment); ``device_path``
+    controls LK010 (default: the file imports jax)."""
     findings: list[Finding] = []
     tree = ast.parse(source, filename=filename)
 
     _FunctionScanner(filename, findings).visit(tree)
     _check_notify_discipline(tree, filename, findings)
     _check_unbounded_growth(tree, filename, findings)
+
+    if device_path is None:
+        device_path = bool(_jax_aliases(tree))
+    if device_path:
+        _check_device_under_lock(tree, source, filename, findings)
 
     if pressure_path is None:
         p = "/" + filename.replace(os.sep, "/").lstrip("/")
@@ -1055,6 +1199,12 @@ DEFAULT_TARGETS = (
     "pathway_tpu/serving/graph.py",
     "pathway_tpu/serving/loadgen.py",
     "pathway_tpu/internals/tracing.py",
+    # device surface: LK010 (device work under a lock) is the live check
+    # here; the other per-file checks run too and must stay clean
+    "pathway_tpu/parallel/sharded_knn.py",
+    "pathway_tpu/parallel/ivf_knn.py",
+    "pathway_tpu/parallel/executor.py",
+    "pathway_tpu/stdlib/indexing/segments.py",
 )
 
 

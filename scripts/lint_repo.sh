@@ -40,10 +40,14 @@ else
     # concrete per-worker budget — a blown budget is a PW-M002 warning
     # (baselineable), O(stream) state reaching a sink is a PW-M001 error
     # (never baselineable)
+    # --device adds the PW-J device-safety sweep over the example AND
+    # the repo device surface (parallel/, ops/, serving/): PW-J001/J004
+    # are errors and never baselineable — a recompile storm or a
+    # collective deadlock does not get grandfathered in
     for ex in "$REPO"/examples/*.py; do
         if ! JAX_PLATFORMS=cpu \
             PATHWAY_MEMORY_BUDGET="${PATHWAY_MEMORY_BUDGET:-4GiB}" \
-            "$PYTHON" -m pathway_tpu.cli lint --werror --memory \
+            "$PYTHON" -m pathway_tpu.cli lint --werror --memory --device \
             --baseline "$REPO/scripts/lint_baseline.json" "$ex"; then
             SELF_FAIL=1
         fi

@@ -83,6 +83,16 @@ def test_bench_smoke_emits_wellformed_metrics():
         ratio = extra[f"capacity_{graph}_ratio"]
         assert 1.0 / 3.0 <= ratio <= 3.0, (graph, ratio)
         assert extra[f"capacity_{graph}_measured_bytes"] > 0, graph
+    # the device cross-validation ran and its gates held (ISSUE 20: a
+    # warmed serving loop records ZERO steady-state compiles, the
+    # shape-unstable control proves the counter is live, and the static
+    # sweep predicts no recompile sites; any breach raises inside
+    # bench_device and would surface here as device_error)
+    assert "device_error" not in extra, extra.get("device_error")
+    assert extra["device_steady_state_compiles"] == 0
+    assert extra["device_unbucketed_compiles"] > 0
+    assert extra["device_predicted_recompile_sites"] == 0
+    assert extra["device_warmup_compiles"] < extra["device_unbucketed_compiles"]
     # the tracing-overhead gate ran and held (ISSUE 14: the always-on
     # flight recorder must cost <=2% on both workloads; a gate trip
     # raises inside bench.py and surfaces here as tracing_error)

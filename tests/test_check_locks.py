@@ -556,6 +556,125 @@ def test_lk007_whole_repo_roots_exist(cl):
         assert (REPO / root).is_dir(), root
 
 
+# ---------------------------------------------------------------- LK010
+
+
+def test_lk010_device_put_under_lock_flagged(cl):
+    src = (
+        "import jax\n"
+        "class Index:\n"
+        "    def add(self, v):\n"
+        "        with self._lock:\n"
+        "            self._buf = jax.device_put(v)\n"
+    )
+    findings = cl.check_source(src, "x.py")
+    assert [f.code for f in findings] == ["LK010"]
+    assert "device_put" in findings[0].message
+
+
+def test_lk010_jnp_dispatch_and_sync_under_lock_flagged(cl):
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "class Index:\n"
+        "    def merge(self, xs):\n"
+        "        with self._mutex:\n"
+        "            self._buf = jnp.stack(xs)\n"
+        "            self._buf.block_until_ready()\n"
+    )
+    findings = cl.check_source(src, "x.py")
+    assert [f.code for f in findings] == ["LK010", "LK010"]
+
+
+def test_lk010_jitted_call_under_lock_flagged(cl):
+    src = (
+        "import jax\n"
+        "class Index:\n"
+        "    def query(self, q):\n"
+        "        with self._lock:\n"
+        "            return self._search_jit(5)(q)\n"
+    )
+    findings = cl.check_source(src, "x.py")
+    assert [f.code for f in findings] == ["LK010"]
+
+
+def test_lk010_stage_outside_swap_inside_clean(cl):
+    # the scatter-swap idiom: device work staged lock-free, the lock
+    # held only for the reference swap
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "class Index:\n"
+        "    def add(self, v):\n"
+        "        dev = jax.device_put(v)\n"
+        "        with self._lock:\n"
+        "            self._buf = dev\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk010_copy_to_host_async_exempt(cl):
+    src = (
+        "import jax\n"
+        "class Index:\n"
+        "    def pipeline(self, out):\n"
+        "        with self._lock:\n"
+        "            out.copy_to_host_async()\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk010_allowlist_comment_clean(cl):
+    src = (
+        "import jax\n"
+        "class Index:\n"
+        "    def add(self, v):\n"
+        "        with self._lock:\n"
+        "            self._buf = jax.device_put(v)  # lk010: 4 KiB control block\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk010_no_jax_import_not_a_device_path(cl):
+    # without a jax import the file is host-only: device_put here is
+    # some other library's name, not a transfer
+    src = (
+        "class Index:\n"
+        "    def add(self, v):\n"
+        "        with self._lock:\n"
+        "            self._buf = device_put(v)\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk010_nested_def_under_lock_runs_later(cl):
+    # a closure defined under the lock executes at an unknown lock
+    # state — its body is scanned lock-free
+    src = (
+        "import jax.numpy as jnp\n"
+        "class Index:\n"
+        "    def later(self):\n"
+        "        with self._lock:\n"
+        "            def cb():\n"
+        "                return jnp.stack(self._bufs)\n"
+        "            self._cb = cb\n"
+    )
+    assert cl.check_source(src, "x.py") == []
+
+
+def test_lk010_device_path_override(cl):
+    # device_path=True forces the check on a file with no jax import:
+    # jitted-name dispatch still resolves
+    src = (
+        "class Index:\n"
+        "    def query(self, q):\n"
+        "        with self._lock:\n"
+        "            return self._encode_jit(q)\n"
+    )
+    findings = cl.check_source(src, "x.py", device_path=True)
+    assert [f.code for f in findings] == ["LK010"]
+
+
 def test_engine_files_clean():
     """The shipped cluster/scheduler must satisfy the discipline; this
     is the gate that keeps future edits honest."""

@@ -944,3 +944,357 @@ def test_strict_mode_surfaces_distribution_errors(tmp_path):
 
     counts = count_by_severity(ei.value.diagnostics)
     assert counts.get("error", 0) >= 1  # the /status + metrics payload
+
+
+# ------------------------------------------- PW-J device safety (ISSUE 20)
+
+
+def _dscan(src, filename="pathway_tpu/parallel/mod.py"):
+    from pathway_tpu.analysis.device import scan_source
+
+    return scan_source(src, filename)
+
+
+_JIT_PRELUDE = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "\n"
+    "_score = jax.jit(lambda q, c: q @ c.T)\n"
+    "\n"
+)
+
+
+def test_j001_unpadded_param_into_jit():
+    src = _JIT_PRELUDE + (
+        "def search(queries, corpus):\n"
+        "    return _score(jnp.asarray(queries), corpus)\n"
+    )
+    diags = _dscan(src)
+    assert codes(diags) == ["PW-J001"]
+    assert diags[0].severity == SEV_ERROR
+    assert diags[0].details["pattern"] == "unpadded_param"
+
+
+def test_j001_bucketed_padding_clean():
+    src = _JIT_PRELUDE + (
+        "def search(queries, corpus):\n"
+        "    queries = pad_rows(queries, bucket_size(len(queries)))\n"
+        "    return _score(jnp.asarray(queries), corpus)\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j001_ceil_div_multiple_padding():
+    # multiple-of-block padding still compiles one program per distinct
+    # block count — the recompile storm the IVF fix removed
+    src = _JIT_PRELUDE + (
+        "def search(queries, corpus):\n"
+        "    n = queries.shape[0]\n"
+        "    pad = ((n + 8 - 1) // 8) * 8\n"
+        "    queries = pad_rows(queries, pad)\n"
+        "    return _score(jnp.asarray(queries), corpus)\n"
+    )
+    diags = _dscan(src)
+    assert codes(diags) == ["PW-J001"]
+    assert diags[0].details["pattern"] == "ceil_div_multiple"
+
+
+def test_j001_ceil_div_over_bucketed_blocks_clean():
+    # the fixed IVF shape: block COUNT rounded to a power of two
+    src = _JIT_PRELUDE + (
+        "def search(queries, corpus, qb):\n"
+        "    n = queries.shape[0]\n"
+        "    pad = qb * bucket_size(-(-n // qb), min_bucket=1)\n"
+        "    queries = pad_rows(queries, pad)\n"
+        "    return _score(jnp.asarray(queries), corpus)\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j001_cold_path_clean():
+    # train/init/restore paths compile once by design
+    src = _JIT_PRELUDE + (
+        "def train_step(batch, corpus):\n"
+        "    return _score(jnp.asarray(batch), corpus)\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j001_waiver_comment_suppresses():
+    src = _JIT_PRELUDE + (
+        "def search(queries, corpus):\n"
+        "    return _score(jnp.asarray(queries), corpus)"
+        "  # pw-j001: fixed upstream batch size\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j002_transfer_in_hot_loop():
+    src = (
+        "import jax\n"
+        "def serve(batches):\n"
+        "    out = []\n"
+        "    for b in batches:\n"
+        "        out.append(jax.device_put(b))\n"
+        "    return out\n"
+    )
+    diags = _dscan(src)
+    assert codes(diags) == ["PW-J002"]
+    assert diags[0].severity == SEV_WARNING
+
+
+def test_j002_pipelined_readback_clean():
+    # copy_to_host_async is the cure, not the disease
+    src = (
+        "import jax\n"
+        "def serve(outs):\n"
+        "    for o in outs:\n"
+        "        o.copy_to_host_async()\n"
+        "    return jax.device_get(outs)\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j002_comprehension_not_a_loop():
+    # a device_put list comprehension is one batched staging step, not a
+    # per-iteration stall (executor._dispatch idiom)
+    src = (
+        "import jax\n"
+        "def dispatch(args, shardings):\n"
+        "    return [jax.device_put(a, s) for a, s in zip(args, shardings)]\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j003_inplace_without_donation():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def scatter(buf, idx, vals):\n"
+        "    return buf.at[idx].set(vals)\n"
+    )
+    diags = _dscan(src)
+    assert codes(diags) == ["PW-J003"]
+    assert diags[0].severity == SEV_WARNING
+
+
+def test_j003_donated_scatter_clean():
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def scatter(buf, idx, vals):\n"
+        "    return buf.at[idx].set(vals)\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j003_safe_twin_of_donated_scatter_clean():
+    # sharded_knn's deliberate non-donating *_safe twin for
+    # concurrent-dispatch windows
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def scatter(buf, idx, vals):\n"
+        "    return buf.at[idx].set(vals)\n"
+        "@jax.jit\n"
+        "def scatter_safe(buf, idx, vals):\n"
+        "    return buf.at[idx].set(vals)\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j004_collective_under_rank_branch():
+    src = (
+        "import jax\n"
+        "def exchange(x, rank):\n"
+        "    if rank == 0:\n"
+        "        return jax.lax.psum(x, 'i')\n"
+        "    return x\n"
+    )
+    diags = _dscan(src)
+    assert codes(diags) == ["PW-J004"]
+    assert diags[0].severity == SEV_ERROR
+
+
+def test_j004_fires_even_on_cold_paths():
+    # a deadlock at init hangs the mesh too — coldness is no excuse
+    src = (
+        "import jax\n"
+        "def init_mesh(x, rank):\n"
+        "    if rank == 0:\n"
+        "        return jax.lax.psum(x, 'i')\n"
+        "    return x\n"
+    )
+    assert codes(_dscan(src)) == ["PW-J004"]
+
+
+def test_j004_static_config_branch_clean():
+    # every process computes the same truth value — not divergent
+    src = (
+        "import jax\n"
+        "class Index:\n"
+        "    def exchange(self, x):\n"
+        "        if self.mesh is not None:\n"
+        "            return jax.lax.psum(x, 'i')\n"
+        "        return x\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j005_blocking_sync_under_lock():
+    src = (
+        "import jax\n"
+        "class Index:\n"
+        "    def swap(self, new):\n"
+        "        with self._lock:\n"
+        "            self._buf = new\n"
+        "            self._buf.block_until_ready()\n"
+    )
+    diags = _dscan(src)
+    assert codes(diags) == ["PW-J005"]
+    assert diags[0].severity == SEV_WARNING
+
+
+def test_j005_sync_outside_lock_clean():
+    src = (
+        "import jax\n"
+        "class Index:\n"
+        "    def swap(self, new):\n"
+        "        new.block_until_ready()\n"
+        "        with self._lock:\n"
+        "            self._buf = new\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_j005_serving_lane_readback():
+    src = (
+        "import jax\n"
+        "def answer_lane(out):\n"
+        "    return out.item()\n"
+    )
+    diags = _dscan(src, filename="pathway_tpu/serving/lanes.py")
+    assert codes(diags) == ["PW-J005"]
+    # same function outside the serving tree: nothing to serialize
+    assert _dscan(src, filename="pathway_tpu/parallel/lanes.py") == []
+
+
+def test_jitted_body_is_exempt_from_hot_checks():
+    # inside a traced body coercions are free: they fold into the program
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def kernel(xs):\n"
+        "    acc = jnp.asarray(0.0)\n"
+        "    for x in xs:\n"
+        "        acc = acc + jnp.asarray(x)\n"
+        "    return acc\n"
+    )
+    assert _dscan(src) == []
+
+
+def test_device_surface_scans_clean():
+    """Acceptance: the committed device modules carry zero PW-J errors
+    and zero predicted recompile sites — the static half of the
+    zero-recompile invariant BENCH_device.json cross-validates live."""
+    from pathway_tpu.analysis.device import device_module_files, scan_paths
+
+    report = scan_paths(device_module_files())
+    assert len(report.files) >= 10
+    assert report.errors == 0, report.diagnostics
+    assert report.predicted_recompile_sites == 0
+
+
+def test_device_profile_shape():
+    from pathway_tpu.analysis.device import device_profile
+
+    prof = device_profile(refresh=True)
+    assert set(prof) >= {
+        "files_scanned",
+        "findings",
+        "errors",
+        "by_code",
+        "predicted_recompile_sites",
+    }
+    assert prof["errors"] == 0
+
+
+def test_j_codes_registered():
+    from pathway_tpu.analysis.diagnostics import CODE_INFO, SEV_ERROR, SEV_WARNING
+
+    assert CODE_INFO["PW-J001"][0] == SEV_ERROR
+    assert CODE_INFO["PW-J002"][0] == SEV_WARNING
+    assert CODE_INFO["PW-J003"][0] == SEV_WARNING
+    assert CODE_INFO["PW-J004"][0] == SEV_ERROR
+    assert CODE_INFO["PW-J005"][0] == SEV_WARNING
+
+
+def test_device_pass_runs_in_analyze_for_serving_graphs():
+    """check_device is wired into ALL_PASSES: a graph whose node carries
+    a serving stage annotation sweeps the whole device surface."""
+    from pathway_tpu.analysis.passes import ALL_PASSES
+    from pathway_tpu.analysis.device import check_device
+
+    assert check_device in ALL_PASSES
+    t = _static_table()
+    t.select(w=pw.this.word)._capture_node()
+    for n in G.engine_graph.nodes:
+        n.meta["serving"] = {"stage": "ingest"}
+        break
+    diags = analyze()
+    assert not [d for d in diags if d.code.startswith("PW-J")], diags
+
+
+def _indexed_docs_graph():
+    """Python-fed docs feeding a KNN index (the device-resident state
+    the per-chip budget prices)."""
+    from pathway_tpu.stdlib.indexing import BruteForceKnnFactory
+
+    class DocS(pw.Schema):
+        doc_id: str = pw.column_definition(primary_key=True)
+        vx: float
+        vy: float
+
+    docs = pw.io.python.read(_Subject(), schema=DocS)
+    docs = docs.select(
+        doc_id=pw.this.doc_id,
+        vec=pw.apply(lambda x, y: (float(x), float(y)), pw.this.vx, pw.this.vy),
+    )
+    index = BruteForceKnnFactory(
+        dimensions=2, reserved_space=4096
+    ).build_data_index(docs.vec, docs)
+    index.query_as_of_now(docs.vec, number_of_matches=2)
+
+
+def test_device_budget_per_chip(monkeypatch):
+    """PATHWAY_DEVICE_BUDGET_BYTES: the device-resident share of the
+    estimate must fit per chip; PW-M002 carries the device scope."""
+    monkeypatch.setenv("PATHWAY_DEVICE_BUDGET_BYTES", "1")
+    monkeypatch.setenv("PATHWAY_DEVICE_CHIPS", "2")
+    _indexed_docs_graph()
+    diags = analyze()
+    dev = [
+        d
+        for d in diags
+        if d.code == "PW-M002"
+        and d.details.get("scope") == "device-per-chip"
+    ]
+    assert dev, codes(diags)
+    det = dev[0].details
+    assert det["chips"] == 2
+    assert det["estimated_bytes"] > det["budget_bytes"]
+    assert det["breakdown"]
+
+
+def test_device_budget_ample_clean(monkeypatch):
+    monkeypatch.setenv("PATHWAY_DEVICE_BUDGET_BYTES", "1TiB")
+    _indexed_docs_graph()
+    assert not [
+        d
+        for d in analyze()
+        if d.code == "PW-M002"
+        and d.details.get("scope") == "device-per-chip"
+    ]
